@@ -1,0 +1,32 @@
+"""p2pmicrogrid_tpu — a TPU-native P2P electricity-trading community framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Simencassiman/P2PMicrogrid
+(reference mounted at /root/reference): prosumer agents (household load + PV +
+battery + 2R2C heat-pump thermal model) learn — tabular Q, DQN, or DDPG-style
+actor-critic — to schedule heat-pump power and trade energy at negotiated P2P
+prices against a sinusoidal time-of-use grid tariff.
+
+Architectural stance (vs. the reference's eager, object-per-agent TensorFlow):
+
+* All simulation state is one explicit PyTree (struct-of-arrays); agents are a
+  batch axis, Monte-Carlo scenarios a second batch axis.
+* The whole community step — multi-round price negotiation, pairwise market
+  clearing, asset dynamics, rewards, and per-slot learning — is a single pure
+  function; an episode is ``jax.lax.scan`` over time slots; everything compiles
+  into one XLA program.
+* Scenarios shard over a ``jax.sharding.Mesh`` (ICI all-reduce for shared
+  parameters), scaling to 1000-agent x 10k-scenario training.
+
+Layer map (mirrors SURVEY.md section 1 of the parent repo):
+
+* ``config``    — typed experiment configuration (reference: microgrid/setup.py)
+* ``data``      — trace ingestion/synthesis + results store (dataset.py, database.py)
+* ``ops``       — pure physics/market math (heating.py, storage.py, community.py)
+* ``models``    — policies as pure functions over batched params (rl.py, ml.py)
+* ``envs``      — the community simulator (community.py, environment.py)
+* ``train``     — training loops and replay (rl.py Trainer, community.main)
+* ``parallel``  — mesh/sharding utilities (no reference analogue; TPU-native)
+* ``analysis``  — post-run reporting (data_analysis.py)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,246 @@
+"""Typed experiment configuration.
+
+Replaces the reference's module-level constants (microgrid/setup.py:15-36) and its
+gitignored machine-local ``config.py`` (paths; consumed at microgrid/database.py:16-20)
+with frozen, hashable dataclasses that can be passed as static arguments to jitted
+functions. Every default matches the reference value, cited per field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+# --- Time base (reference: setup.py:8-16) ---
+SECONDS_PER_MINUTE = 60
+MINUTES_PER_HOUR = 60
+SECONDS_PER_HOUR = SECONDS_PER_MINUTE * MINUTES_PER_HOUR
+HOURS_PER_DAY = 24
+CENTS_PER_EURO = 100
+KWH_TO_WS = 1e3 * SECONDS_PER_HOUR
+
+
+@dataclass(frozen=True)
+class TariffConfig:
+    """Grid tariff: sinusoidal time-of-use buy price, flat injection price.
+
+    Reference: setup.py:21-25 (constants), agent.py:59-67 (price curve).
+    """
+
+    cost_avg: float = 12.0          # c€/kWh           (setup.py:21)
+    cost_amplitude: float = 5.0     # c€/kWh           (setup.py:22)
+    cost_period: float = 12.0       # hours            (setup.py:23)
+    cost_phase: float = 3.0         # radians          (setup.py:24)
+    injection_price: float = 0.07   # €/kWh            (setup.py:25)
+
+
+@dataclass(frozen=True)
+class ThermalConfig:
+    """2R2C thermal building model + heat pump.
+
+    Reference: heating.py:23-29 (RC parameters), heating.py:90-104,158-163
+    (setpoint, margin, heat pump), community.py:226 (cop=3, max 3 kW).
+    """
+
+    ci: float = 2.44e6 * 2          # indoor-air heat capacity, J/K     (heating.py:23)
+    cm: float = 9.4e7               # building-mass heat capacity, J/K  (heating.py:24)
+    ri: float = 8.64e-4             # indoor<->mass resistance, K/W     (heating.py:25)
+    re: float = 1.05e-2             # mass<->outdoor resistance, K/W    (heating.py:26)
+    rvent: float = 7.98e-3          # ventilation resistance, K/W       (heating.py:27)
+    ga: float = 11.468              # solar aperture, m^2               (heating.py:28)
+    f_rad: float = 0.3              # radiative fraction of HP heat     (heating.py:29)
+    setpoint: float = 21.0          # °C                                (community.py:226)
+    margin: float = 1.0             # comfort half-band, °C             (heating.py:90)
+    cop: float = 3.0                # heat-pump COP                     (community.py:226)
+    hp_max_power: float = 3e3       # heat-pump electrical max, W       (community.py:226)
+    init_temp_std: float = 0.3      # heterogeneous T0 spread, °C       (heating.py:101-104)
+
+    @property
+    def lower_bound(self) -> float:
+        return self.setpoint - self.margin
+
+    @property
+    def upper_bound(self) -> float:
+        return self.setpoint + self.margin
+
+
+@dataclass(frozen=True)
+class BatteryConfig:
+    """Battery storage with sqrt-efficiency charge/discharge accounting.
+
+    Reference: storage.py:36-76,108-116. The shipped reference experiments
+    instantiate ``NoStorage`` (community.py:225); set ``enabled=False`` for
+    exact parity, ``enabled=True`` to activate the modelled-but-dormant asset.
+    """
+
+    enabled: bool = False
+    capacity: float = 10e3 * 3600.0  # Ws (10 kWh)
+    peak_power: float = 5e3          # W
+    min_soc: float = 0.1
+    max_soc: float = 0.9
+    efficiency: float = 0.9
+    init_soc: float = 0.5            # reset value (storage.py:73)
+
+
+@dataclass(frozen=True)
+class AgentPopulationConfig:
+    """Per-agent heterogeneous ratings.
+
+    Reference: community.py:210-228 — load rating ~ N(0.7, 0.2) kW, PV rating
+    ~ N(4, 0.2) kW, scaled x1e3 to W; max_in = max(rating)*safety*1e3.
+
+    ``max_out`` in the reference is ``-(max_power + safety*1e3)``
+    (community.py:228) which is almost certainly a typo for ``*``; we use the
+    multiplicative form (SURVEY.md section 7 "bugs to not copy").
+    """
+
+    load_rating_mean: float = 0.7    # kW   (community.py:210)
+    load_rating_std: float = 0.2
+    pv_rating_mean: float = 4.0      # kW   (community.py:211)
+    pv_rating_std: float = 0.2
+    safety: float = 1.1              # (community.py:217)
+
+
+@dataclass(frozen=True)
+class QLearningConfig:
+    """Tabular Q-learning actor.
+
+    Reference: rl.py:56-74 (table shape, gamma, alpha), agent.py:257-268
+    (20 bins per dim, epsilon=0.81, decay 0.9), rl.py:131-132 (epsilon floor).
+    """
+
+    num_time_states: int = 20
+    num_temp_states: int = 20
+    num_balance_states: int = 20
+    num_p2p_states: int = 20
+    num_actions: int = 3
+    gamma: float = 0.9               # (rl.py:59)
+    alpha: float = 1e-5              # (rl.py:60)
+    epsilon: float = 0.81            # (agent.py:264)
+    epsilon_decay: float = 0.9       # (agent.py:264)
+    epsilon_floor: float = 0.1       # (rl.py:132)
+
+
+@dataclass(frozen=True)
+class DQNConfig:
+    """DQN actor + trainer.
+
+    Reference: rl.py:135-148 (64-64-1 state-action Q-net), agent.py:306-311
+    (buffer 5000, batch 32, gamma 0.95, tau 0.005, Adam 1e-5), rl.py:152
+    (epsilon 0.1, decay 0.9), rl.py:329 (first-layer grad clip to [-1, 1]).
+    """
+
+    hidden: int = 64
+    buffer_size: int = 5000
+    batch_size: int = 32
+    gamma: float = 0.95
+    tau: float = 0.005
+    learning_rate: float = 1e-5
+    epsilon: float = 0.1
+    epsilon_decay: float = 0.9
+    grad_clip_first_layer: float = 1.0
+    warmup_passes: int = 5           # init_buffers full passes (community.py:126)
+
+
+@dataclass(frozen=True)
+class DDPGConfig:
+    """Continuous-action actor-critic with Ornstein-Uhlenbeck exploration.
+
+    Capability represented by the reference's stale rl_backup.py (LSTM
+    actor/critic + OU noise, rl_backup.py:14-85,95-103); re-designed here as a
+    feed-forward actor-critic over the same 4-feature observation.
+    """
+
+    actor_hidden: int = 64
+    critic_hidden: int = 64
+    buffer_size: int = 10000         # (rl_backup.py:95)
+    batch_size: int = 128            # (rl_backup.py:96)
+    gamma: float = 0.95
+    tau: float = 0.005               # (rl_backup.py:99)
+    actor_lr: float = 1e-4
+    critic_lr: float = 2e-4          # critic x2 actor lr (rl.py:596-597)
+    ou_theta: float = 0.1            # (rl_backup.py:100)
+    ou_sigma: float = 0.1            # (rl_backup.py:101)
+    ou_dt: float = 1e-2              # (rl_backup.py:66)
+    ou_init_sd: float = 1.0          # (rl_backup.py:102)
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Simulation time base and community shape.
+
+    Reference: setup.py:16 (15-minute slots), setup.py:33-36 (community knobs).
+    """
+
+    time_slot_minutes: int = 15      # (setup.py:16)
+    n_agents: int = 2                # (setup.py:33)
+    rounds: int = 1                  # negotiation rounds (setup.py:34)
+    homogeneous: bool = False        # (setup.py:35)
+    n_scenarios: int = 1             # Monte-Carlo scenario batch (TPU-native axis)
+    # Reference quirk (agent.py:293-296, community.py:161): the next-state
+    # observation reuses the *current* indoor temperature (assets step after
+    # training) and a zero p2p signal. True = replicate; False = use the
+    # advanced temperature.
+    stale_next_temp: bool = True
+
+    @property
+    def slots_per_day(self) -> int:
+        return HOURS_PER_DAY * MINUTES_PER_HOUR // self.time_slot_minutes
+
+    @property
+    def dt_seconds(self) -> float:
+        return float(self.time_slot_minutes * SECONDS_PER_MINUTE)
+
+    @property
+    def slot_hours(self) -> float:
+        return self.time_slot_minutes / MINUTES_PER_HOUR
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Outer training-loop knobs (reference: setup.py:29-32, community.py:272-298)."""
+
+    max_episodes: int = 1000         # (setup.py:30)
+    starting_episodes: int = 0       # (setup.py:29)
+    min_episodes_criterion: int = 50 # stats/decay window (setup.py:31)
+    save_episodes: int = 50          # checkpoint cadence (setup.py:32)
+    seed: int = 42                   # (setup.py:26)
+    implementation: str = "tabular"  # 'tabular' | 'dqn' | 'ddpg' (setup.py:36)
+    episodes_per_jit_block: int = 1  # episodes fused into one jitted call
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    """Top-level bundle; ``setting`` mirrors the reference's experiment-identity
+    string (community.py:423) so results stay comparable."""
+
+    sim: SimConfig = SimConfig()
+    tariff: TariffConfig = TariffConfig()
+    thermal: ThermalConfig = ThermalConfig()
+    battery: BatteryConfig = BatteryConfig()
+    population: AgentPopulationConfig = AgentPopulationConfig()
+    qlearning: QLearningConfig = QLearningConfig()
+    dqn: DQNConfig = DQNConfig()
+    ddpg: DDPGConfig = DDPGConfig()
+    train: TrainConfig = TrainConfig()
+
+    @property
+    def setting(self) -> str:
+        s = self.sim
+        return (
+            f"{s.n_agents}-multi-agent-com-rounds-{s.rounds}-"
+            f"{'homo' if s.homogeneous else 'hetero'}"
+        )
+
+    def replace(self, **kwargs) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+def default_config(**overrides) -> ExperimentConfig:
+    """Build an ExperimentConfig, overriding nested fields by keyword.
+
+    Accepts top-level section overrides, e.g.
+    ``default_config(sim=SimConfig(n_agents=10))``.
+    """
+    return ExperimentConfig(**overrides)

@@ -1,0 +1,23 @@
+"""Data layer: trace ingestion/synthesis and the results store.
+
+TPU-native replacement for the reference's tf.data pipeline (dataset.py) and
+SQLite persistence (database.py): traces become time-major device arrays that
+feed ``lax.scan`` directly; results keep the reference's relational schema so
+the analysis layer stays compatible.
+"""
+
+from p2pmicrogrid_tpu.data.traces import (
+    TraceSet,
+    synthetic_traces,
+    load_reference_db,
+    train_validation_test_split,
+    agent_profiles,
+)
+
+__all__ = [
+    "TraceSet",
+    "synthetic_traces",
+    "load_reference_db",
+    "train_validation_test_split",
+    "agent_profiles",
+]

@@ -1,0 +1,221 @@
+"""Environment and agent traces as time-major arrays.
+
+Replaces the reference's tf.data pipeline (microgrid/dataset.py): SQLite rows
+become plain ``float32`` arrays ``[T]`` / ``[T, n_profiles]``, normalized the
+same way (per-column divide-by-max, dataset.py:40-54; time as slot/96,
+dataset.py:34-44). The ``(state, next_state)`` pairing that the reference
+builds with ``np.roll`` (dataset.py:98-103) is done here once with
+``np.roll(x, -1, axis=0)`` so episodes can be ``lax.scan``-ed without any
+host-side iterator.
+
+A seeded synthetic generator stands in for the gitignored measurement database
+(reference .gitignore:4) — October-like daily load/PV/temperature shapes —
+so the framework and its tests never depend on absent data.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+SLOTS_PER_DAY = 96
+
+# Reference day splits (dataset.py:17-20): October 2021.
+TRAINING_DAYS = list(range(11, 18))
+VALIDATION_DAYS = [18]
+TESTING_DAYS = [8, 9, 10, 19, 20]
+
+
+class TraceSet(NamedTuple):
+    """Time-major traces for a set of days.
+
+    time:  [T] normalized slot-of-day in [0, 1)   (dataset.py:43-44)
+    t_out: [T] outdoor temperature [°C]
+    load:  [T, P] normalized household load profiles in [0, 1] (dataset.py:47-48)
+    pv:    [T, P] normalized PV production in [0, 1]           (dataset.py:49)
+    day:   [T] integer day-of-month tag (for per-day eval grouping,
+           community.py:373-383)
+    """
+
+    time: np.ndarray
+    t_out: np.ndarray
+    load: np.ndarray
+    pv: np.ndarray
+    day: np.ndarray
+
+    @property
+    def n_slots(self) -> int:
+        return self.time.shape[0]
+
+    @property
+    def n_profiles(self) -> int:
+        return self.load.shape[1]
+
+    def select_days(self, days: Sequence[int]) -> "TraceSet":
+        mask = np.isin(self.day, np.asarray(days))
+        return TraceSet(*(a[mask] for a in self))
+
+    def normalized(self) -> "TraceSet":
+        """Per-column divide-by-max of load/pv (dataset.py:47-49).
+
+        The reference normalizes *within each day split* (process_dataframe
+        runs on the already-filtered days, dataset.py:61-80), so call this on
+        a split, not on the full month.
+        """
+        return self._replace(
+            load=(self.load / self.load.max(axis=0, keepdims=True)).astype(np.float32),
+            pv=(self.pv / self.pv.max(axis=0, keepdims=True)).astype(np.float32),
+        )
+
+    def split_by_day(self) -> Dict[int, "TraceSet"]:
+        return {int(d): self.select_days([int(d)]) for d in np.unique(self.day)}
+
+
+def _daily_profile(rng: np.random.Generator, n_days: int, kind: str) -> np.ndarray:
+    """One [n_days * 96] synthetic profile of the requested kind."""
+    t = np.arange(SLOTS_PER_DAY) / SLOTS_PER_DAY  # day fraction
+    out = np.zeros((n_days, SLOTS_PER_DAY))
+    for d in range(n_days):
+        if kind == "load":
+            base = 0.15 + 0.05 * rng.uniform()
+            morning = 0.5 * np.exp(-((t - 7.5 / 24) ** 2) / (2 * (1.2 / 24) ** 2))
+            evening = 0.9 * np.exp(-((t - 19.0 / 24) ** 2) / (2 * (2.0 / 24) ** 2))
+            noise = 0.08 * rng.standard_normal(SLOTS_PER_DAY)
+            out[d] = np.clip(base + morning + evening + noise, 0.02, None)
+        elif kind == "pv":
+            # October sun: production window ~8h-18h, weather-dependent peak.
+            weather = rng.uniform(0.3, 1.0)
+            bell = np.exp(-((t - 12.75 / 24) ** 2) / (2 * (2.2 / 24) ** 2))
+            cloud = 1.0 - 0.3 * np.abs(np.sin(40 * np.pi * t + rng.uniform(0, np.pi)))
+            out[d] = np.clip(weather * bell * cloud - 0.02, 0.0, None)
+        elif kind == "temperature":
+            mean = rng.uniform(7.0, 12.0)
+            swing = rng.uniform(2.0, 5.0)
+            # Daily minimum around 3 am, maximum mid-afternoon (3 pm).
+            out[d] = mean + swing * np.sin(2 * np.pi * (t - 9.0 / 24)) + 0.3 * rng.standard_normal(SLOTS_PER_DAY)
+        else:
+            raise ValueError(kind)
+    return out.reshape(-1)
+
+
+def synthetic_traces(
+    n_days: int = 13,
+    n_profiles: int = 5,
+    seed: int = 42,
+    start_day: int = 8,
+) -> TraceSet:
+    """Seeded October-like synthetic traces.
+
+    Defaults give days 8..20 so the reference day splits (train 11-17,
+    val 18, test {8, 9, 10, 19, 20}; dataset.py:17-20) apply verbatim. Profiles
+    mirror the reference's 5 household load columns l0..l4 (dataset.py:30); PV
+    is one shared trace replicated per profile (the reference has a single
+    ``pv`` column, dataset.py:29).
+    """
+    rng = np.random.default_rng(seed)
+    T = n_days * SLOTS_PER_DAY
+
+    time = np.tile(np.arange(SLOTS_PER_DAY) / SLOTS_PER_DAY, n_days).astype(np.float32)
+    t_out = _daily_profile(rng, n_days, "temperature").astype(np.float32)
+
+    load = np.stack(
+        [_daily_profile(rng, n_days, "load") for _ in range(n_profiles)], axis=1
+    )
+    pv_single = _daily_profile(rng, n_days, "pv")
+    pv = np.repeat(pv_single[:, None], n_profiles, axis=1)
+
+    # Raw (unnormalized) traces: normalization is per day-split, matching the
+    # reference (process_dataframe runs after day filtering, dataset.py:61-80)
+    # — use TraceSet.normalized() on each split.
+    load = load.astype(np.float32)
+    pv = pv.astype(np.float32)
+
+    day = np.repeat(np.arange(start_day, start_day + n_days), SLOTS_PER_DAY).astype(np.int32)
+    assert time.shape[0] == T
+    return TraceSet(time=time, t_out=t_out, load=load, pv=pv, day=day)
+
+
+def load_reference_db(
+    db_path: str,
+    month: int = 10,
+    days: Optional[Sequence[int]] = None,
+    load_cols: Sequence[str] = ("l0", "l1", "l2", "l3", "l4"),
+) -> TraceSet:
+    """Ingest the reference's SQLite measurement DB (database.py:28-43 schema).
+
+    Joins ``environment`` and ``load`` on (date, time, utc) (database.py:128-147),
+    computes the slot-of-day encoding (dataset.py:34-44), normalizes load/pv by
+    their max (dataset.py:47-49), and tags rows with day-of-month.
+    """
+    import pandas as pd  # host-side only
+
+    con = sqlite3.connect(db_path)
+    try:
+        df_env = pd.read_sql_query("SELECT * FROM environment", con)
+        df_load = pd.read_sql_query("SELECT * FROM load", con)
+    finally:
+        con.close()
+
+    df = pd.merge(df_env, df_load, on=["date", "time", "utc"], copy=False)
+    parts = df["date"].str.split("-", expand=True)
+    df["month"] = parts[1].astype(int)
+    df["day"] = parts[2].astype(int)
+    df = df[df["month"] == month]
+    if days is not None:
+        df = df[df["day"].isin(list(days))]
+
+    def slot(timestr: str) -> float:
+        h, m, _ = timestr.split(":")
+        return int(m) / 15 + int(h) * 4
+
+    time = (df["time"].map(slot).to_numpy() / SLOTS_PER_DAY).astype(np.float32)
+    t_out = df["temperature"].astype(float).to_numpy().astype(np.float32)
+    load = np.stack(
+        [df[c].astype(float).to_numpy() for c in load_cols], axis=1
+    ).astype(np.float32)
+    pv_single = df["pv"].astype(float).to_numpy().astype(np.float32)
+    pv = np.repeat(pv_single[:, None], len(load_cols), axis=1)
+
+    # Raw traces; normalize per split via TraceSet.normalized() (see above).
+    day = df["day"].to_numpy().astype(np.int32)
+    return TraceSet(time=time, t_out=t_out, load=load, pv=pv, day=day)
+
+
+def train_validation_test_split(
+    traces: TraceSet,
+) -> Tuple[TraceSet, TraceSet, TraceSet]:
+    """Reference day split (dataset.py:17-20,83-95), each split normalized
+    within itself exactly as the reference's process_dataframe does (it runs on
+    the already-filtered days)."""
+    return (
+        traces.select_days(TRAINING_DAYS).normalized(),
+        traces.select_days(VALIDATION_DAYS).normalized(),
+        traces.select_days(TESTING_DAYS).normalized(),
+    )
+
+
+def agent_profiles(
+    traces: TraceSet,
+    n_agents: int,
+    load_ratings_w: np.ndarray,
+    pv_ratings_w: np.ndarray,
+    homogeneous: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Denormalized per-agent power traces in W.
+
+    Mirrors community.py:219-224: agent i uses profile ``i % n_profiles``
+    (homogeneous: profile 0 for all, community.py:203-204) scaled by its
+    rating in W. Returns (load_w, pv_w) each [T, A].
+    """
+    idx = np.zeros(n_agents, dtype=int) if homogeneous else np.arange(n_agents) % traces.n_profiles
+    load_w = traces.load[:, idx] * np.asarray(load_ratings_w)[None, :]
+    pv_w = traces.pv[:, idx] * np.asarray(pv_ratings_w)[None, :]
+    return load_w.astype(np.float32), pv_w.astype(np.float32)
+
+
+def next_slot(x: np.ndarray) -> np.ndarray:
+    """The reference's (state, next_state) pairing: roll -1 along time
+    (dataset.py:98-103); the last slot wraps to the first."""
+    return np.roll(x, -1, axis=0)

@@ -1,0 +1,24 @@
+"""Pure physics / market math.
+
+Every function here is a pure ``jnp`` function over arrays with no Python-side
+state, designed to be vmapped over agents and scenarios and scanned over time.
+These are the TPU-native equivalents of the reference's asset classes
+(heating.py, storage.py, production.py) and the community's market/cost math
+(community.py:45-65, agent.py:59-67).
+"""
+
+from p2pmicrogrid_tpu.ops.thermal import thermal_step, comfort_penalty
+from p2pmicrogrid_tpu.ops.tariff import grid_prices
+from p2pmicrogrid_tpu.ops.market import clear_market, compute_costs, divide_power
+from p2pmicrogrid_tpu.ops.battery import battery_step, battery_rule_update
+
+__all__ = [
+    "thermal_step",
+    "comfort_penalty",
+    "grid_prices",
+    "clear_market",
+    "compute_costs",
+    "divide_power",
+    "battery_step",
+    "battery_rule_update",
+]

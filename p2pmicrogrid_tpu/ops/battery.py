@@ -1,0 +1,90 @@
+"""Battery storage with sqrt-efficiency accounting — pure step functions.
+
+Reference: microgrid/storage.py:36-76 (``BatteryStorage``) and the rule-based
+charge/discharge policy at agent.py:138-153 (``RuleAgent._update_storage``).
+State is just the state-of-charge ``soc`` (any batch shape); the reference's
+``NoStorage`` null object becomes ``BatteryConfig.enabled=False`` (callers
+short-circuit).
+
+Round-trip losses are split sqrt-wise: charging ``e`` Ws of input energy adds
+``sqrt(eta) * e / capacity`` SoC (storage.py:60-61); discharging to deliver
+``e`` Ws removes ``(e / sqrt(eta)) / capacity`` SoC (storage.py:63-64).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import BatteryConfig
+
+
+def available_space(cfg: BatteryConfig, soc: jnp.ndarray) -> jnp.ndarray:
+    """Input energy [Ws] the battery can still absorb (storage.py:47-50)."""
+    return jnp.maximum(0.0, cfg.max_soc - soc) * cfg.capacity / jnp.sqrt(cfg.efficiency)
+
+
+def available_energy(cfg: BatteryConfig, soc: jnp.ndarray) -> jnp.ndarray:
+    """Output energy [Ws] the battery can still deliver (storage.py:53-55)."""
+    return jnp.maximum(0.0, soc - cfg.min_soc) * cfg.capacity * jnp.sqrt(cfg.efficiency)
+
+
+def battery_step(
+    cfg: BatteryConfig,
+    soc: jnp.ndarray,
+    power: jnp.ndarray,
+    dt: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply a signed battery power for one slot, clipped to physical limits.
+
+    Args:
+        soc: state of charge in [0, 1].
+        power: requested battery power [W]; positive = charge, negative =
+            discharge (delivered to the household).
+        dt: slot length in seconds.
+
+    Returns:
+        (new_soc, actual_power): ``actual_power`` is the clipped realized power
+        so callers can settle the residual with the grid.
+    """
+    power = jnp.clip(power, -cfg.peak_power, cfg.peak_power)
+    charge_e = jnp.minimum(jnp.maximum(power, 0.0) * dt, available_space(cfg, soc))
+    discharge_e = jnp.minimum(jnp.maximum(-power, 0.0) * dt, available_energy(cfg, soc))
+
+    new_soc = (
+        soc
+        + jnp.sqrt(cfg.efficiency) * charge_e / cfg.capacity
+        - discharge_e / (jnp.sqrt(cfg.efficiency) * cfg.capacity)
+    )
+    actual_power = (charge_e - discharge_e) / dt
+    return new_soc, actual_power
+
+
+def battery_rule_update(
+    cfg: BatteryConfig,
+    soc: jnp.ndarray,
+    balance: jnp.ndarray,
+    dt: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy rule-based storage policy (agent.py:138-153).
+
+    Positive balance (net consumption) discharges the battery to cover it;
+    negative balance (excess PV) charges the battery with the surplus. Returns
+    (new_soc, new_balance) with the covered/stored part removed.
+    """
+    energy = balance * dt
+    discharge = jnp.where(
+        balance > 0.0, jnp.minimum(energy, available_energy(cfg, soc)), 0.0
+    )
+    charge = jnp.where(
+        balance < 0.0, jnp.minimum(-energy, available_space(cfg, soc)), 0.0
+    )
+
+    new_soc = (
+        soc
+        + jnp.sqrt(cfg.efficiency) * charge / cfg.capacity
+        - discharge / (jnp.sqrt(cfg.efficiency) * cfg.capacity)
+    )
+    new_balance = balance - discharge / dt + charge / dt
+    return new_soc, new_balance

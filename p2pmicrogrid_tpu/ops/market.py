@@ -1,0 +1,87 @@
+"""P2P market clearing, settlement costs, and proposal splitting.
+
+Reference: microgrid/community.py:45-65 (``_assign_powers``/``_compute_costs``)
+and agent.py:186-195 (``_divide_power``). All functions broadcast over leading
+batch axes (scenarios); the agent axes are the trailing one or two dims.
+
+Sign convention (inherited from the reference): positive power = consumption
+(buy), negative = injection (sell). ``p2p[i, j]`` is agent i's proposed
+exchange with agent j; a trade matches where ``p2p[i, j]`` and ``p2p[j, i]``
+have opposite signs.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def clear_market(p2p: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pairwise sign-opposition matching (community.py:45-54).
+
+    Args:
+        p2p: [..., A, A] proposal matrix (diagonal ignored — zero by
+            construction in the negotiation loop).
+
+    Returns:
+        (p_grid, p_p2p): each [..., A]; matched power settles peer-to-peer at
+        the midpoint price, the residual goes to the grid.
+    """
+    p2p_t = jnp.swapaxes(p2p, -1, -2)
+    p_match = jnp.where(jnp.sign(p2p) != jnp.sign(p2p_t), p2p, 0.0)
+    abs_match = jnp.abs(p_match)
+    exchange = jnp.sign(p_match) * jnp.minimum(abs_match, jnp.swapaxes(abs_match, -1, -2))
+
+    p_grid = jnp.sum(p2p - exchange, axis=-1)
+    p_p2p = jnp.sum(exchange, axis=-1)
+    return p_grid, p_p2p
+
+
+def compute_costs(
+    p_grid: jnp.ndarray,
+    p_p2p: jnp.ndarray,
+    buy_price: jnp.ndarray,
+    injection_price: jnp.ndarray,
+    p2p_price: jnp.ndarray,
+    slot_hours: float,
+) -> jnp.ndarray:
+    """Per-agent settlement cost in € for one slot (community.py:56-65).
+
+    Powers are in W; ``* slot_hours * 1e-3`` converts W to kWh for the €/kWh
+    prices. Positive grid power pays the buy price, negative earns the
+    injection price; matched P2P power settles at the midpoint price.
+    Prices broadcast over the agent axis.
+    """
+    grid_cost = jnp.where(p_grid >= 0.0, p_grid * buy_price, p_grid * injection_price)
+    return (grid_cost + p_p2p * p2p_price) * slot_hours * 1e-3
+
+
+def divide_power(out: jnp.ndarray, powers: jnp.ndarray) -> jnp.ndarray:
+    """Split one agent's net power across counterparties (agent.py:186-195).
+
+    Args:
+        out: scalar (or [...]-batched) net power the agent wants to exchange.
+        powers: [..., A] what each counterparty proposed toward this agent
+            (the negotiation loop passes ``-p2p[:, i]``).
+
+    Proposals are split proportionally to counterparties of *opposite* sign
+    (those are potential trade partners); if there are none, split equally.
+    """
+    out = jnp.asarray(out)
+    filtered = jnp.where(jnp.sign(out)[..., None] != jnp.sign(powers), powers, 0.0)
+    total = jnp.abs(jnp.sum(filtered, axis=-1, keepdims=True))
+    n = powers.shape[-1]
+    # Both branches of the reference's if/else, made XLA-safe: guard the
+    # denominator so the untaken branch cannot produce NaN under jnp.where.
+    safe_total = jnp.where(total > 0.0, total, 1.0)
+    proportional = out[..., None] * jnp.abs(filtered) / safe_total
+    equal = out[..., None] * jnp.ones_like(powers) / n
+    return jnp.where(total > 0.0, proportional, equal)
+
+
+def zero_diagonal(p2p: jnp.ndarray) -> jnp.ndarray:
+    """Remove self-trades (community.py:76)."""
+    a = p2p.shape[-1]
+    eye = jnp.eye(a, dtype=p2p.dtype)
+    return p2p * (1.0 - eye)

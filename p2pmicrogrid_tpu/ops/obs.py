@@ -1,0 +1,52 @@
+"""Observation assembly and tabular state discretization.
+
+Reference: microgrid/agent.py:178-184 (``_get_observation_state``) — the policy
+observation is ``[time, normalized_temperature, balance, mean_p2p]`` — and
+rl.py:89-95 (``QActor._get_state_indices``) for the 20^4 discretizer.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import QLearningConfig
+
+OBS_DIM = 4
+
+
+def make_observation(
+    time_norm: jnp.ndarray,
+    norm_temp: jnp.ndarray,
+    balance: jnp.ndarray,
+    p2p_mean: jnp.ndarray,
+) -> jnp.ndarray:
+    """Stack the 4 features on a trailing axis (agent.py:178-184).
+
+    All inputs broadcast; result is [..., 4].
+    """
+    return jnp.stack(
+        jnp.broadcast_arrays(time_norm, norm_temp, balance, p2p_mean), axis=-1
+    )
+
+
+def discretize(cfg: QLearningConfig, obs: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """Map a [..., 4] observation to Q-table indices (rl.py:89-95).
+
+    The reference uses Python ``int()`` (truncation toward zero) then clamps;
+    ``astype(int32)`` matches the truncation semantics exactly.
+    """
+    nt, ntp, nb, np_ = (
+        cfg.num_time_states,
+        cfg.num_temp_states,
+        cfg.num_balance_states,
+        cfg.num_p2p_states,
+    )
+    time_i = jnp.clip((obs[..., 0] * nt).astype(jnp.int32), 0, nt - 1)
+    temp_i = jnp.clip(
+        ((obs[..., 1] + 1.0) / 2.0 * (ntp - 2) + 1.0).astype(jnp.int32), 0, ntp - 1
+    )
+    bal_i = jnp.clip(((obs[..., 2] + 1.0) / 2.0 * nb).astype(jnp.int32), 0, nb - 1)
+    p2p_i = jnp.clip(((obs[..., 3] + 1.0) / 2.0 * np_).astype(jnp.int32), 0, np_ - 1)
+    return time_i, temp_i, bal_i, p2p_i

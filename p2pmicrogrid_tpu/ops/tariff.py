@@ -1,0 +1,33 @@
+"""Time-of-use grid tariff — pure price curve.
+
+Reference: microgrid/agent.py:46-67 (``GridAgent``): sinusoidal buy price in
+c€/kWh converted to €/kWh, flat injection price in €/kWh.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import CENTS_PER_EURO, HOURS_PER_DAY, TariffConfig
+
+
+def grid_prices(cfg: TariffConfig, time_norm: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Buy and injection price for normalized day-time ``time_norm`` in [0, 1).
+
+    buy(t) = (avg + amp * sin(t * 2*pi*24/period - phase)) / 100   [€/kWh]
+    (agent.py:54,60-64); injection price is constant (agent.py:57).
+
+    Broadcasts over any batch shape of ``time_norm``; also the P2P trade price
+    is conventionally the midpoint (community.py:70) — computed by callers.
+    """
+    freq = 2.0 * jnp.pi * HOURS_PER_DAY / cfg.cost_period
+    buy = (cfg.cost_avg + cfg.cost_amplitude * jnp.sin(time_norm * freq - cfg.cost_phase)) / CENTS_PER_EURO
+    injection = jnp.full_like(buy, cfg.injection_price)
+    return buy, injection
+
+
+def p2p_price(buy: jnp.ndarray, injection: jnp.ndarray) -> jnp.ndarray:
+    """Midpoint P2P settlement price (community.py:70)."""
+    return 0.5 * (buy + injection)

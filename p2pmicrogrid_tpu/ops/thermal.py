@@ -1,0 +1,77 @@
+"""2R2C thermal building model — pure, vmappable Euler step.
+
+Reference: microgrid/heating.py:37-56 (``temperature_simulation``) and
+heating.py:90-124 (comfort band, normalized temperature, HP power scaling).
+
+State convention: temperatures are plain arrays (any batch shape); the heat
+pump's electrical power is ``frac * hp_max_power`` and injects
+``power * cop`` watts of heat, split ``(1 - f_rad)`` into indoor air and
+``f_rad`` into the building mass.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import ThermalConfig
+
+
+def thermal_step(
+    cfg: ThermalConfig,
+    dt: float,
+    t_out: jnp.ndarray,
+    t_in: jnp.ndarray,
+    t_bm: jnp.ndarray,
+    hp_power: jnp.ndarray,
+    solar_rad: jnp.ndarray | float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One Euler step of the 2R2C model (heating.py:37-56).
+
+    Args:
+        cfg: thermal parameters.
+        dt: step length in seconds (reference: SECONDS_PER_MINUTE * TIME_SLOT).
+        t_out: outdoor temperature [°C].
+        t_in: indoor-air temperature [°C].
+        t_bm: building-mass temperature [°C].
+        hp_power: heat-pump *electrical* power [W] (already frac * max_power).
+        solar_rad: solar irradiation [W/m^2]; the reference always passes 0
+            (heating.py:129-130 omits it).
+
+    Returns:
+        (t_in_new, t_bm_new).
+    """
+    heat = hp_power * cfg.cop
+
+    d_tin = (1.0 / cfg.ci) * (
+        (t_bm - t_in) / cfg.ri
+        + (t_out - t_in) / cfg.rvent
+        + (1.0 - cfg.f_rad) * heat
+    )
+    d_tbm = (1.0 / cfg.cm) * (
+        (t_in - t_bm) / cfg.ri
+        + (t_out - t_bm) / cfg.re
+        + cfg.ga * solar_rad
+        + cfg.f_rad * heat
+    )
+
+    return t_in + d_tin * dt, t_bm + d_tbm * dt
+
+
+def normalized_temperature(cfg: ThermalConfig, t_in: jnp.ndarray) -> jnp.ndarray:
+    """(t_in - setpoint) / margin, the policy observation (heating.py:119-120)."""
+    return (t_in - cfg.setpoint) / cfg.margin
+
+
+def comfort_penalty(cfg: ThermalConfig, t_in: jnp.ndarray) -> jnp.ndarray:
+    """Comfort-band violation with the reference's +1 offset (agent.py:225-232).
+
+    Zero inside [setpoint - margin, setpoint + margin]; outside, the excess in
+    °C plus 1 (the offset makes even marginal violations cost ~10 in reward).
+    """
+    excess = jnp.maximum(
+        jnp.maximum(0.0, cfg.lower_bound - t_in),
+        jnp.maximum(0.0, t_in - cfg.upper_bound),
+    )
+    return jnp.where(excess > 0.0, excess + 1.0, 0.0)

@@ -1,0 +1,83 @@
+"""Tests for the data layer: synthetic traces, splits, profile scaling."""
+
+import numpy as np
+
+from p2pmicrogrid_tpu.data.traces import (
+    SLOTS_PER_DAY,
+    TESTING_DAYS,
+    TRAINING_DAYS,
+    VALIDATION_DAYS,
+    TraceSet,
+    agent_profiles,
+    next_slot,
+    synthetic_traces,
+    train_validation_test_split,
+)
+
+
+def test_shapes_and_normalization():
+    tr = synthetic_traces(n_days=3, n_profiles=5, seed=0).normalized()
+    assert tr.n_slots == 3 * SLOTS_PER_DAY
+    assert tr.load.shape == (tr.n_slots, 5)
+    assert tr.pv.shape == (tr.n_slots, 5)
+    # Reference normalization: column max == 1 (dataset.py:47-49).
+    np.testing.assert_allclose(tr.load.max(axis=0), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(tr.pv.max(axis=0), 1.0, rtol=1e-6)
+    assert tr.load.min() >= 0.0 and tr.pv.min() >= 0.0
+    # time is slot/96, repeating daily (dataset.py:43-44).
+    assert tr.time[0] == 0.0
+    np.testing.assert_allclose(tr.time[:SLOTS_PER_DAY], np.arange(96) / 96.0, atol=1e-7)
+    np.testing.assert_allclose(tr.time[SLOTS_PER_DAY], 0.0, atol=1e-7)
+
+
+def test_determinism():
+    a = synthetic_traces(n_days=2, seed=7)
+    b = synthetic_traces(n_days=2, seed=7)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_day_split_matches_reference():
+    """dataset.py:17-20: train 11-17, val 18, test {8,9,10,19,20}."""
+    tr = synthetic_traces(n_days=13, start_day=8)
+    train, val, test = train_validation_test_split(tr)
+    assert sorted(np.unique(train.day).tolist()) == TRAINING_DAYS
+    assert sorted(np.unique(val.day).tolist()) == VALIDATION_DAYS
+    assert sorted(np.unique(test.day).tolist()) == TESTING_DAYS
+    assert train.n_slots == 7 * SLOTS_PER_DAY
+    assert val.n_slots == 1 * SLOTS_PER_DAY
+    assert test.n_slots == 5 * SLOTS_PER_DAY
+    # Per-split normalization, matching the reference's process_dataframe
+    # running after day filtering (dataset.py:61-80): each split peaks at 1.
+    for split in (train, val, test):
+        np.testing.assert_allclose(split.load.max(axis=0), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(split.pv.max(axis=0), 1.0, rtol=1e-6)
+
+
+def test_agent_profiles_scaling():
+    tr = synthetic_traces(n_days=1, n_profiles=5).normalized()
+    load_w, pv_w = agent_profiles(
+        tr, n_agents=7,
+        load_ratings_w=np.full(7, 700.0), pv_ratings_w=np.full(7, 4000.0),
+    )
+    assert load_w.shape == (96, 7) and pv_w.shape == (96, 7)
+    # Agent 5 wraps to profile 0 (community.py: agents draw from l0..l4).
+    np.testing.assert_allclose(load_w[:, 5], load_w[:, 0])
+    assert load_w.max() <= 700.0 + 1e-3
+    assert pv_w.max() <= 4000.0 + 1e-3
+
+
+def test_homogeneous_profiles_identical():
+    tr = synthetic_traces(n_days=1).normalized()
+    load_w, _ = agent_profiles(
+        tr, 3, np.full(3, 700.0), np.full(3, 4000.0), homogeneous=True
+    )
+    np.testing.assert_allclose(load_w[:, 1], load_w[:, 0])
+    np.testing.assert_allclose(load_w[:, 2], load_w[:, 0])
+
+
+def test_next_slot_roll():
+    """dataset.py:98-103: next_state pairing wraps the last slot to the first."""
+    x = np.arange(10.0)[:, None]
+    nx = next_slot(x)
+    assert nx[0, 0] == 1.0 and nx[-1, 0] == 0.0
