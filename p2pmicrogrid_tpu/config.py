@@ -137,7 +137,9 @@ class DQNConfig:
     gamma: float = 0.95
     tau: float = 0.005
     learning_rate: float = 1e-5
-    epsilon: float = 0.1
+    # The reference instantiates ActorModel(1) (agent.py:304), overriding the
+    # class default of 0.1 — exploration starts fully random.
+    epsilon: float = 1.0
     epsilon_decay: float = 0.9
     grad_clip_first_layer: float = 1.0
     warmup_passes: int = 5           # init_buffers full passes (community.py:126)

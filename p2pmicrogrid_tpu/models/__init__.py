@@ -1,0 +1,65 @@
+"""Policies as pure functions over batched parameter PyTrees.
+
+TPU-native re-design of the reference's actor classes (microgrid/rl.py): no
+objects with mutable state — each policy is (init, act, learn, decay) pure
+functions over a NamedTuple of arrays carrying a leading agent axis, so a whole
+community of per-agent actors is one vmapped computation.
+"""
+
+from p2pmicrogrid_tpu.models.tabular import (
+    TabularState,
+    tabular_init,
+    tabular_act,
+    tabular_update,
+    tabular_decay,
+)
+from p2pmicrogrid_tpu.models.replay import (
+    ReplayState,
+    replay_init,
+    replay_add,
+    replay_sample,
+)
+from p2pmicrogrid_tpu.models.dqn import (
+    DQNState,
+    dqn_init,
+    dqn_act,
+    dqn_update,
+    dqn_decay,
+    dqn_initialize_target,
+)
+from p2pmicrogrid_tpu.models.ddpg import (
+    DDPGState,
+    ddpg_init,
+    ddpg_act,
+    ddpg_update,
+    ddpg_decay,
+)
+from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES
+
+# Discrete heat-pump power fractions (rl.py:153, agent.py:268); single source
+# of truth is dqn.ACTION_VALUES.
+ACTIONS = tuple(float(v) for v in ACTION_VALUES.tolist())
+
+__all__ = [
+    "ACTIONS",
+    "TabularState",
+    "tabular_init",
+    "tabular_act",
+    "tabular_update",
+    "tabular_decay",
+    "ReplayState",
+    "replay_init",
+    "replay_add",
+    "replay_sample",
+    "DQNState",
+    "dqn_init",
+    "dqn_act",
+    "dqn_update",
+    "dqn_decay",
+    "dqn_initialize_target",
+    "DDPGState",
+    "ddpg_init",
+    "ddpg_act",
+    "ddpg_update",
+    "ddpg_decay",
+]

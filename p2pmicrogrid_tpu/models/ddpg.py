@@ -1,0 +1,189 @@
+"""Batched per-agent DDPG (continuous-action actor-critic + OU noise).
+
+The reference carries this capability only as a stale design iteration
+(rl_backup.py: LSTM actor with sigmoid head, LSTM critic, Ornstein-Uhlenbeck
+exploration noise, rl_backup.py:14-85; driver wiring at :95-150 targets an
+``rl.DDPG`` API that no longer exists). Rebuilt here as a working first-class
+algorithm: feed-forward actor/critic MLPs over the 4-feature observation,
+per-agent replay, Polyak targets — the heat-pump power fraction becomes a
+continuous action in [0, 1] instead of the 3-point grid.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from p2pmicrogrid_tpu.config import DDPGConfig
+from p2pmicrogrid_tpu.models.networks import Actor, Critic
+from p2pmicrogrid_tpu.models.replay import (
+    ReplayState,
+    replay_add,
+    replay_init,
+    replay_sample,
+)
+
+OBS_DIM = 4
+
+
+class DDPGState(NamedTuple):
+    """Per-agent actor/critic params, targets, optimizers, replay, OU noise."""
+
+    actor: dict
+    critic: dict
+    actor_target: dict
+    critic_target: dict
+    actor_opt: tuple
+    critic_opt: tuple
+    replay: ReplayState
+    ou_state: jnp.ndarray  # [A] — current OU noise value per agent
+
+
+def ddpg_init(cfg: DDPGConfig, n_agents: int, key: jax.Array) -> DDPGState:
+    actor = Actor(hidden=cfg.actor_hidden)
+    critic = Critic(hidden=cfg.critic_hidden)
+    dummy_s = jnp.zeros((1, OBS_DIM))
+    dummy_a = jnp.zeros((1, 1))
+    key, k_ou = jax.random.split(key)
+
+    def init_one(k):
+        ka, kc = jax.random.split(k)
+        pa = actor.init(ka, dummy_s)["params"]
+        pc = critic.init(kc, dummy_s, dummy_a)["params"]
+        return pa, pc
+
+    pa, pc = jax.vmap(init_one)(jax.random.split(key, n_agents))
+    return DDPGState(
+        actor=pa,
+        critic=pc,
+        actor_target=jax.tree_util.tree_map(lambda x: x, pa),
+        critic_target=jax.tree_util.tree_map(lambda x: x, pc),
+        actor_opt=jax.vmap(optax.adam(cfg.actor_lr).init)(pa),
+        critic_opt=jax.vmap(optax.adam(cfg.critic_lr).init)(pc),
+        replay=replay_init(n_agents, cfg.buffer_size, OBS_DIM, 1),
+        # OU noise starts at x0 ~ N(0, ou_init_sd) (rl_backup.py:81,102).
+        ou_state=cfg.ou_init_sd * jax.random.normal(k_ou, (n_agents,)),
+    )
+
+
+def _ou_step(cfg: DDPGConfig, x: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """One Ornstein-Uhlenbeck step toward mean 0 (rl_backup.py:65-85)."""
+    noise = jax.random.normal(key, x.shape)
+    return (
+        x
+        - cfg.ou_theta * x * cfg.ou_dt
+        + cfg.ou_sigma * jnp.sqrt(cfg.ou_dt) * noise
+    )
+
+
+def ddpg_act(
+    cfg: DDPGConfig,
+    state: DDPGState,
+    obs: jnp.ndarray,
+    key: jax.Array,
+    explore: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray, DDPGState]:
+    """Deterministic action + OU exploration noise, clipped to [0, 1].
+
+    obs: [A, 4] -> (action_frac [A], q [A], new_state). Unlike the discrete
+    learners, the action is the heat-pump fraction itself.
+    """
+    actor = Actor(hidden=cfg.actor_hidden)
+    critic = Critic(hidden=cfg.critic_hidden)
+
+    def one(pa, pc, o):
+        a = actor.apply({"params": pa}, o[None, :])[0, 0]
+        q = critic.apply({"params": pc}, o[None, :], a[None, None])[0, 0]
+        return a, q
+
+    a, q = jax.vmap(one)(state.actor, state.critic, obs)
+
+    if explore:
+        ou = _ou_step(cfg, state.ou_state, key)
+        a = jnp.clip(a + ou, 0.0, 1.0)
+        state = state._replace(ou_state=ou)
+    return a, q, state
+
+
+def ddpg_update(
+    cfg: DDPGConfig,
+    state: DDPGState,
+    obs: jnp.ndarray,
+    action_frac: jnp.ndarray,
+    reward: jnp.ndarray,
+    next_obs: jnp.ndarray,
+    key: jax.Array,
+) -> Tuple[DDPGState, jnp.ndarray]:
+    """One per-slot learn step: critic TD, actor policy gradient, Polyak.
+
+    obs/next_obs: [A, 4]; action_frac: [A] in [0, 1]; reward: [A].
+    Returns (new_state, critic_loss [A]).
+    """
+    replay = replay_add(state.replay, obs, action_frac[:, None], reward, next_obs)
+    s, a, r, ns = replay_sample(replay, key, cfg.batch_size)
+
+    actor = Actor(hidden=cfg.actor_hidden)
+    critic = Critic(hidden=cfg.critic_hidden)
+    a_opt = optax.adam(cfg.actor_lr)
+    c_opt = optax.adam(cfg.critic_lr)
+
+    def learn_one(pa, pc, pat, pct, oa, oc, s, a, r, ns):
+        # Critic: TD(0) toward target actor/critic bootstrap.
+        na = actor.apply({"params": pat}, ns)
+        q_next = critic.apply({"params": pct}, ns, na)[:, 0]
+        q_target = r + cfg.gamma * q_next
+
+        def critic_loss(p):
+            q = critic.apply({"params": p}, s, a)[:, 0]
+            return jnp.mean(jnp.square(q_target - q))
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss)(pc)
+        c_updates, oc = c_opt.update(c_grads, oc, pc)
+        pc = optax.apply_updates(pc, c_updates)
+
+        # Actor: maximize Q(s, pi(s)).
+        def actor_loss(p):
+            pi = actor.apply({"params": p}, s)
+            return -jnp.mean(critic.apply({"params": pc}, s, pi)[:, 0])
+
+        a_grads = jax.grad(actor_loss)(pa)
+        a_updates, oa = a_opt.update(a_grads, oa, pa)
+        pa = optax.apply_updates(pa, a_updates)
+
+        polyak = lambda t, o: jax.tree_util.tree_map(
+            lambda x, y: (1.0 - cfg.tau) * x + cfg.tau * y, t, o
+        )
+        return pa, pc, polyak(pat, pa), polyak(pct, pc), oa, oc, c_loss
+
+    pa, pc, pat, pct, oa, oc, loss = jax.vmap(learn_one)(
+        state.actor,
+        state.critic,
+        state.actor_target,
+        state.critic_target,
+        state.actor_opt,
+        state.critic_opt,
+        s,
+        a,
+        r,
+        ns,
+    )
+    return (
+        state._replace(
+            actor=pa,
+            critic=pc,
+            actor_target=pat,
+            critic_target=pct,
+            actor_opt=oa,
+            critic_opt=oc,
+            replay=replay,
+        ),
+        loss,
+    )
+
+
+def ddpg_decay(cfg: DDPGConfig, state: DDPGState) -> DDPGState:
+    """OU noise has its own decay-free schedule; kept for interface parity."""
+    return state

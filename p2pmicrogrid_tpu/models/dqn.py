@@ -1,0 +1,199 @@
+"""Batched per-agent DQN.
+
+TPU-native equivalent of the reference's ``ActorModel`` + ``Trainer``
+(rl.py:151-359): each agent owns a 64-64-1 state-action Q-network, a target
+copy, an Adam optimizer, and a replay buffer. Here every per-agent component
+carries a leading agent axis and the act/learn cycle is vmapped across agents,
+so the per-slot "add transition, sample 32, TD step, soft-update" loop
+(rl.py:284-297, agent.py:338-342) compiles into the episode scan instead of
+running eagerly per agent per slot.
+
+Exploration starts at epsilon = 1.0: the reference instantiates
+``ActorModel(1)`` (agent.py:304), overriding the class default of 0.1.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from p2pmicrogrid_tpu.config import DQNConfig
+from p2pmicrogrid_tpu.models.networks import QNetwork
+from p2pmicrogrid_tpu.models.replay import (
+    ReplayState,
+    replay_add,
+    replay_init,
+    replay_sample,
+)
+
+ACTION_VALUES = jnp.asarray([0.0, 0.5, 1.0])  # rl.py:153
+OBS_DIM = 4
+
+
+class DQNState(NamedTuple):
+    """Learner state for all agents (every leaf has leading agent axis except
+    epsilon, shared as in the reference's identical per-agent schedules)."""
+
+    online: dict
+    target: dict
+    opt_state: tuple
+    replay: ReplayState
+    epsilon: jnp.ndarray
+
+
+def _make_optimizer(cfg: DQNConfig) -> optax.GradientTransformation:
+    return optax.adam(cfg.learning_rate)
+
+
+def dqn_init(cfg: DQNConfig, n_agents: int, key: jax.Array) -> DQNState:
+    """Independent per-agent networks (vmapped init over split keys)."""
+    net = QNetwork(hidden=cfg.hidden)
+    dummy_s = jnp.zeros((1, OBS_DIM))
+    dummy_a = jnp.zeros((1, 1))
+
+    def init_one(k):
+        k_on, k_tg = jax.random.split(k)
+        return (
+            net.init(k_on, dummy_s, dummy_a)["params"],
+            net.init(k_tg, dummy_s, dummy_a)["params"],
+        )
+
+    online, target = jax.vmap(init_one)(jax.random.split(key, n_agents))
+    opt_state = jax.vmap(_make_optimizer(cfg).init)(online)
+    return DQNState(
+        online=online,
+        target=target,
+        opt_state=opt_state,
+        replay=replay_init(n_agents, cfg.buffer_size, OBS_DIM, 1),
+        epsilon=jnp.asarray(cfg.epsilon, dtype=jnp.float32),
+    )
+
+
+def _q_all_actions(cfg: DQNConfig, params, obs: jnp.ndarray) -> jnp.ndarray:
+    """Q-values of the 3 discrete actions for each agent.
+
+    params: per-agent pytree [A, ...]; obs: [A, 4] -> [A, 3].
+    (The action-enumeration argmax of rl.py:186-194.)
+    """
+    net = QNetwork(hidden=cfg.hidden)
+
+    def one(p, o):
+        s = jnp.broadcast_to(o, (ACTION_VALUES.shape[0], OBS_DIM))
+        a = ACTION_VALUES[:, None]
+        return net.apply({"params": p}, s, a)[:, 0]
+
+    return jax.vmap(one)(params, obs)
+
+
+def dqn_act(
+    cfg: DQNConfig,
+    state: DQNState,
+    obs: jnp.ndarray,
+    key: jax.Array,
+    explore: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-agent epsilon-greedy over the 3 enumerated actions (rl.py:173-194).
+
+    Returns (action, q): action [A] int32 index into ACTION_VALUES; q [A]
+    greedy Q (0 on explored slots, rl.py:184).
+    """
+    q = _q_all_actions(cfg, state.online, obs)
+    greedy = jnp.argmax(q, axis=-1).astype(jnp.int32)
+    greedy_q = jnp.take_along_axis(q, greedy[:, None], axis=-1)[:, 0]
+
+    if not explore:
+        return greedy, greedy_q
+
+    n_agents = obs.shape[0]
+    k_mask, k_rand = jax.random.split(key)
+    rand_action = jax.random.randint(k_rand, (n_agents,), 0, ACTION_VALUES.shape[0], dtype=jnp.int32)
+    explore_mask = jax.random.uniform(k_mask, (n_agents,)) < state.epsilon
+    action = jnp.where(explore_mask, rand_action, greedy)
+    q_out = jnp.where(explore_mask, 0.0, greedy_q)
+    return action, q_out
+
+
+def _td_loss(cfg: DQNConfig, net: QNetwork, params, target_params, s, a, r, ns):
+    """TD(0) loss against the target net's action-enumerated max
+    (rl.py:308-326). No terminal masking: reference episodes have none."""
+    b = s.shape[0]
+
+    def q_target_for(action_value):
+        act = jnp.full((b, 1), action_value)
+        return net.apply({"params": target_params}, ns, act)[:, 0]
+
+    q_max = jnp.max(
+        jnp.stack([q_target_for(v) for v in ACTION_VALUES.tolist()], axis=0), axis=0
+    )
+    q_target = r + cfg.gamma * q_max
+    q_value = net.apply({"params": params}, s, a)[:, 0]
+    return jnp.mean(jnp.square(q_target - q_value))
+
+
+def _clip_first_layer(cfg: DQNConfig, grads: dict) -> dict:
+    """The reference clips only the first layer's kernel gradient to [-1, 1]
+    (``dl_dw[0]``, rl.py:328-329)."""
+    c = cfg.grad_clip_first_layer
+    first = grads["Dense_0"]["kernel"]
+    grads = dict(grads)
+    grads["Dense_0"] = dict(grads["Dense_0"], kernel=jnp.clip(first, -c, c))
+    return grads
+
+
+def dqn_update(
+    cfg: DQNConfig,
+    state: DQNState,
+    obs: jnp.ndarray,
+    action: jnp.ndarray,
+    reward: jnp.ndarray,
+    next_obs: jnp.ndarray,
+    key: jax.Array,
+) -> Tuple[DQNState, jnp.ndarray]:
+    """One per-slot learn step for every agent (agent.py:338-342 →
+    rl.py:299-333): add transition, sample a batch, TD gradient step with
+    first-layer clip, soft-update targets.
+
+    obs/next_obs: [A, 4]; action: [A] int32 index; reward: [A].
+    Returns (new_state, loss [A]).
+    """
+    act_frac = ACTION_VALUES[action][:, None]
+    replay = replay_add(state.replay, obs, act_frac, reward, next_obs)
+    s, a, r, ns = replay_sample(replay, key, cfg.batch_size)
+
+    net = QNetwork(hidden=cfg.hidden)
+    opt = _make_optimizer(cfg)
+
+    def learn_one(params, target_params, opt_state, s, a, r, ns):
+        loss, grads = jax.value_and_grad(
+            lambda p: _td_loss(cfg, net, p, target_params, s, a, r, ns)
+        )(params)
+        grads = _clip_first_layer(cfg, grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # Polyak soft update (rl.py:335-359), tau = cfg.tau.
+        target_params = jax.tree_util.tree_map(
+            lambda t, o: (1.0 - cfg.tau) * t + cfg.tau * o, target_params, params
+        )
+        return params, target_params, opt_state, loss
+
+    online, target, opt_state, loss = jax.vmap(learn_one)(
+        state.online, state.target, state.opt_state, s, a, r, ns
+    )
+    return (
+        state._replace(online=online, target=target, opt_state=opt_state, replay=replay),
+        loss,
+    )
+
+
+def dqn_initialize_target(state: DQNState) -> DQNState:
+    """Hard copy online -> target after buffer warmup (rl.py:272-276,
+    community.py:146-147)."""
+    return state._replace(target=jax.tree_util.tree_map(lambda x: x, state.online))
+
+
+def dqn_decay(cfg: DQNConfig, state: DQNState) -> DQNState:
+    """Exploration decay, no floor (rl.py:196-197)."""
+    return state._replace(epsilon=cfg.epsilon_decay * state.epsilon)

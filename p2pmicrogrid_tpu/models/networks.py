@@ -1,0 +1,55 @@
+"""Flax network definitions shared by the value-based and actor-critic learners.
+
+TPU-native equivalents of the reference's Keras models: the 64-64-1
+state-action Q-network (rl.py:135-148) and the actor/critic pair whose
+capability the stale ``rl_backup.py`` represents (LSTM actor/critic + OU noise,
+rl_backup.py:14-62) — re-designed as feed-forward MLPs over the 4-feature
+observation (the reference's own DQN path is feed-forward too; its episodes
+are 96 independent slots, so recurrence buys nothing and costs scan
+serialization on the MXU).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class QNetwork(nn.Module):
+    """State-action value net: concat(state, action) -> Dense64-Dense64-Dense1
+    (rl.py:139-148)."""
+
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, state: jnp.ndarray, action: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.concatenate([state, action], axis=-1)
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(1)(x)
+
+
+class Actor(nn.Module):
+    """Deterministic policy: state -> heat-pump power fraction in [0, 1]
+    (sigmoid head, rl_backup.py:23-27)."""
+
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, state: jnp.ndarray) -> jnp.ndarray:
+        x = nn.relu(nn.Dense(self.hidden)(state))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.sigmoid(nn.Dense(1)(x))
+
+
+class Critic(nn.Module):
+    """Q(s, a) critic for the continuous-action learner (rl_backup.py:39-62)."""
+
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, state: jnp.ndarray, action: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.concatenate([state, action], axis=-1)
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(1)(x)

@@ -1,0 +1,124 @@
+"""Batched tabular Q-learning.
+
+TPU-native equivalent of the reference's ``QActor`` (microgrid/rl.py:56-132):
+one actor per agent, each owning a 20^4 x 3 Q-table. Here the whole community's
+tables are a single ``[A, nt, ntemp, nb, np2p, n_actions]`` array; action
+selection and the Bellman update are pure functions gathered/scattered along
+the agent axis, so they vmap over scenarios and jit into the episode scan.
+
+Because tables are per-agent (leading axis), the scatter-update exactly matches
+the reference's sequential per-agent semantics — no cross-agent collisions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import QLearningConfig
+from p2pmicrogrid_tpu.ops.obs import discretize
+
+
+class TabularState(NamedTuple):
+    """Learner state for all agents.
+
+    q_table: [A, nt, ntemp, nb, np2p, n_actions] float32
+    epsilon: scalar float32 — shared exploration schedule (every reference
+        agent decays its own epsilon identically, community.py:283-285).
+    """
+
+    q_table: jnp.ndarray
+    epsilon: jnp.ndarray
+
+
+def tabular_init(cfg: QLearningConfig, n_agents: int) -> TabularState:
+    """Zero tables (rl.py:73-74), initial epsilon (agent.py:264)."""
+    shape = (
+        n_agents,
+        cfg.num_time_states,
+        cfg.num_temp_states,
+        cfg.num_balance_states,
+        cfg.num_p2p_states,
+        cfg.num_actions,
+    )
+    return TabularState(
+        q_table=jnp.zeros(shape, dtype=jnp.float32),
+        epsilon=jnp.asarray(cfg.epsilon, dtype=jnp.float32),
+    )
+
+
+def _q_rows(cfg: QLearningConfig, q_table: jnp.ndarray, obs: jnp.ndarray) -> jnp.ndarray:
+    """Gather each agent's Q-row for its discretized observation.
+
+    q_table: [A, ...states..., n_actions]; obs: [A, 4] -> [A, n_actions].
+    """
+    ti, tpi, bi, pi = discretize(cfg, obs)
+    a_idx = jnp.arange(q_table.shape[0])
+    return q_table[a_idx, ti, tpi, bi, pi, :]
+
+
+def tabular_act(
+    cfg: QLearningConfig,
+    state: TabularState,
+    obs: jnp.ndarray,
+    key: jax.Array,
+    explore: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-agent epsilon-greedy action (rl.py:100-117).
+
+    Args:
+        obs: [A, 4] observations.
+        explore: static — False gives pure greedy (eval path,
+            agent.py:277-289).
+
+    Returns:
+        (action, q): action [A] int32 index into ACTIONS; q [A] the greedy
+        Q-value (0 for explored slots, matching rl.py:111's convention).
+    """
+    rows = _q_rows(cfg, state.q_table, obs)
+    greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+    greedy_q = jnp.take_along_axis(rows, greedy[:, None], axis=-1)[:, 0]
+
+    if not explore:
+        return greedy, greedy_q
+
+    n_agents = obs.shape[0]
+    k_mask, k_rand = jax.random.split(key)
+    rand_action = jax.random.randint(k_rand, (n_agents,), 0, cfg.num_actions, dtype=jnp.int32)
+    explore_mask = jax.random.uniform(k_mask, (n_agents,)) < state.epsilon
+
+    action = jnp.where(explore_mask, rand_action, greedy)
+    q = jnp.where(explore_mask, 0.0, greedy_q)
+    return action, q
+
+
+def tabular_update(
+    cfg: QLearningConfig,
+    state: TabularState,
+    obs: jnp.ndarray,
+    action: jnp.ndarray,
+    reward: jnp.ndarray,
+    next_obs: jnp.ndarray,
+) -> TabularState:
+    """Per-agent Bellman update (rl.py:119-129).
+
+    obs/next_obs: [A, 4]; action: [A] int32; reward: [A].
+    """
+    ti, tpi, bi, pi = discretize(cfg, obs)
+    a_idx = jnp.arange(state.q_table.shape[0])
+
+    q_sa = state.q_table[a_idx, ti, tpi, bi, pi, action]
+    q_next_max = jnp.max(_q_rows(cfg, state.q_table, next_obs), axis=-1)
+
+    td = reward + cfg.gamma * q_next_max - q_sa
+    q_table = state.q_table.at[a_idx, ti, tpi, bi, pi, action].add(cfg.alpha * td)
+    return state._replace(q_table=q_table)
+
+
+def tabular_decay(cfg: QLearningConfig, state: TabularState) -> TabularState:
+    """Exploration decay with floor (rl.py:131-132)."""
+    return state._replace(
+        epsilon=jnp.maximum(cfg.epsilon_floor, cfg.epsilon_decay * state.epsilon)
+    )

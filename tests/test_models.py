@@ -1,0 +1,264 @@
+"""Model-layer tests: batched tabular Q, replay ring, DQN, DDPG.
+
+Oracles follow SURVEY.md section 4: closed-form pieces are checked against
+hand-computed values; batched/vmapped paths are checked against a sequential
+NumPy re-derivation of the reference semantics (rl.py:89-129).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import DDPGConfig, DQNConfig, QLearningConfig
+from p2pmicrogrid_tpu.models import (
+    ddpg_act,
+    ddpg_init,
+    ddpg_update,
+    dqn_act,
+    dqn_decay,
+    dqn_init,
+    dqn_initialize_target,
+    dqn_update,
+    replay_add,
+    replay_init,
+    replay_sample,
+    tabular_act,
+    tabular_decay,
+    tabular_init,
+    tabular_update,
+)
+from p2pmicrogrid_tpu.ops.obs import discretize
+
+
+class TestTabular:
+    def test_init_shape(self):
+        cfg = QLearningConfig()
+        st = tabular_init(cfg, n_agents=3)
+        assert st.q_table.shape == (3, 20, 20, 20, 20, 3)
+        assert float(st.epsilon) == pytest.approx(0.81)
+
+    def test_greedy_action_picks_argmax(self):
+        cfg = QLearningConfig()
+        st = tabular_init(cfg, n_agents=2)
+        obs = jnp.array([[0.5, 0.0, 0.0, 0.0], [0.5, 0.0, 0.0, 0.0]])
+        ti, tpi, bi, pi = discretize(cfg, obs)
+        # Plant a known best action per agent.
+        q = st.q_table
+        q = q.at[0, ti[0], tpi[0], bi[0], pi[0], 2].set(5.0)
+        q = q.at[1, ti[1], tpi[1], bi[1], pi[1], 1].set(7.0)
+        st = st._replace(q_table=q)
+
+        action, qv = tabular_act(cfg, st, obs, jax.random.PRNGKey(0), explore=False)
+        assert action.tolist() == [2, 1]
+        assert qv.tolist() == [5.0, 7.0]
+
+    def test_bellman_update_matches_hand_value(self):
+        # One agent, alpha/gamma made large so the delta is visible.
+        cfg = QLearningConfig(alpha=0.5, gamma=0.9)
+        st = tabular_init(cfg, n_agents=1)
+        obs = jnp.array([[0.0, 0.0, 0.0, 0.0]])
+        next_obs = jnp.array([[0.99, 0.0, 0.0, 0.0]])
+        ti, tpi, bi, pi = discretize(cfg, obs)
+        nti, ntpi, nbi, npi = discretize(cfg, next_obs)
+
+        q = st.q_table.at[0, nti[0], ntpi[0], nbi[0], npi[0], 1].set(2.0)
+        st = st._replace(q_table=q)
+
+        st2 = tabular_update(
+            cfg, st, obs, jnp.array([0]), jnp.array([-1.0]), next_obs
+        )
+        # q <- 0 + 0.5 * (-1 + 0.9*2 - 0) = 0.4
+        got = st2.q_table[0, ti[0], tpi[0], bi[0], pi[0], 0]
+        assert float(got) == pytest.approx(0.4)
+
+    def test_update_is_per_agent_isolated(self):
+        cfg = QLearningConfig(alpha=1.0)
+        st = tabular_init(cfg, n_agents=2)
+        obs = jnp.zeros((2, 4))
+        st2 = tabular_update(
+            cfg, st, obs, jnp.array([0, 0]), jnp.array([1.0, 0.0]), obs
+        )
+        # Agent 1 had zero reward and zero table: no change anywhere in its table.
+        assert float(jnp.abs(st2.q_table[1]).max()) == 0.0
+        assert float(jnp.abs(st2.q_table[0]).max()) > 0.0
+
+    def test_epsilon_decay_floor(self):
+        cfg = QLearningConfig()
+        st = tabular_init(cfg, 1)._replace(epsilon=jnp.asarray(0.105))
+        st = tabular_decay(cfg, st)
+        assert float(st.epsilon) == pytest.approx(0.1)  # floor (rl.py:132)
+
+    def test_explore_rate_statistical(self):
+        cfg = QLearningConfig()
+        st = tabular_init(cfg, n_agents=1000)._replace(epsilon=jnp.asarray(0.5))
+        obs = jnp.zeros((1000, 4))
+        # All-zero tables: greedy is action 0; explored slots uniform over 3.
+        action, _ = tabular_act(cfg, st, obs, jax.random.PRNGKey(1), explore=True)
+        frac_nonzero = float(jnp.mean(action != 0))
+        # P(action != 0) = eps * 2/3 = 1/3.
+        assert 0.25 < frac_nonzero < 0.42
+
+
+class TestReplay:
+    def test_ring_wraps(self):
+        st = replay_init(n_agents=2, capacity=3)
+        for i in range(5):
+            st = replay_add(
+                st,
+                jnp.full((2, 4), float(i)),
+                jnp.full((2, 1), float(i)),
+                jnp.full((2,), float(i)),
+                jnp.full((2, 4), float(i + 10)),
+            )
+        assert int(st.count) == 3
+        assert int(st.cursor) == 2  # 5 mod 3
+        # Slot 0 and 1 hold the two newest writes (3, 4); slot 2 holds 2.
+        assert st.reward[:, 0].tolist() == [3.0, 3.0]
+        assert st.reward[:, 1].tolist() == [4.0, 4.0]
+        assert st.reward[:, 2].tolist() == [2.0, 2.0]
+
+    def test_sample_only_filled_region(self):
+        st = replay_init(n_agents=1, capacity=100)
+        for i in range(4):
+            st = replay_add(
+                st,
+                jnp.zeros((1, 4)),
+                jnp.zeros((1, 1)),
+                jnp.full((1,), float(i + 1)),
+                jnp.zeros((1, 4)),
+            )
+        _, _, r, _ = replay_sample(st, jax.random.PRNGKey(0), batch_size=64)
+        assert float(r.min()) >= 1.0  # never samples the zeroed tail
+
+    def test_sample_shapes(self):
+        st = replay_init(n_agents=3, capacity=10)
+        st = replay_add(
+            st, jnp.zeros((3, 4)), jnp.zeros((3, 1)), jnp.zeros((3,)), jnp.zeros((3, 4))
+        )
+        s, a, r, ns = replay_sample(st, jax.random.PRNGKey(0), batch_size=8)
+        assert s.shape == (3, 8, 4)
+        assert a.shape == (3, 8, 1)
+        assert r.shape == (3, 8)
+        assert ns.shape == (3, 8, 4)
+
+
+class TestDQN:
+    def setup_method(self):
+        self.cfg = DQNConfig(buffer_size=64, batch_size=8)
+        self.st = dqn_init(self.cfg, n_agents=2, key=jax.random.PRNGKey(0))
+
+    def test_init_epsilon_is_one(self):
+        # agent.py:304 — ActorModel(1), not the 0.1 class default.
+        assert float(self.st.epsilon) == 1.0
+
+    def test_act_shapes_and_range(self):
+        obs = jnp.zeros((2, 4))
+        action, q = dqn_act(self.cfg, self.st, obs, jax.random.PRNGKey(1), explore=False)
+        assert action.shape == (2,)
+        assert q.shape == (2,)
+        assert set(np.asarray(action).tolist()) <= {0, 1, 2}
+
+    def test_agents_have_independent_params(self):
+        k0 = self.st.online["Dense_0"]["kernel"]
+        assert not np.allclose(np.asarray(k0[0]), np.asarray(k0[1]))
+
+    def test_update_moves_online_and_target(self):
+        obs = jnp.ones((2, 4)) * 0.1
+        st2, loss = dqn_update(
+            self.cfg,
+            self.st,
+            obs,
+            jnp.array([1, 2]),
+            jnp.array([-1.0, -2.0]),
+            obs,
+            jax.random.PRNGKey(2),
+        )
+        assert loss.shape == (2,)
+        d_on = np.abs(
+            np.asarray(st2.online["Dense_0"]["kernel"])
+            - np.asarray(self.st.online["Dense_0"]["kernel"])
+        ).max()
+        assert d_on > 0
+        # Polyak pulls target toward online by factor tau.
+        gap_before = np.abs(
+            np.asarray(self.st.target["Dense_0"]["kernel"])
+            - np.asarray(self.st.online["Dense_0"]["kernel"])
+        ).mean()
+        gap_after = np.abs(
+            np.asarray(st2.target["Dense_0"]["kernel"])
+            - np.asarray(st2.online["Dense_0"]["kernel"])
+        ).mean()
+        assert gap_after < gap_before
+
+    def test_initialize_target_hard_copy(self):
+        st2 = dqn_initialize_target(self.st)
+        np.testing.assert_allclose(
+            np.asarray(st2.target["Dense_0"]["kernel"]),
+            np.asarray(st2.online["Dense_0"]["kernel"]),
+        )
+
+    def test_decay_no_floor(self):
+        st = self.st._replace(epsilon=jnp.asarray(0.01))
+        st = dqn_decay(self.cfg, st)
+        assert float(st.epsilon) == pytest.approx(0.009)
+
+    def test_update_jits(self):
+        obs = jnp.zeros((2, 4))
+        f = jax.jit(
+            lambda st, k: dqn_update(
+                self.cfg, st, obs, jnp.array([0, 1]), jnp.array([0.0, 0.0]), obs, k
+            )
+        )
+        st2, _ = f(self.st, jax.random.PRNGKey(3))
+        assert int(st2.replay.count) == 1
+
+
+class TestDDPG:
+    def setup_method(self):
+        self.cfg = DDPGConfig(buffer_size=64, batch_size=8)
+        self.st = ddpg_init(self.cfg, n_agents=2, key=jax.random.PRNGKey(0))
+
+    def test_act_in_unit_interval(self):
+        obs = jnp.zeros((2, 4))
+        a, q, st = ddpg_act(self.cfg, self.st, obs, jax.random.PRNGKey(1))
+        assert a.shape == (2,)
+        assert float(a.min()) >= 0.0
+        assert float(a.max()) <= 1.0
+
+    def test_ou_noise_evolves(self):
+        obs = jnp.zeros((2, 4))
+        _, _, st = ddpg_act(self.cfg, self.st, obs, jax.random.PRNGKey(1))
+        assert not np.allclose(np.asarray(st.ou_state), np.asarray(self.st.ou_state))
+
+    def test_ou_init_uses_configured_sd(self):
+        # rl_backup.py:81,102 — x0 ~ N(0, ou_init_sd), not zeros.
+        assert not np.allclose(np.asarray(self.st.ou_state), 0.0)
+
+    def test_greedy_does_not_touch_noise(self):
+        obs = jnp.zeros((2, 4))
+        _, _, st = ddpg_act(self.cfg, self.st, obs, jax.random.PRNGKey(1), explore=False)
+        np.testing.assert_allclose(
+            np.asarray(st.ou_state), np.asarray(self.st.ou_state)
+        )
+
+    def test_update_moves_both_nets(self):
+        obs = jnp.ones((2, 4)) * 0.2
+        st2, loss = ddpg_update(
+            self.cfg,
+            self.st,
+            obs,
+            jnp.array([0.3, 0.7]),
+            jnp.array([-1.0, -0.5]),
+            obs,
+            jax.random.PRNGKey(2),
+        )
+        assert loss.shape == (2,)
+        for name, old, new in [
+            ("actor", self.st.actor, st2.actor),
+            ("critic", self.st.critic, st2.critic),
+        ]:
+            delta = np.abs(
+                np.asarray(new["Dense_0"]["kernel"]) - np.asarray(old["Dense_0"]["kernel"])
+            ).max()
+            assert delta > 0, name
