@@ -1,0 +1,32 @@
+"""Community simulator: the reference's runtime layer as pure JAX.
+
+Replaces the object-per-agent eager loop of microgrid/community.py and the
+process-global ``Environment`` singleton (environment.py) with explicit state
+PyTrees and a single ``lax.scan``-able step function.
+"""
+
+from p2pmicrogrid_tpu.envs.community import (
+    AgentRatings,
+    EpisodeArrays,
+    PhysState,
+    Policy,
+    SlotOutputs,
+    build_episode_arrays,
+    init_physical,
+    make_ratings,
+    run_episode,
+    rule_baseline_episode,
+)
+
+__all__ = [
+    "AgentRatings",
+    "EpisodeArrays",
+    "PhysState",
+    "Policy",
+    "SlotOutputs",
+    "build_episode_arrays",
+    "init_physical",
+    "make_ratings",
+    "run_episode",
+    "rule_baseline_episode",
+]

@@ -1,0 +1,423 @@
+"""The P2P community simulator as one pure, scannable step function.
+
+TPU-native re-design of the reference runtime (microgrid/community.py:33-195 +
+environment.py + agent.py's per-agent orchestration): all per-agent state is a
+struct-of-arrays PyTree with a leading agent axis, the multi-round price
+negotiation is an inner ``lax.scan`` of *vmapped* agent decisions, and an
+episode is an outer ``lax.scan`` over time slots. Nothing here touches the
+host: one jitted call runs a full episode including per-slot learning.
+
+Reference semantics preserved exactly (SURVEY.md section 7):
+
+* Within a negotiation round every agent sees the *previous* round's p2p
+  matrix (community.py:75-86) — agents are embarrassingly parallel.
+* The diagonal of the proposal matrix is zeroed at the *start* of each round
+  only; a final-round diagonal residue (from divide_power's equal split)
+  settles with the grid (community.py:76,91).
+* Reward = -(cost + 10 * comfort penalty), penalty offset +1, evaluated at the
+  *pre-step* indoor temperature (agent.py:225-232).
+* The next-state observation reuses the stale (pre-step) indoor temperature
+  and a zero p2p signal (agent.py:293-296, community.py:161) — toggleable via
+  ``SimConfig.stale_next_temp``.
+* Assets advance after learning (community.py:158-170).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.data.traces import TraceSet, agent_profiles, next_slot
+from p2pmicrogrid_tpu.ops.battery import battery_rule_update
+from p2pmicrogrid_tpu.ops.market import (
+    clear_market,
+    compute_costs,
+    divide_power,
+    zero_diagonal,
+)
+from p2pmicrogrid_tpu.ops.obs import make_observation
+from p2pmicrogrid_tpu.ops.tariff import grid_prices, p2p_price as p2p_price_fn
+from p2pmicrogrid_tpu.ops.thermal import (
+    comfort_penalty,
+    normalized_temperature,
+    thermal_step,
+)
+
+
+class Policy(NamedTuple):
+    """A policy as three pure functions (closing over their config).
+
+    act(pol_state, obs [A,4], prev_frac [A], key, explore) ->
+        (hp_frac [A], aux [A], q [A], pol_state)
+        ``aux`` is whatever ``learn`` needs to identify the action (the
+        discrete index for tabular/DQN, the fraction itself for DDPG).
+    learn(pol_state, obs, aux, reward, next_obs, key) -> (pol_state, loss [A])
+    decay(pol_state) -> pol_state   (exploration schedule, community.py:283-285)
+    """
+
+    act: Callable
+    learn: Callable
+    decay: Callable
+
+
+class AgentRatings(NamedTuple):
+    """Static per-agent ratings, [A] each (community.py:210-228)."""
+
+    load_rating_w: np.ndarray
+    pv_rating_w: np.ndarray
+    max_in: np.ndarray
+    max_out: np.ndarray
+
+
+class EpisodeArrays(NamedTuple):
+    """Time-major per-slot inputs for one episode, precomputed on host.
+
+    The ``next_*`` fields implement the reference's np.roll (state, next_state)
+    pairing (dataset.py:98-103): the last slot wraps to the first.
+    """
+
+    time: jnp.ndarray       # [T] normalized slot-of-day
+    t_out: jnp.ndarray      # [T] outdoor temperature [°C]
+    load_w: jnp.ndarray     # [T, A] household load [W]
+    pv_w: jnp.ndarray       # [T, A] PV production [W]
+    next_time: jnp.ndarray  # [T]
+    next_load_w: jnp.ndarray
+    next_pv_w: jnp.ndarray
+
+    @property
+    def n_slots(self) -> int:
+        return self.time.shape[0]
+
+
+class PhysState(NamedTuple):
+    """Physical asset state, [A] each."""
+
+    t_in: jnp.ndarray    # indoor air temperature [°C]
+    t_bm: jnp.ndarray    # building-mass temperature [°C]
+    soc: jnp.ndarray     # battery state of charge in [0, 1]
+    hp_frac: jnp.ndarray  # heat-pump power fraction in [0, 1]
+
+
+class SlotOutputs(NamedTuple):
+    """Per-slot trace recorded by the episode scan (mirrors what the reference
+    logs to SQLite: community.py:341-361, database.py:226-312)."""
+
+    cost: jnp.ndarray       # [A] €
+    reward: jnp.ndarray     # [A]
+    loss: jnp.ndarray       # [A]
+    p_grid: jnp.ndarray     # [A] W
+    p_p2p: jnp.ndarray      # [A] W
+    buy_price: jnp.ndarray  # [] €/kWh
+    injection_price: jnp.ndarray
+    trade_price: jnp.ndarray
+    t_in: jnp.ndarray       # [A] pre-step indoor temperature
+    hp_power_w: jnp.ndarray  # [A] final heat-pump electrical power
+    decisions: jnp.ndarray  # [rounds+1, A] per-round hp power [W] (community.py:88-89)
+    q: jnp.ndarray          # [A] actor value estimate
+
+
+def draw_rating_scales(
+    cfg: ExperimentConfig, rng: np.random.Generator
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-agent load/PV scales in kW: ~N(0.7,0.2)/N(4,0.2), or the means when
+    homogeneous (community.py:210-211; redrawn at eval, community.py:386-391)."""
+    p = cfg.population
+    n = cfg.sim.n_agents
+    if cfg.sim.homogeneous:
+        return np.full(n, p.load_rating_mean), np.full(n, p.pv_rating_mean)
+    return (
+        rng.normal(p.load_rating_mean, p.load_rating_std, n),
+        rng.normal(p.pv_rating_mean, p.pv_rating_std, n),
+    )
+
+
+def make_ratings(cfg: ExperimentConfig, rng: np.random.Generator) -> AgentRatings:
+    """Draw heterogeneous load/PV ratings (community.py:210-228).
+
+    Homogeneous communities pin every agent to the mean (community.py:210-211).
+    ``max_out`` uses the multiplicative form — the reference's
+    ``-(max_power + safety*1e3)`` (community.py:228) is a typo not copied
+    (SURVEY.md section 7).
+    """
+    p = cfg.population
+    load_r, pv_r = draw_rating_scales(cfg, rng)
+    max_power = np.maximum(load_r, pv_r)
+    return AgentRatings(
+        load_rating_w=(load_r * 1e3).astype(np.float32),
+        pv_rating_w=(pv_r * 1e3).astype(np.float32),
+        max_in=(max_power * p.safety * 1e3).astype(np.float32),
+        max_out=(-max_power * p.safety * 1e3).astype(np.float32),
+    )
+
+
+def build_episode_arrays(
+    cfg: ExperimentConfig, traces: TraceSet, ratings: AgentRatings
+) -> EpisodeArrays:
+    """Denormalize per-agent profiles and precompute the next-slot pairing."""
+    load_w, pv_w = agent_profiles(
+        traces,
+        cfg.sim.n_agents,
+        ratings.load_rating_w,
+        ratings.pv_rating_w,
+        homogeneous=cfg.sim.homogeneous,
+    )
+    return EpisodeArrays(
+        time=jnp.asarray(traces.time),
+        t_out=jnp.asarray(traces.t_out),
+        load_w=jnp.asarray(load_w),
+        pv_w=jnp.asarray(pv_w),
+        next_time=jnp.asarray(next_slot(traces.time)),
+        next_load_w=jnp.asarray(next_slot(load_w)),
+        next_pv_w=jnp.asarray(next_slot(pv_w)),
+    )
+
+
+def init_physical(cfg: ExperimentConfig, key: jax.Array) -> PhysState:
+    """Initial temperatures: setpoint exactly (homogeneous) or
+    N(setpoint, 0.3) per agent (heating.py:101-104); battery at init SoC."""
+    n = cfg.sim.n_agents
+    th = cfg.thermal
+    if cfg.sim.homogeneous:
+        t_in = jnp.full((n,), th.setpoint, dtype=jnp.float32)
+        t_bm = jnp.full((n,), th.setpoint, dtype=jnp.float32)
+    else:
+        k1, k2 = jax.random.split(key)
+        t_in = th.setpoint + th.init_temp_std * jax.random.normal(k1, (n,))
+        t_bm = th.setpoint + th.init_temp_std * jax.random.normal(k2, (n,))
+    return PhysState(
+        t_in=t_in,
+        t_bm=t_bm,
+        soc=jnp.full((n,), cfg.battery.init_soc, dtype=jnp.float32),
+        hp_frac=jnp.zeros((n,), dtype=jnp.float32),  # HeatPump(power=0), community.py:226
+    )
+
+
+def _negotiate(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state,
+    phys: PhysState,
+    ratings: AgentRatings,
+    time_norm: jnp.ndarray,
+    balance_w: jnp.ndarray,
+    key: jax.Array,
+    explore: bool,
+):
+    """The multi-round negotiation loop (community.py:75-89).
+
+    Every round: zero the diagonal, let all agents (vmapped) observe the
+    previous round's proposals and re-decide, rebuild the proposal matrix.
+    Returns the final matrix plus the last round's (obs, aux) for learning.
+    """
+    n = cfg.sim.n_agents
+    th = cfg.thermal
+    norm_balance = balance_w / ratings.max_in
+
+    def round_body(carry, round_key):
+        p2p, hp_frac, pol_state = carry
+        p2p = zero_diagonal(p2p)
+
+        # powers seen by agent i = -p2p[:, i]  (community.py:81)
+        powers = -jnp.swapaxes(p2p, -1, -2)
+        p2p_mean = jnp.mean(powers, axis=-1) / ratings.max_in  # agent.py:203
+
+        obs = make_observation(
+            time_norm, normalized_temperature(th, phys.t_in), norm_balance, p2p_mean
+        )
+        hp_frac, aux, q, pol_state = policy.act(
+            pol_state, obs, hp_frac, round_key, explore
+        )
+
+        hp_power = hp_frac * th.hp_max_power
+        p_out = divide_power(balance_w + hp_power, powers)  # [A, A], row i = agent i
+        return (p_out, hp_frac, pol_state), (obs, aux, q, hp_power)
+
+    keys = jax.random.split(key, cfg.sim.rounds + 1)
+    (p2p, hp_frac, pol_state), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
+        round_body,
+        (jnp.zeros((n, n)), phys.hp_frac, pol_state),
+        keys,
+    )
+    # Learning uses the LAST round's observation/action (the reference
+    # overwrites _current_state/_last_action every round, agent.py:200-213).
+    return p2p, hp_frac, pol_state, obs_r[-1], aux_r[-1], q_r[-1], hp_power_r
+
+
+def community_slot(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    carry,
+    xs,
+    training: bool,
+    ratings: AgentRatings,
+):
+    """One 15-minute slot: negotiate -> clear -> settle -> learn -> step assets
+    (community.py:149-170)."""
+    phys, pol_state, key = carry
+    time_norm, t_out, load_w, pv_w, next_time, next_load_w, next_pv_w = xs
+    key, k_round, k_learn = jax.random.split(key, 3)
+
+    buy, inj = grid_prices(cfg.tariff, time_norm)
+    trade = p2p_price_fn(buy, inj)
+
+    balance_w = load_w - pv_w
+    soc = phys.soc
+    if cfg.battery.enabled:
+        # Modelled-but-dormant battery (storage.py, agent.py:138-153) as an
+        # opt-in: greedily absorb/cover the balance before trading.
+        soc, balance_w = battery_rule_update(
+            cfg.battery, soc, balance_w, cfg.sim.dt_seconds
+        )
+
+    p2p, hp_frac, pol_state, obs, aux, q, hp_power_rounds = _negotiate(
+        cfg, policy, pol_state, phys, ratings, time_norm, balance_w, k_round,
+        explore=training,
+    )
+
+    p_grid, p_p2p = clear_market(p2p)
+    cost = compute_costs(p_grid, p_p2p, buy, inj, trade, cfg.sim.slot_hours)
+
+    # Reward at pre-step indoor temperature (agent.py:225-232).
+    penalty = comfort_penalty(cfg.thermal, phys.t_in)
+    reward = -(cost + 10.0 * penalty)
+
+    # Advance thermal state with the final round's heat-pump power and the
+    # current slot's outdoor temperature (heating.py:126-143).
+    hp_power = hp_frac * cfg.thermal.hp_max_power
+    t_in_pre = phys.t_in
+    t_in_new, t_bm_new = thermal_step(
+        cfg.thermal, cfg.sim.dt_seconds, t_out, phys.t_in, phys.t_bm, hp_power
+    )
+
+    loss = jnp.zeros_like(reward)
+    if training:
+        next_temp = phys.t_in if cfg.sim.stale_next_temp else t_in_new
+        next_balance = (next_load_w - next_pv_w) / ratings.max_in
+        next_obs = make_observation(
+            next_time,
+            normalized_temperature(cfg.thermal, next_temp),
+            next_balance,
+            jnp.zeros_like(next_balance),  # zero p2p signal (community.py:161)
+        )
+        pol_state, loss = policy.learn(pol_state, obs, aux, reward, next_obs, k_learn)
+
+    phys = PhysState(t_in=t_in_new, t_bm=t_bm_new, soc=soc, hp_frac=hp_frac)
+    outputs = SlotOutputs(
+        cost=cost,
+        reward=reward,
+        loss=loss,
+        p_grid=p_grid,
+        p_p2p=p_p2p,
+        buy_price=buy,
+        injection_price=inj,
+        trade_price=trade,
+        t_in=t_in_pre,
+        hp_power_w=hp_power,
+        decisions=hp_power_rounds,
+        q=q,
+    )
+    return (phys, pol_state, key), outputs
+
+
+def run_episode(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state,
+    phys: PhysState,
+    arrays: EpisodeArrays,
+    ratings: AgentRatings,
+    key: jax.Array,
+    training: bool = True,
+) -> Tuple[PhysState, object, SlotOutputs]:
+    """One full episode as a single ``lax.scan`` (community.py:149-182 for
+    training, :95-123 for greedy evaluation).
+
+    Returns (final physical state, final policy state, per-slot outputs with a
+    leading time axis).
+    """
+    xs = (
+        arrays.time,
+        arrays.t_out,
+        arrays.load_w,
+        arrays.pv_w,
+        arrays.next_time,
+        arrays.next_load_w,
+        arrays.next_pv_w,
+    )
+    ratings = AgentRatings(*(jnp.asarray(a) for a in ratings))
+
+    def step(carry, x):
+        return community_slot(cfg, policy, carry, x, training, ratings)
+
+    (phys, pol_state, key), outputs = jax.lax.scan(step, (phys, pol_state, key), xs)
+    return phys, pol_state, outputs
+
+
+def rule_baseline_episode(
+    cfg: ExperimentConfig,
+    phys: PhysState,
+    arrays: EpisodeArrays,
+) -> Tuple[PhysState, SlotOutputs]:
+    """Thermostat bang-bang baseline, grid-only settlement.
+
+    The reference's ``RuleAgent`` (agent.py:106-136): heat at full power below
+    the comfort band, off above it, keep the previous command inside the band;
+    the whole balance settles with the grid (its community is the no-trading
+    baseline). Pure scan, no learning, no RNG.
+    """
+    th = cfg.thermal
+
+    def step(carry, x):
+        phys = carry
+        time_norm, t_out, load_w, pv_w = x
+        buy, inj = grid_prices(cfg.tariff, time_norm)
+        trade = p2p_price_fn(buy, inj)
+
+        # Bang-bang thermostat (agent.py:130-136).
+        hp_frac = jnp.where(
+            phys.t_in <= th.lower_bound,
+            1.0,
+            jnp.where(phys.t_in >= th.upper_bound, 0.0, phys.hp_frac),
+        )
+        hp_power = hp_frac * th.hp_max_power
+
+        balance_w = load_w - pv_w
+        soc = phys.soc
+        if cfg.battery.enabled:
+            soc, balance_w = battery_rule_update(
+                cfg.battery, soc, balance_w, cfg.sim.dt_seconds
+            )
+        p_grid = balance_w + hp_power
+        p_p2p = jnp.zeros_like(p_grid)
+
+        cost = compute_costs(p_grid, p_p2p, buy, inj, trade, cfg.sim.slot_hours)
+        penalty = comfort_penalty(th, phys.t_in)
+        reward = -(cost + 10.0 * penalty)
+
+        t_in_new, t_bm_new = thermal_step(
+            th, cfg.sim.dt_seconds, t_out, phys.t_in, phys.t_bm, hp_power
+        )
+        new_phys = PhysState(t_in=t_in_new, t_bm=t_bm_new, soc=soc, hp_frac=hp_frac)
+        out = SlotOutputs(
+            cost=cost,
+            reward=reward,
+            loss=jnp.zeros_like(reward),
+            p_grid=p_grid,
+            p_p2p=p_p2p,
+            buy_price=buy,
+            injection_price=inj,
+            trade_price=trade,
+            t_in=phys.t_in,
+            hp_power_w=hp_power,
+            decisions=hp_power[None, :],
+            q=jnp.zeros_like(reward),
+        )
+        return new_phys, out
+
+    xs = (arrays.time, arrays.t_out, arrays.load_w, arrays.pv_w)
+    phys, outputs = jax.lax.scan(step, phys, xs)
+    return phys, outputs

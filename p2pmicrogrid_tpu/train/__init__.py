@@ -1,0 +1,32 @@
+"""Training layer: policy adapters, episode loops, warmup, checkpointing.
+
+Reference analogues: community.py:248-321 (``main``), :125-147
+(``init_buffers``), :364-412 (``load_and_run``), rl.py:251-359 (``Trainer``),
+setup.py:29-32 (loop knobs).
+"""
+
+from p2pmicrogrid_tpu.train.policies import (
+    make_tabular_policy,
+    make_dqn_policy,
+    make_ddpg_policy,
+    init_policy_state,
+    make_policy,
+)
+from p2pmicrogrid_tpu.train.loop import (
+    TrainResult,
+    train_community,
+    evaluate_community,
+    init_dqn_buffers,
+)
+
+__all__ = [
+    "make_tabular_policy",
+    "make_dqn_policy",
+    "make_ddpg_policy",
+    "init_policy_state",
+    "make_policy",
+    "TrainResult",
+    "train_community",
+    "evaluate_community",
+    "init_dqn_buffers",
+]

@@ -1,0 +1,289 @@
+"""Training and evaluation loops.
+
+Reference analogues: ``community.main``'s episode loop (community.py:272-298),
+``init_buffers`` DQN warmup (community.py:125-147), and ``load_and_run``'s
+per-day greedy evaluation (community.py:364-412).
+
+The TPU-native shape: the entire episode (96 slots x negotiation x learning)
+is one jitted ``lax.scan``; optionally ``episodes_per_jit_block`` episodes are
+fused into a single device call with an outer scan, so the Python loop only
+handles the exploration-decay schedule, metric recording, and checkpoints.
+Evaluation vmaps the per-day runs into one device call.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.data.traces import TraceSet
+from p2pmicrogrid_tpu.envs.community import (
+    AgentRatings,
+    EpisodeArrays,
+    PhysState,
+    Policy,
+    SlotOutputs,
+    build_episode_arrays,
+    draw_rating_scales,
+    init_physical,
+    run_episode,
+)
+from p2pmicrogrid_tpu.models import dqn_initialize_target
+from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES, DQNState
+from p2pmicrogrid_tpu.models.replay import replay_add
+
+
+@dataclass
+class TrainResult:
+    """What ``main`` accumulates: per-episode reward/error plus the periodic
+    training-progress records (community.py:276-296)."""
+
+    pol_state: object
+    phys: PhysState
+    episode_rewards: List[float] = field(default_factory=list)
+    episode_losses: List[float] = field(default_factory=list)
+    progress: List[Tuple[int, float, float]] = field(default_factory=list)
+    train_seconds: float = 0.0
+    env_steps: int = 0
+
+    @property
+    def env_steps_per_sec(self) -> float:
+        return self.env_steps / self.train_seconds if self.train_seconds else 0.0
+
+
+def _episode_metrics(outputs: SlotOutputs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Episode reward = sum over slots of the agent-mean reward
+    (community.py:179); loss = mean (community.py:180)."""
+    return (
+        jnp.sum(jnp.mean(outputs.reward, axis=-1)),
+        jnp.mean(outputs.loss),
+    )
+
+
+def make_train_step(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    arrays: EpisodeArrays,
+    ratings: AgentRatings,
+) -> Callable:
+    """Jitted function running ``episodes_per_jit_block`` training episodes.
+
+    Each episode starts from a freshly drawn physical state (the reference
+    re-randomizes indoor temperatures on every reset, heating.py:145-152) and
+    scans the slots; the block scans the episodes. The exploration-decay
+    schedule (every ``min_episodes_criterion`` episodes, community.py:279-287)
+    runs *inside* the block via ``lax.cond`` keyed on the global episode index,
+    so fused blocks follow the reference schedule exactly.
+    """
+    block = cfg.train.episodes_per_jit_block
+    criterion = cfg.train.min_episodes_criterion
+
+    def one_episode(pol_state, key):
+        k_phys, k_ep = jax.random.split(key)
+        phys = init_physical(cfg, k_phys)
+        phys, pol_state, outputs = run_episode(
+            cfg, policy, pol_state, phys, arrays, ratings, k_ep, training=True
+        )
+        reward, loss = _episode_metrics(outputs)
+        return pol_state, phys, reward, loss
+
+    @jax.jit
+    def train_block(pol_state, episode0, key):
+        keys = jax.random.split(key, block)
+
+        def body(carry, xs):
+            pol_state = carry
+            i, k = xs
+            pol_state, phys, reward, loss = one_episode(pol_state, k)
+            pol_state = jax.lax.cond(
+                (episode0 + i) % criterion == 0, policy.decay, lambda s: s, pol_state
+            )
+            return pol_state, (reward, loss, phys)
+
+        pol_state, (rewards, losses, physes) = jax.lax.scan(
+            body, pol_state, (jnp.arange(block), keys)
+        )
+        last_phys = jax.tree_util.tree_map(lambda x: x[-1], physes)
+        return pol_state, last_phys, rewards, losses
+
+    return train_block
+
+
+def init_dqn_buffers(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state: DQNState,
+    arrays: EpisodeArrays,
+    ratings: AgentRatings,
+    key: jax.Array,
+) -> DQNState:
+    """DQN replay warmup (community.py:125-147): ``warmup_passes`` full
+    epsilon-greedy passes that only *record* transitions (no gradient steps),
+    then a hard online->target copy.
+
+    Implemented by swapping the policy's ``learn`` for a buffer-only write.
+    """
+    def record_only(pol_state, obs, aux, reward, next_obs, _key):
+        act_frac = ACTION_VALUES[aux.astype(jnp.int32)][:, None]
+        replay = replay_add(pol_state.replay, obs, act_frac, reward, next_obs)
+        return pol_state._replace(replay=replay), jnp.zeros_like(reward)
+
+    warmup_policy = Policy(act=policy.act, learn=record_only, decay=policy.decay)
+
+    @jax.jit
+    def one_pass(pol_state, key):
+        k_phys, k_ep = jax.random.split(key)
+        phys = init_physical(cfg, k_phys)
+        _, pol_state, _ = run_episode(
+            cfg, warmup_policy, pol_state, phys, arrays, ratings, k_ep, training=True
+        )
+        return pol_state
+
+    for k in jax.random.split(key, cfg.dqn.warmup_passes):
+        pol_state = one_pass(pol_state, k)
+    return dqn_initialize_target(pol_state)
+
+
+def train_community(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state,
+    traces: TraceSet,
+    ratings: AgentRatings,
+    key: jax.Array,
+    progress_cb: Optional[Callable[[int, float, float], None]] = None,
+    checkpoint_cb: Optional[Callable[[int, object], None]] = None,
+    verbose: bool = False,
+) -> TrainResult:
+    """The reference's training driver (community.py:248-298).
+
+    Every ``min_episodes_criterion`` episodes: decay exploration and emit a
+    running-average progress record (community.py:279-288). Every
+    ``save_episodes`` episodes: invoke the checkpoint callback
+    (community.py:290-292). Returns final states plus metric histories.
+    """
+    t = cfg.train
+    arrays = build_episode_arrays(cfg, traces, ratings)
+
+    if t.implementation == "dqn":
+        key, k_warm = jax.random.split(key)
+        pol_state = init_dqn_buffers(cfg, policy, pol_state, arrays, ratings, k_warm)
+
+    train_block = make_train_step(cfg, policy, arrays, ratings)
+    block = t.episodes_per_jit_block
+
+    result = TrainResult(pol_state=pol_state, phys=None)
+    window_r = collections.deque(maxlen=t.min_episodes_criterion)
+    window_l = collections.deque(maxlen=t.min_episodes_criterion)
+
+    start = _time.time()
+    episode = t.starting_episodes
+    phys = None
+    while episode < t.max_episodes:
+        key, k_block = jax.random.split(key)
+        pol_state, phys, rewards, losses = train_block(
+            pol_state, jnp.asarray(episode), k_block
+        )
+        rewards = np.asarray(rewards)
+        losses = np.asarray(losses)
+
+        for i in range(rewards.shape[0]):
+            window_r.append(float(rewards[i]))
+            window_l.append(float(losses[i]))
+            result.episode_rewards.append(float(rewards[i]))
+            result.episode_losses.append(float(losses[i]))
+            ep = episode + i
+
+            # Exploration decay already happened in-block; emit the progress
+            # record on the same cadence (community.py:279-288).
+            if ep % t.min_episodes_criterion == 0:
+                avg_r = statistics.mean(window_r)
+                avg_l = statistics.mean(window_l)
+                result.progress.append((ep, avg_r, avg_l))
+                if progress_cb:
+                    progress_cb(ep, avg_r, avg_l)
+                if verbose:
+                    print(f"episode {ep}: avg reward {avg_r:.3f}, avg error {avg_l:.3f}")
+
+            # Checkpoints fire at block granularity: mid-block states are not
+            # observable from the host (the fused block is one device call).
+            if (ep + 1) % t.save_episodes == 0 and checkpoint_cb:
+                checkpoint_cb(ep, pol_state)
+
+        episode += block
+
+    # Block until the device is done so the timing is honest.
+    jax.block_until_ready(pol_state)
+    result.train_seconds = _time.time() - start
+    result.env_steps = (episode - t.starting_episodes) * arrays.n_slots
+    result.pol_state = pol_state
+    result.phys = phys
+    return result
+
+
+def evaluate_community(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state,
+    traces: TraceSet,
+    ratings: AgentRatings,
+    key: jax.Array,
+    redraw_profile_scales: bool = True,
+    rng: Optional[np.random.Generator] = None,
+) -> Tuple[np.ndarray, SlotOutputs]:
+    """Greedy per-day evaluation (community.py:364-412): each day runs from a
+    fresh physical state so bad decisions don't propagate (community.py:380).
+
+    All days evaluate in ONE device call (vmap over the day axis) — the
+    reference loops days on the host.
+
+    ``redraw_profile_scales`` mirrors community.py:386-391: at eval time the
+    per-agent load/PV profile scales are re-drawn ~N(0.7,0.2)/N(4,0.2) kW
+    (homogeneous: fixed means), independent of the training ratings.
+
+    Returns (days, outputs) where every SlotOutputs leaf has shape
+    [n_days, slots_per_day, ...].
+    """
+    by_day = traces.split_by_day()
+    days = np.array(sorted(by_day), dtype=np.int32)
+
+    gen = rng if rng is not None else np.random.default_rng(0)
+    day_arrays = []
+    for d in days:
+        day_traces = by_day[int(d)]
+        r = ratings
+        if redraw_profile_scales:
+            load_r, pv_r = draw_rating_scales(cfg, gen)
+            r = AgentRatings(
+                load_rating_w=(load_r * 1e3).astype(np.float32),
+                pv_rating_w=(pv_r * 1e3).astype(np.float32),
+                max_in=ratings.max_in,
+                max_out=ratings.max_out,
+            )
+        day_arrays.append(build_episode_arrays(cfg, day_traces, r))
+
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *day_arrays)
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+
+    @jax.jit
+    def eval_all(pol_state, keys):
+        def one_day(arrays, k):
+            phys = init_physical(cfg, k)
+            _, _, outputs = run_episode(
+                cfg, policy, pol_state, phys, arrays, ratings_j, k, training=False
+            )
+            return outputs
+
+        return jax.vmap(one_day)(stacked, keys)
+
+    keys = jax.random.split(key, len(days))
+    outputs = eval_all(pol_state, keys)
+    return days, outputs

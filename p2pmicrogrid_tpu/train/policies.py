@@ -1,0 +1,110 @@
+"""Adapters from the models layer to the env's ``Policy`` interface.
+
+The reference binds actors to agents by subclassing (``QAgent``/``DQNAgent``
+wrap ``QActor``/``ActorModel`` + ``Trainer``, agent.py:255-350). Here a policy
+is three pure closures over the experiment config; the policy *state* is the
+corresponding model NamedTuple, selected by ``TrainConfig.implementation``
+exactly like the reference's ``setup.implementation`` switch
+(community.py:241-245).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.envs.community import Policy
+from p2pmicrogrid_tpu.models import (
+    ddpg_act,
+    ddpg_init,
+    ddpg_update,
+    dqn_act,
+    dqn_decay,
+    dqn_init,
+    dqn_update,
+    tabular_act,
+    tabular_decay,
+    tabular_init,
+    tabular_update,
+)
+from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES
+
+
+def make_tabular_policy(cfg: ExperimentConfig) -> Policy:
+    """Tabular Q-learning (QAgent, agent.py:255-298)."""
+    q = cfg.qlearning
+
+    def act(pol_state, obs, prev_frac, key, explore):
+        action, qv = tabular_act(q, pol_state, obs, key, explore)
+        return ACTION_VALUES[action], action.astype(jnp.float32), qv, pol_state
+
+    def learn(pol_state, obs, aux, reward, next_obs, key):
+        pol_state = tabular_update(
+            q, pol_state, obs, aux.astype(jnp.int32), reward, next_obs
+        )
+        return pol_state, jnp.zeros_like(reward)  # QAgent.train returns 0 loss
+
+    return Policy(act=act, learn=learn, decay=lambda s: tabular_decay(q, s))
+
+
+def make_dqn_policy(cfg: ExperimentConfig) -> Policy:
+    """Per-agent DQN (DQNAgent, agent.py:301-342)."""
+    d = cfg.dqn
+
+    def act(pol_state, obs, prev_frac, key, explore):
+        action, qv = dqn_act(d, pol_state, obs, key, explore)
+        return ACTION_VALUES[action], action.astype(jnp.float32), qv, pol_state
+
+    def learn(pol_state, obs, aux, reward, next_obs, key):
+        return dqn_update(
+            d, pol_state, obs, aux.astype(jnp.int32), reward, next_obs, key
+        )
+
+    return Policy(act=act, learn=learn, decay=lambda s: dqn_decay(d, s))
+
+
+def make_ddpg_policy(cfg: ExperimentConfig) -> Policy:
+    """Continuous-action actor-critic (capability of rl_backup.py)."""
+    d = cfg.ddpg
+
+    def act(pol_state, obs, prev_frac, key, explore):
+        frac, qv, pol_state = ddpg_act(d, pol_state, obs, key, explore)
+        return frac, frac, qv, pol_state
+
+    def learn(pol_state, obs, aux, reward, next_obs, key):
+        return ddpg_update(d, pol_state, obs, aux, reward, next_obs, key)
+
+    return Policy(act=act, learn=learn, decay=lambda s: s)
+
+
+_FACTORIES = {
+    "tabular": make_tabular_policy,
+    "dqn": make_dqn_policy,
+    "ddpg": make_ddpg_policy,
+}
+
+
+def make_policy(cfg: ExperimentConfig) -> Policy:
+    """Select by ``TrainConfig.implementation`` (setup.py:36,
+    community.py:241-245)."""
+    try:
+        return _FACTORIES[cfg.train.implementation](cfg)
+    except KeyError:
+        raise ValueError(
+            f"unknown implementation {cfg.train.implementation!r}; "
+            f"expected one of {sorted(_FACTORIES)}"
+        ) from None
+
+
+def init_policy_state(cfg: ExperimentConfig, key: jax.Array):
+    """Fresh learner state for the configured implementation."""
+    impl = cfg.train.implementation
+    n = cfg.sim.n_agents
+    if impl == "tabular":
+        return tabular_init(cfg.qlearning, n)
+    if impl == "dqn":
+        return dqn_init(cfg.dqn, n, key)
+    if impl == "ddpg":
+        return ddpg_init(cfg.ddpg, n, key)
+    raise ValueError(f"unknown implementation {impl!r}")

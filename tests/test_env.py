@@ -1,0 +1,288 @@
+"""Community-environment tests.
+
+The load-bearing test is the negotiation equivalence: the vmapped/scanned
+negotiation + clearing is replayed against a sequential NumPy re-derivation of
+the reference's per-agent loop (community.py:67-93, agent.py:186-213) with a
+planted greedy Q-table, slot by slot.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    QLearningConfig,
+    SimConfig,
+    TrainConfig,
+    DQNConfig,
+    DDPGConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.data import synthetic_traces
+from p2pmicrogrid_tpu.envs import (
+    build_episode_arrays,
+    init_physical,
+    make_ratings,
+    rule_baseline_episode,
+    run_episode,
+)
+from p2pmicrogrid_tpu.models import tabular_init
+from p2pmicrogrid_tpu.train import (
+    evaluate_community,
+    init_policy_state,
+    make_policy,
+    train_community,
+)
+
+
+def small_cfg(impl="tabular", **sim_kw):
+    sim = SimConfig(n_agents=2, **sim_kw)
+    return default_config(
+        sim=sim,
+        train=TrainConfig(
+            max_episodes=2, min_episodes_criterion=1, implementation=impl
+        ),
+        dqn=DQNConfig(buffer_size=200, warmup_passes=1),
+        ddpg=DDPGConfig(buffer_size=200, batch_size=16),
+    )
+
+
+@pytest.fixture(scope="module")
+def day_traces():
+    return synthetic_traces(n_days=1, start_day=11).normalized()
+
+
+class TestRuleBaseline:
+    def test_comfort_band_held(self, day_traces):
+        cfg = small_cfg()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+        _, out = rule_baseline_episode(cfg, phys, arrays)
+        # Bang-bang with 15-min steps overshoots slightly but stays near band.
+        assert float(out.t_in.min()) > 18.5
+        assert float(out.t_in.max()) < 23.5
+        assert out.cost.shape == (96, 2)
+
+    def test_no_p2p_power(self, day_traces):
+        cfg = small_cfg()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+        _, out = rule_baseline_episode(cfg, phys, arrays)
+        np.testing.assert_allclose(np.asarray(out.p_p2p), 0.0)
+
+
+class TestEpisode:
+    def test_shapes_and_determinism(self, day_traces):
+        cfg = small_cfg()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+
+        run = jax.jit(
+            lambda ps, ph, k: run_episode(
+                cfg, policy, ps, ph, arrays, ratings, k, training=True
+            )
+        )
+        _, ps1, out1 = run(ps, phys, jax.random.PRNGKey(7))
+        _, ps2, out2 = run(ps, phys, jax.random.PRNGKey(7))
+
+        assert out1.reward.shape == (96, 2)
+        assert out1.decisions.shape == (96, cfg.sim.rounds + 1, 2)
+        np.testing.assert_array_equal(np.asarray(out1.reward), np.asarray(out2.reward))
+        np.testing.assert_array_equal(
+            np.asarray(ps1.q_table), np.asarray(ps2.q_table)
+        )
+
+    def test_learning_changes_qtable(self, day_traces):
+        cfg = small_cfg()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+        _, ps2, _ = run_episode(
+            cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7), training=True
+        )
+        assert float(jnp.abs(ps2.q_table - ps.q_table).max()) > 0.0
+
+    def test_eval_does_not_learn(self, day_traces):
+        cfg = small_cfg()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+        _, ps2, _ = run_episode(
+            cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7), training=False
+        )
+        np.testing.assert_array_equal(np.asarray(ps.q_table), np.asarray(ps2.q_table))
+
+    def test_power_balance_conservation(self, day_traces):
+        """Matched P2P power sums to zero across the community: what one agent
+        buys peer-to-peer another sold (clear_market antisymmetry)."""
+        cfg = small_cfg()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        # Plant a random table so actions/powers are non-trivial.
+        ps = ps._replace(
+            q_table=jax.random.normal(jax.random.PRNGKey(5), ps.q_table.shape)
+        )
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+        _, _, out = run_episode(
+            cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7), training=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.p_p2p.sum(axis=-1)), 0.0, atol=1e-3
+        )
+
+
+class TestNegotiationEquivalence:
+    """Vectorized negotiation vs a sequential NumPy replay of the reference's
+    agent loop (community.py:75-93, agent.py:178-213, rl.py:89-117 greedy)."""
+
+    def _numpy_reference_slot(self, cfg, qcfg, q_table, ratings, phys_tin, time_norm,
+                              balance_w, rounds):
+        A = balance_w.shape[0]
+        hp_max = cfg.thermal.hp_max_power
+        setp, marg = cfg.thermal.setpoint, cfg.thermal.margin
+        actions = np.array([0.0, 0.5, 1.0])
+        hp_frac = np.zeros(A)
+        p2p = np.zeros((A, A))
+
+        def discretize(obs):
+            t = int(np.clip(int(obs[0] * qcfg.num_time_states), 0, qcfg.num_time_states - 1))
+            tp = int(np.clip(int((obs[1] + 1) / 2 * (qcfg.num_temp_states - 2) + 1), 0, qcfg.num_temp_states - 1))
+            b = int(np.clip(int((obs[2] + 1) / 2 * qcfg.num_balance_states), 0, qcfg.num_balance_states - 1))
+            p = int(np.clip(int((obs[3] + 1) / 2 * qcfg.num_p2p_states), 0, qcfg.num_p2p_states - 1))
+            return t, tp, b, p
+
+        for r in range(rounds + 1):
+            np.fill_diagonal(p2p, 0.0)
+            new_rows = np.zeros((A, A))
+            for i in range(A):
+                powers = -p2p[:, i]
+                p2p_mean = powers.mean() / ratings.max_in[i]
+                norm_temp = (phys_tin[i] - setp) / marg
+                obs = np.array([time_norm, norm_temp, balance_w[i] / ratings.max_in[i], p2p_mean])
+                ti, tpi, bi, pi = discretize(obs)
+                a = int(np.argmax(q_table[i, ti, tpi, bi, pi]))
+                hp_frac[i] = actions[a]
+                out = balance_w[i] + hp_frac[i] * hp_max
+                filtered = np.where(np.sign(out) != np.sign(powers), powers, 0.0)
+                total = abs(filtered.sum())
+                if total == 0.0:
+                    p_out = out * np.ones(A) / A
+                else:
+                    p_out = out * np.abs(filtered) / total
+                new_rows[i] = p_out
+            p2p = new_rows
+
+        p2p_t = p2p.T
+        p_match = np.where(np.sign(p2p) != np.sign(p2p_t), p2p, 0.0)
+        exchange = np.sign(p_match) * np.minimum(np.abs(p_match), np.abs(p_match).T)
+        p_grid = (p2p - exchange).sum(axis=1)
+        p_p2p = exchange.sum(axis=1)
+        return p_grid, p_p2p, hp_frac
+
+    @pytest.mark.parametrize("rounds", [0, 1, 2])
+    @pytest.mark.parametrize("n_agents", [2, 3, 5])
+    def test_matches_sequential_reference(self, day_traces, rounds, n_agents):
+        cfg = small_cfg(rounds=rounds)
+        cfg = cfg.replace(sim=SimConfig(n_agents=n_agents, rounds=rounds))
+        qcfg = cfg.qlearning
+        rng = np.random.default_rng(3)
+        ratings = make_ratings(cfg, rng)
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        policy = make_policy(cfg)
+
+        ps = tabular_init(qcfg, n_agents)
+        ps = ps._replace(
+            q_table=jax.random.normal(jax.random.PRNGKey(5), ps.q_table.shape)
+        )
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+
+        _, _, out = run_episode(
+            cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7),
+            training=False,
+        )
+
+        # Replay slots 0..4 sequentially; thermal state must be advanced the
+        # same way between slots.
+        q_np = np.asarray(ps.q_table)
+        t_in = np.asarray(phys.t_in).copy()
+        t_bm = np.asarray(phys.t_bm).copy()
+        from p2pmicrogrid_tpu.ops.thermal import thermal_step
+
+        for t in range(5):
+            balance_w = np.asarray(arrays.load_w[t] - arrays.pv_w[t])
+            p_grid, p_p2p, hp_frac = self._numpy_reference_slot(
+                cfg, qcfg, q_np, ratings, t_in, float(arrays.time[t]), balance_w,
+                rounds,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out.p_grid[t]), p_grid, rtol=1e-4, atol=1e-2
+            )
+            np.testing.assert_allclose(
+                np.asarray(out.p_p2p[t]), p_p2p, rtol=1e-4, atol=1e-2
+            )
+            t_in_new, t_bm_new = thermal_step(
+                cfg.thermal,
+                cfg.sim.dt_seconds,
+                jnp.asarray(arrays.t_out[t]),
+                jnp.asarray(t_in),
+                jnp.asarray(t_bm),
+                jnp.asarray(hp_frac * cfg.thermal.hp_max_power),
+            )
+            t_in, t_bm = np.asarray(t_in_new), np.asarray(t_bm_new)
+
+
+class TestTraining:
+    @pytest.mark.parametrize("impl", ["tabular", "dqn", "ddpg"])
+    def test_two_episodes_run(self, day_traces, impl):
+        cfg = small_cfg(impl)
+        rng = np.random.default_rng(42)
+        ratings = make_ratings(cfg, rng)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        res = train_community(cfg, policy, ps, day_traces, ratings, jax.random.PRNGKey(0))
+        assert len(res.episode_rewards) == 2
+        assert all(np.isfinite(r) for r in res.episode_rewards)
+        assert res.env_steps == 2 * 96
+        assert res.progress  # decay/progress hook fired at episode 0
+
+    def test_jit_block_fusion_equivalent_count(self, day_traces):
+        cfg = small_cfg()
+        cfg = cfg.replace(
+            train=TrainConfig(
+                max_episodes=4, min_episodes_criterion=2, episodes_per_jit_block=2
+            )
+        )
+        rng = np.random.default_rng(42)
+        ratings = make_ratings(cfg, rng)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        res = train_community(cfg, policy, ps, day_traces, ratings, jax.random.PRNGKey(0))
+        assert len(res.episode_rewards) == 4
+
+
+class TestEvaluation:
+    def test_per_day_eval_shapes(self):
+        traces = synthetic_traces(n_days=3, start_day=8).normalized()
+        cfg = small_cfg()
+        rng = np.random.default_rng(42)
+        ratings = make_ratings(cfg, rng)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        days, out = evaluate_community(
+            cfg, policy, ps, traces, ratings, jax.random.PRNGKey(0), rng=rng
+        )
+        assert days.tolist() == [8, 9, 10]
+        assert out.cost.shape == (3, 96, 2)
+        assert np.isfinite(np.asarray(out.cost)).all()
