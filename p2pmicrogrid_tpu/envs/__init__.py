@@ -11,11 +11,14 @@ from p2pmicrogrid_tpu.envs.community import (
     PhysState,
     Policy,
     SlotOutputs,
+    SlotTransition,
     build_episode_arrays,
+    draw_rating_scales,
     init_physical,
     make_ratings,
     run_episode,
     rule_baseline_episode,
+    slot_dynamics,
 )
 
 __all__ = [
@@ -24,9 +27,12 @@ __all__ = [
     "PhysState",
     "Policy",
     "SlotOutputs",
+    "SlotTransition",
     "build_episode_arrays",
+    "draw_rating_scales",
     "init_physical",
     "make_ratings",
     "run_episode",
     "rule_baseline_episode",
+    "slot_dynamics",
 ]

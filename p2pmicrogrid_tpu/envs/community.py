@@ -247,19 +247,36 @@ def _negotiate(
     return p2p, hp_frac, pol_state, obs_r[-1], aux_r[-1], q_r[-1], hp_power_r
 
 
-def community_slot(
+class SlotTransition(NamedTuple):
+    """The learning transition a slot produces (agent.py:293-296): last-round
+    observation/action, reward, and the next-slot observation."""
+
+    obs: jnp.ndarray       # [A, 4]
+    aux: jnp.ndarray       # [A] action identifier (index or fraction)
+    reward: jnp.ndarray    # [A]
+    next_obs: jnp.ndarray  # [A, 4]
+
+
+def slot_dynamics(
     cfg: ExperimentConfig,
     policy: Policy,
-    carry,
+    pol_state,
+    phys: PhysState,
     xs,
-    training: bool,
+    key: jax.Array,
     ratings: AgentRatings,
+    explore: bool,
 ):
-    """One 15-minute slot: negotiate -> clear -> settle -> learn -> step assets
-    (community.py:149-170)."""
-    phys, pol_state, key = carry
+    """Everything in a slot except learning: negotiate -> clear -> settle ->
+    reward -> step assets (community.py:149-157,170).
+
+    Split out from ``community_slot`` so scenario-sharded training can vmap
+    the dynamics while applying a single *shared* parameter update across
+    scenarios (parallel/scenarios.py).
+
+    Returns (phys', pol_state', outputs, transition).
+    """
     time_norm, t_out, load_w, pv_w, next_time, next_load_w, next_pv_w = xs
-    key, k_round, k_learn = jax.random.split(key, 3)
 
     buy, inj = grid_prices(cfg.tariff, time_norm)
     trade = p2p_price_fn(buy, inj)
@@ -274,8 +291,8 @@ def community_slot(
         )
 
     p2p, hp_frac, pol_state, obs, aux, q, hp_power_rounds = _negotiate(
-        cfg, policy, pol_state, phys, ratings, time_norm, balance_w, k_round,
-        explore=training,
+        cfg, policy, pol_state, phys, ratings, time_norm, balance_w, key,
+        explore=explore,
     )
 
     p_grid, p_p2p = clear_market(p2p)
@@ -293,23 +310,20 @@ def community_slot(
         cfg.thermal, cfg.sim.dt_seconds, t_out, phys.t_in, phys.t_bm, hp_power
     )
 
-    loss = jnp.zeros_like(reward)
-    if training:
-        next_temp = phys.t_in if cfg.sim.stale_next_temp else t_in_new
-        next_balance = (next_load_w - next_pv_w) / ratings.max_in
-        next_obs = make_observation(
-            next_time,
-            normalized_temperature(cfg.thermal, next_temp),
-            next_balance,
-            jnp.zeros_like(next_balance),  # zero p2p signal (community.py:161)
-        )
-        pol_state, loss = policy.learn(pol_state, obs, aux, reward, next_obs, k_learn)
+    next_temp = phys.t_in if cfg.sim.stale_next_temp else t_in_new
+    next_balance = (next_load_w - next_pv_w) / ratings.max_in
+    next_obs = make_observation(
+        next_time,
+        normalized_temperature(cfg.thermal, next_temp),
+        next_balance,
+        jnp.zeros_like(next_balance),  # zero p2p signal (community.py:161)
+    )
 
     phys = PhysState(t_in=t_in_new, t_bm=t_bm_new, soc=soc, hp_frac=hp_frac)
     outputs = SlotOutputs(
         cost=cost,
         reward=reward,
-        loss=loss,
+        loss=jnp.zeros_like(reward),
         p_grid=p_grid,
         p_p2p=p_p2p,
         buy_price=buy,
@@ -320,6 +334,33 @@ def community_slot(
         decisions=hp_power_rounds,
         q=q,
     )
+    transition = SlotTransition(obs=obs, aux=aux, reward=reward, next_obs=next_obs)
+    return phys, pol_state, outputs, transition
+
+
+def community_slot(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    carry,
+    xs,
+    training: bool,
+    ratings: AgentRatings,
+):
+    """One 15-minute slot: negotiate -> clear -> settle -> learn -> step assets
+    (community.py:149-170)."""
+    phys, pol_state, key = carry
+    key, k_round, k_learn = jax.random.split(key, 3)
+
+    phys, pol_state, outputs, tr = slot_dynamics(
+        cfg, policy, pol_state, phys, xs, k_round, ratings, explore=training
+    )
+
+    if training:
+        pol_state, loss = policy.learn(
+            pol_state, tr.obs, tr.aux, tr.reward, tr.next_obs, k_learn
+        )
+        outputs = outputs._replace(loss=loss)
+
     return (phys, pol_state, key), outputs
 
 

@@ -143,6 +143,26 @@ def _clip_first_layer(cfg: DQNConfig, grads: dict) -> dict:
     return grads
 
 
+def apply_td_update(cfg: DQNConfig, loss_fn, params, target_params, opt_state):
+    """One gradient step + Polyak target update for one agent's Q-network:
+    first-layer kernel clip (rl.py:328-329), Adam apply, soft update
+    (rl.py:335-359). ``loss_fn(params) -> scalar``.
+
+    Shared by the single-scenario per-slot update (``dqn_update``) and the
+    scenario-averaged shared-parameter update (parallel/scenarios.py) so the
+    clip/optimizer/tau semantics can never diverge between the two paths.
+    """
+    opt = _make_optimizer(cfg)
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    grads = _clip_first_layer(cfg, grads)
+    updates, opt_state = opt.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    target_params = jax.tree_util.tree_map(
+        lambda t, o: (1.0 - cfg.tau) * t + cfg.tau * o, target_params, params
+    )
+    return params, target_params, opt_state, loss
+
+
 def dqn_update(
     cfg: DQNConfig,
     state: DQNState,
@@ -164,20 +184,15 @@ def dqn_update(
     s, a, r, ns = replay_sample(replay, key, cfg.batch_size)
 
     net = QNetwork(hidden=cfg.hidden)
-    opt = _make_optimizer(cfg)
 
     def learn_one(params, target_params, opt_state, s, a, r, ns):
-        loss, grads = jax.value_and_grad(
-            lambda p: _td_loss(cfg, net, p, target_params, s, a, r, ns)
-        )(params)
-        grads = _clip_first_layer(cfg, grads)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        # Polyak soft update (rl.py:335-359), tau = cfg.tau.
-        target_params = jax.tree_util.tree_map(
-            lambda t, o: (1.0 - cfg.tau) * t + cfg.tau * o, target_params, params
+        return apply_td_update(
+            cfg,
+            lambda p: _td_loss(cfg, net, p, target_params, s, a, r, ns),
+            params,
+            target_params,
+            opt_state,
         )
-        return params, target_params, opt_state, loss
 
     online, target, opt_state, loss = jax.vmap(learn_one)(
         state.online, state.target, state.opt_state, s, a, r, ns

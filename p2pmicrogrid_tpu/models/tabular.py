@@ -113,7 +113,12 @@ def tabular_update(
     q_next_max = jnp.max(_q_rows(cfg, state.q_table, next_obs), axis=-1)
 
     td = reward + cfg.gamma * q_next_max - q_sa
-    q_table = state.q_table.at[a_idx, ti, tpi, bi, pi, action].add(cfg.alpha * td)
+    # Each agent touches its own table row (leading a_idx is arange), so the
+    # scatter indices are unique and sorted — letting XLA take the vectorized
+    # scatter path instead of the serialized colliding-update loop.
+    q_table = state.q_table.at[a_idx, ti, tpi, bi, pi, action].add(
+        cfg.alpha * td, unique_indices=True, indices_are_sorted=True
+    )
     return state._replace(q_table=q_table)
 
 

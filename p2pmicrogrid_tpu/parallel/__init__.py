@@ -1,0 +1,37 @@
+"""TPU-native scaling: scenario batching and device-mesh sharding.
+
+The reference has no parallel or distributed execution at all (SURVEY.md
+section 2, "Parallelism & distributed-communication inventory") — everything
+is one Python process iterating agents in a ``for`` loop. Here the scaling
+axes demanded by BASELINE.md are first-class:
+
+* **agents** — already a vmapped array axis everywhere (envs/, models/);
+* **scenarios** — Monte-Carlo load/PV draws as a second vmapped axis, either
+  fully independent replicas or sharing policy parameters with per-slot
+  cross-scenario gradient averaging (the "shared-critic" mode);
+* **devices** — the scenario axis sharded over a ``jax.sharding.Mesh``; XLA
+  inserts the ICI all-reduces for shared-parameter gradients and metric
+  reductions (DCN between hosts for multi-pod meshes).
+"""
+
+from p2pmicrogrid_tpu.parallel.mesh import (
+    make_mesh,
+    scenario_sharding,
+    replicated_sharding,
+)
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    make_scenario_traces,
+    stack_scenario_arrays,
+    train_scenarios_independent,
+    train_scenarios_shared,
+)
+
+__all__ = [
+    "make_mesh",
+    "scenario_sharding",
+    "replicated_sharding",
+    "make_scenario_traces",
+    "stack_scenario_arrays",
+    "train_scenarios_independent",
+    "train_scenarios_shared",
+]

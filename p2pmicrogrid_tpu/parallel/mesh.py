@@ -1,0 +1,55 @@
+"""Device-mesh construction and sharding specs.
+
+One mesh axis, ``"data"``, shards the Monte-Carlo scenario axis; policy
+parameters are replicated (independent mode keeps a per-scenario learner state
+which is also scenario-sharded). The collectives are left to XLA: a
+``jnp.mean`` over a sharded axis lowers to an all-reduce over ICI, and shared-
+parameter gradients averaged across scenarios lower to a psum — exactly the
+"pick a mesh, annotate shardings, let XLA insert collectives" recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, axis_name: str = "data"
+) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} present"
+            )
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def scenario_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    """Shard the leading (scenario) axis across the mesh; all trailing axes
+    replicated."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (shared parameters, configs-as-arrays)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_leading_axis(tree, mesh: Mesh, axis_name: str = "data"):
+    """Device-put every leaf with its leading axis sharded over the mesh."""
+    sh = scenario_sharding(mesh, axis_name)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def replicate(tree, mesh: Mesh):
+    """Device-put every leaf fully replicated over the mesh."""
+    sh = replicated_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)

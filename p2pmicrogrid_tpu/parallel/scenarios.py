@@ -1,0 +1,371 @@
+"""Monte-Carlo scenario batching: the TPU-native scaling axis.
+
+The reference simulates exactly one load/PV realization per run (SURVEY.md
+section 2). Here a *scenario* is an independent draw of the synthetic
+load/PV/weather generator; scenarios form a leading batch axis over the whole
+simulation, vmapped on one chip and sharded across the mesh on many
+(mesh.py). Two training modes:
+
+* **independent** — every scenario carries its own full learner state: S
+  independent communities train in one device program (Monte-Carlo over
+  trajectories; supports tabular/dqn/ddpg).
+* **shared** — one set of policy parameters serves all scenarios; each slot
+  the per-scenario updates are *averaged* across the scenario axis before
+  being applied (the "shared-critic" mode of BASELINE.md config 4). Under a
+  scenario-sharded jit this average lowers to an ICI all-reduce — the
+  gradient-allreduce data parallelism of the north star.
+
+Both training loops take a prebuilt episode function (``make_*_episode_fn``)
+so the jitted program is compiled once and reused across calls; exploration
+decays on the reference cadence (every ``min_episodes_criterion`` episodes,
+community.py:279-287).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.data.traces import TraceSet, synthetic_traces
+from p2pmicrogrid_tpu.envs.community import (
+    AgentRatings,
+    EpisodeArrays,
+    Policy,
+    build_episode_arrays,
+    init_physical,
+    run_episode,
+    slot_dynamics,
+)
+from p2pmicrogrid_tpu.models.dqn import (
+    ACTION_VALUES,
+    DQNState,
+    QNetwork,
+    _td_loss,
+    apply_td_update,
+)
+from p2pmicrogrid_tpu.models.replay import replay_add, replay_sample
+from p2pmicrogrid_tpu.models.tabular import TabularState
+from p2pmicrogrid_tpu.ops.obs import discretize
+
+
+def make_scenario_traces(
+    cfg: ExperimentConfig,
+    n_scenarios: Optional[int] = None,
+    n_days: int = 1,
+    seed: int = 0,
+    start_day: int = 11,
+) -> TraceSet:
+    """S independent synthetic draws (S = ``cfg.sim.n_scenarios`` unless
+    overridden), stacked on a leading scenario axis: leaves are [S, T(, P)].
+    """
+    S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
+    draws = [
+        synthetic_traces(n_days=n_days, seed=seed + s, start_day=start_day).normalized()
+        for s in range(S)
+    ]
+    return TraceSet(*(np.stack(leaves) for leaves in zip(*draws)))
+
+
+def stack_scenario_arrays(
+    cfg: ExperimentConfig, traces: TraceSet, ratings: AgentRatings
+) -> EpisodeArrays:
+    """Per-scenario EpisodeArrays, stacked to [S, T, ...]."""
+    per_scenario = [
+        build_episode_arrays(cfg, TraceSet(*(np.asarray(l)[s] for l in traces)), ratings)
+        for s in range(traces.time.shape[0])
+    ]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_scenario)
+
+
+def _run_episode_loop(
+    episode_fn: Callable,
+    carry,
+    key: jax.Array,
+    n_episodes: int,
+    policy: Policy,
+    decay_every: Optional[int],
+    episode0: int,
+) -> Tuple[object, np.ndarray, float]:
+    """Shared host loop: run episodes, decay on the reference cadence."""
+    rewards = []
+    start = _time.time()
+    for e in range(n_episodes):
+        key, k = jax.random.split(key)
+        carry, r = episode_fn(carry, k)
+        if decay_every and (episode0 + e) % decay_every == 0:
+            carry = _decay_carry(policy, carry)
+        rewards.append(np.asarray(r))
+    jax.block_until_ready(carry)
+    return carry, np.stack(rewards), _time.time() - start
+
+
+def _decay_carry(policy: Policy, carry):
+    if isinstance(carry, tuple) and not hasattr(carry, "_fields"):
+        pol_state, rest = carry[0], carry[1:]
+        return (policy.decay(pol_state),) + rest
+    return policy.decay(carry)
+
+
+# --- independent mode -------------------------------------------------------
+
+
+def make_independent_episode_fn(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    arrays_s: EpisodeArrays,
+    ratings: AgentRatings,
+) -> Callable:
+    """Jitted: one training episode for each of S independent learners.
+
+    Signature: (pol_state_s, key) -> (pol_state_s, rewards [S]).
+    """
+    n_scenarios = arrays_s.time.shape[0]
+
+    @jax.jit
+    def episode(pol_state_s, key):
+        keys = jax.random.split(key, n_scenarios)
+
+        def one(pol_state, arrays, k):
+            k_phys, k_ep = jax.random.split(k)
+            phys = init_physical(cfg, k_phys)
+            _, pol_state, outputs = run_episode(
+                cfg, policy, pol_state, phys, arrays, ratings, k_ep, training=True
+            )
+            return pol_state, jnp.sum(jnp.mean(outputs.reward, axis=-1))
+
+        return jax.vmap(one, in_axes=(0, 0, 0))(pol_state_s, arrays_s, keys)
+
+    return episode
+
+
+def train_scenarios_independent(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state_s,
+    arrays_s: EpisodeArrays,
+    ratings: AgentRatings,
+    key: jax.Array,
+    n_episodes: int,
+    episode_fn: Optional[Callable] = None,
+    episode0: int = 0,
+) -> Tuple[object, np.ndarray, float]:
+    """S independent learners, one device program per episode.
+
+    ``pol_state_s`` must carry a leading scenario axis on every leaf (e.g.
+    ``jax.vmap(lambda k: init_policy_state(cfg, k))(keys)``). Pass a prebuilt
+    ``episode_fn`` (``make_independent_episode_fn``) to reuse its compiled
+    program across calls. Returns (final states [S,...], rewards
+    [episodes, S], seconds).
+    """
+    if episode_fn is None:
+        episode_fn = make_independent_episode_fn(cfg, policy, arrays_s, ratings)
+    return _run_episode_loop(
+        episode_fn,
+        pol_state_s,
+        key,
+        n_episodes,
+        policy,
+        cfg.train.min_episodes_criterion,
+        episode0,
+    )
+
+
+# --- shared-parameter mode --------------------------------------------------
+
+
+def _tabular_update_shared(
+    cfg: ExperimentConfig, state: TabularState, tr, key
+) -> Tuple[TabularState, jnp.ndarray]:
+    """Shared Q-table Bellman update averaged over the scenario axis.
+
+    tr leaves have shape [S, A, ...]. Per-agent tables stay exact along the
+    agent axis; along the scenario axis the per-scenario TD deltas are applied
+    at their own indices scaled 1/S (colliding cells sum, which matches
+    averaging the sequential updates to first order in alpha).
+    """
+    q = cfg.qlearning
+    S = tr.obs.shape[0]
+    A = state.q_table.shape[0]
+
+    def delta_for(obs, action, reward, next_obs):
+        ti, tpi, bi, pi = discretize(q, obs)
+        a_idx = jnp.arange(A)
+        q_sa = state.q_table[a_idx, ti, tpi, bi, pi, action]
+        nti, ntpi, nbi, npi = discretize(q, next_obs)
+        q_next = jnp.max(state.q_table[a_idx, nti, ntpi, nbi, npi, :], axis=-1)
+        td = reward + q.gamma * q_next - q_sa
+        return (a_idx, ti, tpi, bi, pi, action), td
+
+    idxs, tds = jax.vmap(
+        lambda o, a, r, n: delta_for(o, a.astype(jnp.int32), r, n)
+    )(tr.obs, tr.aux, tr.reward, tr.next_obs)
+
+    # Scenarios frequently collide on the same (agent, state, action) cell; a
+    # raw colliding scatter-add serializes on TPU (~ms per slot at S=256).
+    # Dedup first: linearize indices, sort, segment-sum colliding values, and
+    # scatter only segment heads with unique_indices=True (duplicates are sent
+    # to distinct out-of-range indices and dropped).
+    table = state.q_table
+    dims = table.shape
+    flat_vals = q.alpha * tds.reshape(-1) / S
+    lin = jnp.ravel_multi_index(
+        tuple(i.reshape(-1) for i in idxs), dims, mode="clip"
+    )
+    order = jnp.argsort(lin)
+    sl = lin[order]
+    sv = flat_vals[order]
+    is_head = jnp.concatenate([jnp.ones((1,), bool), sl[1:] != sl[:-1]])
+    seg_id = jnp.cumsum(is_head) - 1
+    summed = jax.ops.segment_sum(sv, seg_id, num_segments=sl.shape[0])
+    size = int(np.prod(dims))
+    n = sl.shape[0]
+    scatter_idx = jnp.where(is_head, sl, size + jnp.arange(n))
+    head_vals = jnp.where(is_head, summed[seg_id], 0.0)
+    flat_table = table.reshape(-1).at[scatter_idx].add(
+        head_vals, mode="drop", unique_indices=True
+    )
+    return state._replace(q_table=flat_table.reshape(dims)), jnp.zeros_like(
+        tr.reward[0]
+    )
+
+
+def _dqn_update_shared(
+    cfg: ExperimentConfig, state: DQNState, replay_s, tr, key
+) -> Tuple[DQNState, object, jnp.ndarray]:
+    """Shared per-agent DQN params; per-scenario replay; gradients averaged
+    over scenarios each slot (the psum-over-ICI path when scenario-sharded).
+    """
+    d = cfg.dqn
+    act_frac = ACTION_VALUES[tr.aux.astype(jnp.int32)][..., None]  # [S, A, 1]
+    replay_s = jax.vmap(replay_add)(replay_s, tr.obs, act_frac, tr.reward, tr.next_obs)
+
+    S = tr.obs.shape[0]
+    keys = jax.random.split(key, S)
+    s, a, r, ns = jax.vmap(lambda rep, k: replay_sample(rep, k, d.batch_size))(
+        replay_s, keys
+    )  # [S, A, B, ...]
+
+    net = QNetwork(hidden=d.hidden)
+
+    def learn_one(params, target_params, opt_state, s, a, r, ns):
+        def loss_fn(p):
+            # Mean TD loss over the scenario axis for one agent.
+            losses = jax.vmap(
+                lambda s_, a_, r_, ns_: _td_loss(d, net, p, target_params, s_, a_, r_, ns_)
+            )(s, a, r, ns)
+            return jnp.mean(losses)
+
+        return apply_td_update(d, loss_fn, params, target_params, opt_state)
+
+    # vmap over the agent axis; scenario axis is reduced inside the loss.
+    online, target, opt_state, loss = jax.vmap(
+        learn_one, in_axes=(0, 0, 0, 1, 1, 1, 1)
+    )(state.online, state.target, state.opt_state, s, a, r, ns)
+
+    new_state = state._replace(online=online, target=target, opt_state=opt_state)
+    return new_state, replay_s, loss
+
+
+def make_shared_episode_fn(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    arrays_s: EpisodeArrays,
+    ratings: AgentRatings,
+) -> Callable:
+    """Jitted: one shared-parameter training episode over S scenarios.
+
+    Signature: ((pol_state, replay_s), key) -> ((pol_state, replay_s),
+    rewards [S]). ``replay_s`` is None for tabular.
+    """
+    impl = cfg.train.implementation
+    if impl not in ("tabular", "dqn"):
+        raise ValueError(f"shared-scenario training supports tabular/dqn, got {impl!r}")
+    n_scenarios = arrays_s.time.shape[0]
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+
+    def slot(carry, xs_t):
+        phys_s, pol_state, replay_s, key = carry
+        key, k_act, k_learn = jax.random.split(key, 3)
+        act_keys = jax.random.split(k_act, n_scenarios)
+
+        def dyn(phys, xs, k):
+            phys, _, outputs, tr = slot_dynamics(
+                cfg, policy, pol_state, phys, xs, k, ratings_j, explore=True
+            )
+            return phys, outputs, tr
+
+        phys_s, outputs_s, tr_s = jax.vmap(dyn)(phys_s, xs_t, act_keys)
+
+        if impl == "tabular":
+            pol_state, _ = _tabular_update_shared(cfg, pol_state, tr_s, k_learn)
+        else:
+            pol_state, replay_s, _ = _dqn_update_shared(
+                cfg, pol_state, replay_s, tr_s, k_learn
+            )
+        return (phys_s, pol_state, replay_s, key), jnp.mean(outputs_s.reward, axis=-1)
+
+    @jax.jit
+    def episode(carry, key):
+        pol_state, replay_s = carry
+        k_phys, k_scan = jax.random.split(key)
+        phys_s = jax.vmap(lambda k: init_physical(cfg, k))(
+            jax.random.split(k_phys, n_scenarios)
+        )
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrays_s)
+        xs = (
+            xs.time,
+            xs.t_out,
+            xs.load_w,
+            xs.pv_w,
+            xs.next_time,
+            xs.next_load_w,
+            xs.next_pv_w,
+        )
+        (phys_s, pol_state, replay_s, _), rewards = jax.lax.scan(
+            slot, (phys_s, pol_state, replay_s, k_scan), xs
+        )
+        return (pol_state, replay_s), jnp.sum(rewards, axis=0)
+
+    return episode
+
+
+def train_scenarios_shared(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state,
+    arrays_s: EpisodeArrays,
+    ratings: AgentRatings,
+    key: jax.Array,
+    n_episodes: int,
+    replay_s=None,
+    episode_fn: Optional[Callable] = None,
+    episode0: int = 0,
+) -> Tuple[object, object, np.ndarray, float]:
+    """One shared learner over S scenarios: per slot, vmapped dynamics produce
+    per-scenario transitions and a single averaged update is applied.
+
+    Supports ``implementation`` 'tabular' and 'dqn'. For dqn, ``replay_s``
+    must be a scenario-stacked ReplayState (``jax.vmap(replay_init)``-style).
+    Pass a prebuilt ``episode_fn`` (``make_shared_episode_fn``) to reuse its
+    compiled program across calls.
+
+    Returns (pol_state, replay_s, rewards [episodes, S], seconds).
+    """
+    if episode_fn is None:
+        episode_fn = make_shared_episode_fn(cfg, policy, arrays_s, ratings)
+    carry, rewards, seconds = _run_episode_loop(
+        episode_fn,
+        (pol_state, replay_s),
+        key,
+        n_episodes,
+        policy,
+        cfg.train.min_episodes_criterion,
+        episode0,
+    )
+    pol_state, replay_s = carry
+    return pol_state, replay_s, rewards, seconds

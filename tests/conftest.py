@@ -1,7 +1,10 @@
 """Test configuration: run everything on a virtual 8-device CPU mesh.
 
-Must set the env vars before jax is imported anywhere (pytest imports conftest
-first, and test modules import jax lazily at module level after this runs).
+The TPU comes from an out-of-tree PJRT plugin whose site hook calls
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter startup,
+which overrides ``JAX_PLATFORMS`` from the environment. Tests must therefore
+(a) set ``XLA_FLAGS`` before the CPU client is instantiated and (b) force the
+platform selection back to cpu through jax.config, not the environment.
 """
 
 import os
@@ -12,3 +15,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
