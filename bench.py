@@ -1,186 +1,10 @@
-"""Benchmark: scenario-env-steps/sec/chip (the BASELINE.md metric).
+"""Driver benchmark entry: one JSON line {metric, value, unit, vs_baseline}.
 
-Flagship config ~ BASELINE.md config 3: a 50-agent community with battery
-storage + 2R2C heating, 256 Monte-Carlo load/PV scenarios, shared tabular-Q
-parameters, trained end-to-end on the default device — the whole episode
-(96 slots x negotiation x market clearing x per-slot shared learning) is one
-XLA program per episode; one env-step = one community slot in one scenario.
-
-``vs_baseline`` compares against a sequential NumPy re-implementation of the
-reference's eager per-slot, per-agent loop (community.py:67-93 semantics,
-single scenario) running on this host — the reference's own execution model,
-minus TF overhead (a generous baseline).
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Thin wrapper; the implementation lives in p2pmicrogrid_tpu.benchmarks so the
+installed package exposes the same benchmark via the CLI (`... bench`).
 """
 
-from __future__ import annotations
-
-import json
-import time
-
-import numpy as np
-
-N_AGENTS = 50
-N_SCENARIOS = 256
-MEASURE_EPISODES = 2
-
-
-def jax_steps_per_sec() -> float:
-    import jax
-
-    from p2pmicrogrid_tpu.config import (
-        BatteryConfig,
-        SimConfig,
-        TrainConfig,
-        default_config,
-    )
-    from p2pmicrogrid_tpu.envs import make_ratings
-    from p2pmicrogrid_tpu.parallel import (
-        make_scenario_traces,
-        stack_scenario_arrays,
-        train_scenarios_shared,
-    )
-    from p2pmicrogrid_tpu.train import init_policy_state, make_policy
-
-    from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
-
-    cfg = default_config(
-        sim=SimConfig(n_agents=N_AGENTS, n_scenarios=N_SCENARIOS),
-        battery=BatteryConfig(enabled=True),
-        train=TrainConfig(implementation="tabular"),
-    )
-    ratings = make_ratings(cfg, np.random.default_rng(42))
-    traces = make_scenario_traces(cfg)
-    arrays = stack_scenario_arrays(cfg, traces, ratings)
-    key = jax.random.PRNGKey(0)
-    policy = make_policy(cfg)
-    ps = init_policy_state(cfg, key)
-
-    # One episode fn -> one compiled program reused by warmup and measurement.
-    episode_fn = make_shared_episode_fn(cfg, policy, arrays, ratings)
-    ps, _, _, _ = train_scenarios_shared(
-        cfg, policy, ps, arrays, ratings, key, n_episodes=1, episode_fn=episode_fn
-    )
-    _, _, _, secs = train_scenarios_shared(
-        cfg,
-        policy,
-        ps,
-        arrays,
-        ratings,
-        key,
-        n_episodes=MEASURE_EPISODES,
-        episode_fn=episode_fn,
-        episode0=1,
-    )
-    slots = int(arrays.time.shape[1])
-    return MEASURE_EPISODES * slots * N_SCENARIOS / secs
-
-
-def numpy_reference_steps_per_sec(max_slots: int = 96) -> float:
-    """Sequential per-agent eager loop with the same semantics (the
-    reference's execution model), one scenario."""
-    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
-    from p2pmicrogrid_tpu.data import synthetic_traces
-    from p2pmicrogrid_tpu.envs import build_episode_arrays, make_ratings
-
-    cfg = default_config(
-        sim=SimConfig(n_agents=N_AGENTS), train=TrainConfig(implementation="tabular")
-    )
-    q = cfg.qlearning
-    traces = synthetic_traces(n_days=1, start_day=11).normalized()
-    ratings = make_ratings(cfg, np.random.default_rng(42))
-    arrays = build_episode_arrays(cfg, traces, ratings)
-
-    A = N_AGENTS
-    actions = np.array([0.0, 0.5, 1.0])
-    q_tables = np.zeros((A, 20, 20, 20, 20, 3), dtype=np.float32)
-    t_in = np.full(A, 21.0)
-    t_bm = np.full(A, 21.0)
-    hp_frac = np.zeros(A)
-    epsilon = q.epsilon
-    th = cfg.thermal
-    rng = np.random.default_rng(0)
-
-    def discretize1(obs):
-        t = int(np.clip(int(obs[0] * 20), 0, 19))
-        tp = int(np.clip(int((obs[1] + 1) / 2 * 18 + 1), 0, 19))
-        b = int(np.clip(int((obs[2] + 1) / 2 * 20), 0, 19))
-        p = int(np.clip(int((obs[3] + 1) / 2 * 20), 0, 19))
-        return t, tp, b, p
-
-    T = min(max_slots, arrays.n_slots)
-    load_w = np.asarray(arrays.load_w)
-    pv_w = np.asarray(arrays.pv_w)
-    time_n = np.asarray(arrays.time)
-    t_out = np.asarray(arrays.t_out)
-
-    start = time.time()
-    for t in range(T):
-        balance = load_w[t] - pv_w[t]
-        p2p = np.zeros((A, A))
-        for r in range(cfg.sim.rounds + 1):
-            np.fill_diagonal(p2p, 0.0)
-            new_rows = np.zeros((A, A))
-            for i in range(A):
-                powers = -p2p[:, i]
-                obs = np.array(
-                    [
-                        time_n[t],
-                        (t_in[i] - th.setpoint) / th.margin,
-                        balance[i] / ratings.max_in[i],
-                        powers.mean() / ratings.max_in[i],
-                    ]
-                )
-                ti, tpi, bi, pi = discretize1(obs)
-                if rng.random() < epsilon:
-                    a = rng.integers(0, 3)
-                else:
-                    a = int(np.argmax(q_tables[i, ti, tpi, bi, pi]))
-                hp_frac[i] = actions[a]
-                out = balance[i] + hp_frac[i] * th.hp_max_power
-                filt = np.where(np.sign(out) != np.sign(powers), powers, 0.0)
-                tot = abs(filt.sum())
-                new_rows[i] = (
-                    out * np.abs(filt) / tot if tot > 0 else out * np.ones(A) / A
-                )
-                # Bellman update (placeholder next-state; the update's cost is
-                # what matters for throughput).
-                q_tables[i, ti, tpi, bi, pi, a] += q.alpha * (
-                    -1.0 + q.gamma * q_tables[i, ti, tpi, bi, pi].max()
-                    - q_tables[i, ti, tpi, bi, pi, a]
-                )
-            p2p = new_rows
-        p_match = np.where(np.sign(p2p) != np.sign(p2p.T), p2p, 0.0)
-        exchange = np.sign(p_match) * np.minimum(np.abs(p_match), np.abs(p_match).T)
-        _ = (p2p - exchange).sum(axis=1)
-        # Thermal step.
-        heat = hp_frac * th.hp_max_power * th.cop
-        d_tin = ((t_bm - t_in) / th.ri + (t_out[t] - t_in) / th.rvent + 0.7 * heat) / th.ci
-        d_tbm = ((t_in - t_bm) / th.ri + (t_out[t] - t_bm) / th.re + 0.3 * heat) / th.cm
-        t_in = t_in + d_tin * cfg.sim.dt_seconds
-        t_bm = t_bm + d_tbm * cfg.sim.dt_seconds
-    seconds = time.time() - start
-    return T / seconds
-
-
-def main() -> None:
-    value = jax_steps_per_sec()
-    baseline = numpy_reference_steps_per_sec()
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"scenario_env_steps_per_sec_{N_AGENTS}agent_"
-                    f"{N_SCENARIOS}scenario_shared_tabular"
-                ),
-                "value": round(value, 1),
-                "unit": "env-steps/sec/chip",
-                "vs_baseline": round(value / baseline, 2),
-            }
-        )
-    )
-
+from p2pmicrogrid_tpu.benchmarks import main
 
 if __name__ == "__main__":
     main()

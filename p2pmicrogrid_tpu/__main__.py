@@ -1,0 +1,8 @@
+"""``python -m p2pmicrogrid_tpu`` — the CLI entry point (the reference's
+``microgrid/__main__.py`` is an empty file; SURVEY.md section 1)."""
+
+import sys
+
+from p2pmicrogrid_tpu.cli import main
+
+sys.exit(main())

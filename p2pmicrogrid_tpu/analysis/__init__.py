@@ -1,0 +1,38 @@
+"""Post-run analysis and reporting (reference: microgrid/data_analysis.py).
+
+Host-side: pandas/matplotlib/scipy over the relational results store
+(data/results.py) and raw simulator outputs. Figures are returned (and
+optionally saved), never ``plt.show()``-n — this layer must run headless.
+"""
+
+from p2pmicrogrid_tpu.analysis.report import (
+    community_summary,
+    analyse_community_output,
+)
+from p2pmicrogrid_tpu.analysis.stats import (
+    paired_cost_ttest,
+    statistics_community_scale,
+    statistics_nr_rounds,
+    statistical_tests,
+)
+from p2pmicrogrid_tpu.analysis.plots import (
+    plot_learning_curves,
+    plot_cost_comparison,
+    plot_day_traces,
+    plot_rounds_decisions,
+    plot_qtable_heatmap,
+)
+
+__all__ = [
+    "community_summary",
+    "analyse_community_output",
+    "paired_cost_ttest",
+    "statistics_community_scale",
+    "statistics_nr_rounds",
+    "statistical_tests",
+    "plot_learning_curves",
+    "plot_cost_comparison",
+    "plot_day_traces",
+    "plot_rounds_decisions",
+    "plot_qtable_heatmap",
+]

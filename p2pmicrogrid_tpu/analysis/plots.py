@@ -1,0 +1,143 @@
+"""Thesis-figure plotting utilities.
+
+Reference: data_analysis.py's figure factory — learning curves (:697-772),
+cost comparisons across settings (:324-417), per-day state/decision traces
+(:420-694), round-by-round decision comparison (:997-1096), and Q-table
+visualization (:1214-1297). All functions return matplotlib Figures and never
+call ``plt.show()``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def plot_learning_curves(progress_df, settings: Optional[Sequence[str]] = None):
+    """Reward / TD-error training curves (data_analysis.py:697-772).
+
+    ``progress_df``: the ``training_progress`` table (ResultsStore).
+    """
+    plt = _plt()
+    df = progress_df
+    if settings is not None:
+        df = df[df["setting"].isin(list(settings))]
+    fig, axes = plt.subplots(1, 2, figsize=(12, 4))
+    for (setting, impl), g in df.groupby(["setting", "implementation"]):
+        g = g.sort_values("episode")
+        axes[0].plot(g["episode"], g["reward"], label=f"{setting} ({impl})")
+        axes[1].plot(g["episode"], g["error"], label=f"{setting} ({impl})")
+    axes[0].set_xlabel("Episode")
+    axes[0].set_ylabel("Average reward")
+    axes[0].set_title("Training reward")
+    axes[1].set_xlabel("Episode")
+    axes[1].set_ylabel("Average error")
+    axes[1].set_title("Training error")
+    axes[0].legend(fontsize=7)
+    fig.tight_layout()
+    return fig
+
+
+def plot_cost_comparison(test_df, settings: Optional[Sequence[str]] = None):
+    """Average daily cost per setting, with per-day spread
+    (data_analysis.py:324-417)."""
+    from p2pmicrogrid_tpu.analysis.stats import daily_cost_table
+
+    plt = _plt()
+    df = test_df
+    if settings is not None:
+        df = df[df["setting"].isin(list(settings))]
+    daily = daily_cost_table(df).reset_index().melt(
+        id_vars="day", var_name="setting", value_name="cost"
+    )
+    order = sorted(daily["setting"].unique())
+    means = [daily.loc[daily["setting"] == s, "cost"].mean() for s in order]
+    stds = [daily.loc[daily["setting"] == s, "cost"].std() for s in order]
+    fig, ax = plt.subplots(figsize=(max(6, len(order) * 1.2), 4))
+    ax.bar(range(len(order)), means, 0.6, yerr=stds, capsize=4)
+    ax.set_xticks(range(len(order)))
+    ax.set_xticklabels(order, rotation=20, ha="right", fontsize=8)
+    ax.set_ylabel("Avg daily cost per agent [€]")
+    ax.set_title("Cost comparison")
+    fig.tight_layout()
+    return fig
+
+
+def plot_day_traces(test_df, setting: str, day: int, comfort_bounds=(20.0, 22.0)):
+    """Per-slot load/pv/temperature/heat-pump/cost traces for one day
+    (data_analysis.py:420-694)."""
+    plt = _plt()
+    df = test_df[(test_df["setting"] == setting) & (test_df["day"] == day)]
+    fig, axes = plt.subplots(4, 1, figsize=(9, 11), sharex=True)
+    for agent, g in df.groupby("agent"):
+        g = g.sort_values("time")
+        t = g["time"] * 24
+        axes[0].plot(t, g["load"] * 1e-3, label=f"agent {agent}")
+        axes[0].plot(t, g["pv"] * 1e-3, "--", alpha=0.6)
+        axes[1].plot(t, g["temperature"])
+        axes[2].plot(t, g["heatpump"] * 1e-3)
+        axes[3].plot(t, g["cost"].cumsum())
+    axes[0].set_ylabel("Load / PV [kW]")
+    axes[0].legend(fontsize=7)
+    axes[1].set_ylabel("T indoor [°C]")
+    axes[1].axhspan(*comfort_bounds, alpha=0.15, color="green")
+    axes[2].set_ylabel("Heat pump [kW]")
+    axes[3].set_ylabel("Cumulative cost [€]")
+    axes[3].set_xlabel("Time [h]")
+    fig.suptitle(f"{setting} — day {day}")
+    fig.tight_layout()
+    return fig
+
+
+def plot_rounds_decisions(rounds_df, setting: str, day: int):
+    """Round-by-round heat-pump decisions (data_analysis.py:997-1096)."""
+    plt = _plt()
+    df = rounds_df[(rounds_df["setting"] == setting) & (rounds_df["day"] == day)]
+    agents = sorted(df["agent"].unique())
+    fig, axes = plt.subplots(len(agents), 1, figsize=(9, 2.5 * len(agents)), sharex=True, squeeze=False)
+    for ax, agent in zip(axes[:, 0], agents):
+        g = df[df["agent"] == agent]
+        for rnd, gg in g.groupby("round"):
+            gg = gg.sort_values("time")
+            ax.step(gg["time"] * 24, gg["decision"] * 1e-3, where="post", label=f"round {rnd}")
+        ax.set_ylabel(f"agent {agent} [kW]")
+        ax.legend(fontsize=7)
+    axes[-1, 0].set_xlabel("Time [h]")
+    fig.suptitle(f"Per-round decisions — {setting}, day {day}")
+    fig.tight_layout()
+    return fig
+
+
+def plot_qtable_heatmap(q_table: np.ndarray):
+    """Greedy-policy heatmap over (time, temperature), marginalizing the
+    balance/p2p state dims (data_analysis.py:1214-1297).
+
+    q_table: one agent's table [nt, ntemp, nb, np2p, n_actions].
+    """
+    plt = _plt()
+    q = np.asarray(q_table)
+    # Marginalize balance/p2p by averaging Q before the argmax.
+    q2 = q.mean(axis=(2, 3))  # [nt, ntemp, n_actions]
+    greedy = q2.argmax(axis=-1)
+    fig, axes = plt.subplots(1, 2, figsize=(11, 4))
+    im0 = axes[0].pcolormesh(greedy.T, cmap="viridis")
+    axes[0].set_title("Greedy action (0=off, 2=full)")
+    axes[0].set_xlabel("Time bin")
+    axes[0].set_ylabel("Temperature bin")
+    fig.colorbar(im0, ax=axes[0])
+    im1 = axes[1].pcolormesh(q2.max(axis=-1).T, cmap="magma")
+    axes[1].set_title("Max Q-value")
+    axes[1].set_xlabel("Time bin")
+    fig.colorbar(im1, ax=axes[1])
+    fig.tight_layout()
+    return fig

@@ -1,0 +1,146 @@
+"""Community run report (reference: data_analysis.py:188-304).
+
+``community_summary`` computes the quantities the reference prints and plots
+after a run — per-agent energy, cost, self-consumption — from simulator
+outputs; ``analyse_community_output`` renders the reference's figure set
+(cost bars, self-consumption bars, grid-load day x slot heatmap, per-agent
+profile/temperature/heat-pump traces).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def community_summary(
+    outputs,
+    arrays,
+    slot_hours: float = 0.25,
+    comfort_bounds: tuple = (20.0, 22.0),
+) -> Dict[str, np.ndarray]:
+    """Per-agent summary over an evaluated span.
+
+    outputs/arrays leaves: [D, T, ...] (or [T, ...]; a leading day axis is
+    added if missing). Mirrors data_analysis.py:194-197: power = what each
+    agent drew (grid + p2p), self-consumption = PV used on site.
+    ``comfort_bounds`` defaults to the reference's 21 +/- 1 °C band
+    (heating.py:90-94); pass ``(cfg.thermal.lower_bound,
+    cfg.thermal.upper_bound)`` for non-default thermal configs.
+    """
+    def _flat(x):
+        x = np.asarray(x)
+        return x.reshape(-1, x.shape[-1]) if x.ndim > 2 else x
+
+    power = _flat(outputs.p_grid) + _flat(outputs.p_p2p)   # [D*T, A]
+    production = _flat(arrays.pv_w)
+    load = _flat(arrays.load_w)
+    cost = _flat(outputs.cost)
+    t_in = _flat(outputs.t_in)
+
+    # data_analysis.py:195: PV production covered on-site. When the agent
+    # injects (power < 0) the self-consumed part is production + power;
+    # when it draws, all production is consumed on site.
+    self_consumption = np.where(power < 0, production + power, production)
+
+    with np.errstate(invalid="ignore", divide="ignore"):
+        sc_ratio = self_consumption.sum(axis=0) / production.sum(axis=0)
+
+    lo, hi = comfort_bounds
+    return {
+        "energy_consumed_kwh": power.sum(axis=0) * slot_hours * 1e-3,
+        "load_energy_kwh": load.sum(axis=0) * slot_hours * 1e-3,
+        "pv_energy_kwh": production.sum(axis=0) * slot_hours * 1e-3,
+        "total_cost_eur": cost.sum(axis=0),
+        "self_consumption_ratio": sc_ratio,
+        "mean_temperature": t_in.mean(axis=0),
+        "comfort_violation_frac": ((t_in < lo) | (t_in > hi)).mean(axis=0),
+    }
+
+
+def analyse_community_output(
+    days,
+    outputs,
+    arrays,
+    save_dir: Optional[str] = None,
+    slot_hours: float = 0.25,
+    comfort_bounds: tuple = (20.0, 22.0),
+):
+    """The reference's post-run figure set (data_analysis.py:188-304).
+
+    Returns (summary dict, {figure_name: Figure}). Saves PNGs when
+    ``save_dir`` is given.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    summary = community_summary(outputs, arrays, slot_hours, comfort_bounds)
+    figures = {}
+
+    power = np.asarray(outputs.p_grid) + np.asarray(outputs.p_p2p)
+    if power.ndim == 2:
+        power = power[None]
+    n_days, T, A = power.shape
+    agent_ids = np.arange(A)
+
+    # Cost bars (plot_costs, data_analysis.py:247-254).
+    fig, ax = plt.subplots()
+    ax.bar(agent_ids, summary["total_cost_eur"], 0.35)
+    ax.set_title("Electricity costs")
+    ax.set_xlabel("Agent")
+    ax.set_ylabel("Cost [€]")
+    figures["costs"] = fig
+
+    # Self-consumption bars (plot_selfconsumption, data_analysis.py:257-263).
+    fig, ax = plt.subplots()
+    ax.bar(agent_ids, summary["self_consumption_ratio"] * 100, 0.35)
+    ax.set_title("Self consumption")
+    ax.set_xlabel("Agent")
+    ax.set_ylabel("%")
+    figures["self_consumption"] = fig
+
+    # Grid load day x slot heatmap (plot_grid_load, data_analysis.py:266-304).
+    fig, ax = plt.subplots()
+    grid_power = power.sum(axis=-1) * 1e-3  # [D, T] kW
+    pcm = ax.pcolormesh(grid_power, cmap="magma")
+    ax.set_title("Grid load")
+    ax.set_xlabel("Time slot")
+    ax.set_ylabel("Day")
+    fig.colorbar(pcm, ax=ax, orientation="horizontal", label="Power [kW]")
+    figures["grid_load"] = fig
+
+    # Per-agent day-1 traces (data_analysis.py:212-240).
+    t = np.arange(T) * slot_hours
+    t_in = np.asarray(outputs.t_in)
+    hp = np.asarray(outputs.hp_power_w)
+    pv = np.asarray(arrays.pv_w)
+    if t_in.ndim == 2:
+        t_in, hp, pv = t_in[None], hp[None], pv[None]
+    for i in range(A):
+        fig, axes = plt.subplots(3, 1, figsize=(8, 9), sharex=True)
+        axes[0].plot(t, power[0, :, i] * 1e-3, label="Loads")
+        axes[0].plot(t, pv[0, :, i] * 1e-3, label="PV")
+        axes[0].set_ylabel("Power [kW]")
+        axes[0].set_title(f"Agent profiles (agent {i})")
+        axes[0].legend()
+        axes[1].plot(t, t_in[0, :, i])
+        axes[1].axhspan(*comfort_bounds, alpha=0.15, color="green")
+        axes[1].set_ylabel("Temperature [°C]")
+        axes[1].set_title(f"Indoor temperature (agent {i})")
+        axes[2].plot(t, hp[0, :, i])
+        axes[2].set_ylabel("Power [W]")
+        axes[2].set_xlabel("Time [h]")
+        axes[2].set_title(f"Heat pump power (agent {i})")
+        figures[f"agent_{i}"] = fig
+
+    if save_dir:
+        os.makedirs(save_dir, exist_ok=True)
+        for name, fig in figures.items():
+            fig.savefig(os.path.join(save_dir, f"{name}.png"), dpi=120)
+    for fig in figures.values():
+        plt.close(fig)
+    return summary, figures

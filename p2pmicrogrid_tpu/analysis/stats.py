@@ -1,0 +1,142 @@
+"""Statistical significance tests over result tables.
+
+Reference: data_analysis.py:1300-1457 — paired per-day t-tests between
+settings, Levene variance tests and one-way ANOVA across community scales and
+negotiation round counts. Rebuilt generically: the reference hardcodes its
+thesis setting strings; here any list of settings works, with the reference's
+groupings expressible as calls.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+from scipy import stats
+
+
+def daily_cost_table(df):
+    """Pivot test-result rows into a [day x setting] cost table.
+
+    Reference pattern (data_analysis.py:1326-1331): sum cost over slots per
+    (setting, day, agent), then average over agents.
+    """
+    g = (
+        df[["setting", "day", "agent", "cost"]]
+        .groupby(["setting", "day", "agent"]).sum()
+        .groupby(["setting", "day"]).mean()
+    )
+    return g.reset_index().pivot(index="day", columns="setting", values="cost")
+
+
+def mean_cost_per_setting_agent(df):
+    """Per-(setting, agent) mean daily cost (the reference's scale/rounds
+    aggregation, data_analysis.py:1383-1387,1421-1424)."""
+    return (
+        df[["setting", "agent", "day", "cost"]]
+        .groupby(["setting", "agent", "day"]).sum()
+        .groupby(["setting", "agent"]).mean()
+        .reset_index()
+    )
+
+
+def paired_cost_ttest(
+    df, setting_a: str, setting_b: str
+) -> Dict[str, float]:
+    """Paired per-day t-test of total daily cost between two settings
+    (data_analysis.py:1310-1320,1339-1349). Days present in only one setting
+    are dropped (and counted) rather than silently poisoning the test with
+    NaN."""
+    costs = daily_cost_table(df)[[setting_a, setting_b]].dropna()
+    diff = np.asarray(costs[setting_a]) - np.asarray(costs[setting_b])
+    t, p = stats.ttest_1samp(diff, 0)
+    return {
+        "mean_diff": float(diff.mean()),
+        "t": float(t),
+        "p": float(p),
+        "n_days": int(len(diff)),
+    }
+
+
+def statistics_community_scale(
+    df, settings: Optional[Sequence[str]] = None
+) -> Dict[str, float]:
+    """Levene + ANOVA of per-agent mean cost across community sizes
+    (data_analysis.py:1378-1401). Setting strings must start with the agent
+    count (the reference's ``{n}-multi-agent-...`` naming)."""
+    if settings is not None:
+        df = df[df["setting"].isin(list(settings))]
+    costs = mean_cost_per_setting_agent(df)
+    costs["agents"] = costs["setting"].map(
+        lambda s: int(re.match(r"^([0-9]+)-", s).groups()[0])
+    )
+    samples = [
+        np.asarray(costs.loc[costs["agents"] == n, "cost"])
+        for n in sorted(costs["agents"].unique())
+    ]
+    _, p_levene = stats.levene(*samples)
+    _, p_anova = stats.f_oneway(*samples)
+    out = {"p_levene": float(p_levene), "p_anova": float(p_anova)}
+    if len(samples) > 2:
+        _, p_reduced = stats.f_oneway(*samples[1:])
+        out["p_anova_without_smallest"] = float(p_reduced)
+    return out
+
+
+def statistics_nr_rounds(
+    df, settings: Optional[Sequence[str]] = None
+) -> Dict[str, float]:
+    """Levene + ANOVA + pairwise t-tests across negotiation round counts
+    (data_analysis.py:1404-1437). Settings follow the reference naming
+    ``...rounds-{r}-...``."""
+    if settings is not None:
+        df = df[df["setting"].isin(list(settings))]
+    costs = mean_cost_per_setting_agent(df)
+    costs["rounds"] = costs["setting"].map(
+        lambda s: int(re.search(r"rounds-([0-9]+)", s).groups()[0])
+    )
+    rounds_sorted = sorted(costs["rounds"].unique())
+    samples = [
+        np.asarray(costs.loc[costs["rounds"] == r, "cost"]) for r in rounds_sorted
+    ]
+    _, p_levene = stats.levene(*samples)
+    _, p_anova = stats.f_oneway(*samples)
+    out = {"p_levene": float(p_levene), "p_anova": float(p_anova)}
+    for i in range(len(samples)):
+        for j in range(i + 1, len(samples)):
+            _, p = stats.ttest_ind(samples[i], samples[j])
+            out[f"p_rounds_{rounds_sorted[i]}_vs_{rounds_sorted[j]}"] = float(p)
+    return out
+
+
+def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]:
+    """Run the available test battery over a ResultsStore's test results
+    (the reference's ``statistical_tests`` driver, data_analysis.py:1440-1457).
+
+    ``settings_pairs``: optional list of (setting_a, setting_b) for paired
+    t-tests. Scale/rounds analyses run when >= 2 matching settings exist.
+    """
+    df = store.get_test_results()
+    results: Dict[str, Dict[str, float]] = {}
+    if df.empty:
+        return results
+
+    for a, b in settings_pairs or []:
+        results[f"ttest[{a} vs {b}]"] = paired_cost_ttest(df, a, b)
+
+    scale_settings = sorted(
+        {
+            s
+            for s in df["setting"].unique()
+            if re.match(r"^[0-9]+-", s)
+        }
+    )
+    if len({re.match(r"^([0-9]+)-", s).groups()[0] for s in scale_settings}) >= 2:
+        results["community_scale"] = statistics_community_scale(df, scale_settings)
+
+    rounds_settings = [s for s in df["setting"].unique() if re.search(r"rounds-[0-9]+", s)]
+    if len({re.search(r"rounds-([0-9]+)", s).groups()[0] for s in rounds_settings}) >= 2:
+        results["nr_rounds"] = statistics_nr_rounds(df, rounds_settings)
+
+    return results

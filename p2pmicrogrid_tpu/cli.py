@@ -1,0 +1,276 @@
+"""Command-line interface.
+
+The reference has no CLI at all — ``microgrid/__main__.py`` is empty and
+functionality is toggled by editing commented-out lines (community.py:430-440,
+data_analysis.py:1633-1645). This module is the typed-config + real-CLI
+replacement mandated by SURVEY.md section 5 ("Config / flag system").
+
+Subcommands:
+  train     train a community (tabular/dqn/ddpg), checkpoint, log progress
+  eval      load a checkpoint, run greedy per-day evaluation, persist results
+  baseline  run the rule-based thermostat baseline over the test days
+  bench     run the benchmark and print its JSON line
+  analyse   render figures + run the statistics battery from a results DB
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _build_cfg(args) -> "ExperimentConfig":
+    from p2pmicrogrid_tpu.config import (
+        BatteryConfig,
+        SimConfig,
+        TrainConfig,
+        default_config,
+    )
+
+    return default_config(
+        sim=SimConfig(
+            n_agents=args.agents,
+            rounds=args.rounds,
+            homogeneous=args.homogeneous,
+            n_scenarios=getattr(args, "scenarios", 1),
+        ),
+        battery=BatteryConfig(enabled=args.battery),
+        train=TrainConfig(
+            max_episodes=args.episodes,
+            implementation=args.implementation,
+            seed=args.seed,
+            episodes_per_jit_block=getattr(args, "jit_block", 1),
+        ),
+    )
+
+
+def _load_traces(args):
+    from p2pmicrogrid_tpu.data import (
+        load_reference_db,
+        synthetic_traces,
+        train_validation_test_split,
+    )
+
+    if args.db:
+        traces = load_reference_db(args.db)
+    else:
+        traces = synthetic_traces(seed=args.seed)
+    return train_validation_test_split(traces)
+
+
+def cmd_train(args) -> int:
+    import jax
+
+    from p2pmicrogrid_tpu.data import ResultsStore
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.train import (
+        init_policy_state,
+        make_policy,
+        train_community,
+    )
+    from p2pmicrogrid_tpu.train.checkpoint import checkpoint_dir, save_checkpoint
+
+    cfg = _build_cfg(args)
+    train_traces, _, _ = _load_traces(args)
+    rng = np.random.default_rng(cfg.train.seed)
+    ratings = make_ratings(cfg, rng)
+    key = jax.random.PRNGKey(cfg.train.seed)
+    policy = make_policy(cfg)
+    pol_state = init_policy_state(cfg, key)
+
+    store = ResultsStore(args.results_db) if args.results_db else None
+    ckpt_dir = checkpoint_dir(args.model_dir, cfg.setting, cfg.train.implementation)
+
+    def progress(ep, r, e):
+        if store:
+            store.log_training_progress(cfg.setting, cfg.train.implementation, ep, r, e)
+
+    def checkpoint(ep, ps):
+        save_checkpoint(ckpt_dir, ps, ep)
+
+    print(f"setting: {cfg.setting} ({cfg.train.implementation})")
+    result = train_community(
+        cfg, policy, pol_state, train_traces, ratings, key,
+        progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
+    )
+    save_checkpoint(ckpt_dir, result.pol_state, cfg.train.max_episodes - 1)
+    print(
+        f"trained {cfg.train.max_episodes} episodes in {result.train_seconds:.1f}s "
+        f"({result.env_steps_per_sec:.0f} env-steps/s); checkpoint: {ckpt_dir}"
+    )
+    return 0
+
+
+def cmd_eval(args) -> int:
+    import jax
+
+    from p2pmicrogrid_tpu.analysis import analyse_community_output
+    from p2pmicrogrid_tpu.data import ResultsStore, save_eval_outputs
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.train import (
+        evaluate_community,
+        init_policy_state,
+        make_policy,
+    )
+    from p2pmicrogrid_tpu.train.checkpoint import checkpoint_dir, restore_checkpoint
+
+    cfg = _build_cfg(args)
+    _, val_traces, test_traces = _load_traces(args)
+    traces = test_traces if args.test else val_traces
+    rng = np.random.default_rng(cfg.train.seed)
+    ratings = make_ratings(cfg, rng)
+    key = jax.random.PRNGKey(cfg.train.seed)
+    policy = make_policy(cfg)
+
+    template = init_policy_state(cfg, key)
+    ckpt_dir = checkpoint_dir(args.model_dir, cfg.setting, cfg.train.implementation)
+    pol_state, episode = restore_checkpoint(ckpt_dir, template)
+    print(f"restored {ckpt_dir} at episode {episode}")
+
+    days, outputs, day_arrays = evaluate_community(
+        cfg, policy, pol_state, traces, ratings, key, rng=rng
+    )
+    costs = np.asarray(outputs.cost).sum(axis=(1, 2))
+    for d, c in zip(days.tolist(), costs.tolist()):
+        print(f"day {d}: community cost {c:+.3f} €")
+
+    if args.results_db:
+        store = ResultsStore(args.results_db)
+        save_eval_outputs(
+            store, cfg.setting, cfg.train.implementation, args.test, days, outputs, day_arrays
+        )
+        print(f"results -> {args.results_db}")
+    if args.figures_dir:
+        summary, _ = analyse_community_output(days, outputs, day_arrays, save_dir=args.figures_dir)
+        print(f"figures -> {args.figures_dir}")
+        print(json.dumps({k: v.tolist() for k, v in summary.items()}, indent=2))
+    return 0
+
+
+def cmd_baseline(args) -> int:
+    import jax
+
+    from p2pmicrogrid_tpu.data import ResultsStore
+    from p2pmicrogrid_tpu.envs import (
+        build_episode_arrays,
+        init_physical,
+        make_ratings,
+        rule_baseline_episode,
+    )
+
+    cfg = _build_cfg(args)
+    _, val_traces, test_traces = _load_traces(args)
+    traces = test_traces if args.test else val_traces
+    rng = np.random.default_rng(cfg.train.seed)
+    ratings = make_ratings(cfg, rng)
+
+    store = ResultsStore(args.results_db) if args.results_db else None
+    for day, day_traces in sorted(traces.split_by_day().items()):
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        phys = init_physical(cfg, jax.random.PRNGKey(cfg.train.seed))
+        _, out = rule_baseline_episode(cfg, phys, arrays)
+        cost = float(np.asarray(out.cost).sum())
+        print(f"day {day}: rule-based community cost {cost:+.3f} €")
+        if store:
+            store.log_run_results(
+                "rule-based",
+                "rule-based",
+                args.test,
+                day,
+                time=np.asarray(arrays.time),
+                load=np.asarray(arrays.load_w),
+                pv=np.asarray(arrays.pv_w),
+                temperature=np.asarray(out.t_in),
+                heatpump=np.asarray(out.hp_power_w),
+                cost=np.asarray(out.cost),
+            )
+    return 0
+
+
+def cmd_bench(args) -> int:
+    from p2pmicrogrid_tpu.benchmarks import main as bench_main
+
+    bench_main()
+    return 0
+
+
+def cmd_analyse(args) -> int:
+    from p2pmicrogrid_tpu.analysis import (
+        plot_cost_comparison,
+        plot_learning_curves,
+        statistical_tests,
+    )
+    from p2pmicrogrid_tpu.data import ResultsStore
+
+    store = ResultsStore(args.results_db)
+    out = statistical_tests(store)
+    print(json.dumps(out, indent=2, default=float))
+    if args.figures_dir:
+        import os
+
+        os.makedirs(args.figures_dir, exist_ok=True)
+        progress = store.get_training_progress()
+        if not progress.empty:
+            plot_learning_curves(progress).savefig(
+                f"{args.figures_dir}/learning_curves.png", dpi=120
+            )
+        tests = store.get_test_results()
+        if not tests.empty:
+            plot_cost_comparison(tests).savefig(
+                f"{args.figures_dir}/cost_comparison.png", dpi=120
+            )
+        print(f"figures -> {args.figures_dir}")
+    return 0
+
+
+def _add_common(p: argparse.ArgumentParser, train_knobs: bool = True) -> None:
+    p.add_argument("--agents", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--homogeneous", action="store_true")
+    p.add_argument("--battery", action="store_true")
+    p.add_argument("--implementation", choices=["tabular", "dqn", "ddpg"], default="tabular")
+    p.add_argument("--episodes", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--db", help="reference SQLite measurement DB (default: synthetic)")
+    p.add_argument("--results-db", help="SQLite results store path")
+    p.add_argument("--model-dir", default="./models")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="p2pmicrogrid-tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("train", help="train a community")
+    _add_common(p)
+    p.add_argument("--jit-block", type=int, default=1, dest="jit_block")
+    p.add_argument("--scenarios", type=int, default=1)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("eval", help="evaluate a trained community per day")
+    _add_common(p)
+    p.add_argument("--test", action="store_true", help="test days (default: validation)")
+    p.add_argument("--figures-dir")
+    p.set_defaults(fn=cmd_eval)
+
+    p = sub.add_parser("baseline", help="rule-based thermostat baseline")
+    _add_common(p)
+    p.add_argument("--test", action="store_true")
+    p.set_defaults(fn=cmd_baseline)
+
+    p = sub.add_parser("bench", help="run the benchmark")
+    p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("analyse", help="statistics + figures from a results DB")
+    p.add_argument("--results-db", required=True)
+    p.add_argument("--figures-dir")
+    p.set_defaults(fn=cmd_analyse)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,29 @@ class DDPGConfig:
 
 
 @dataclass(frozen=True)
+class ForecastConfig:
+    """Windowed load/PV forecaster (reference: microgrid/ml.py).
+
+    Window input_width = shift = label_width = 3 (ml.py:198-201); model
+    Dense(20)-Dense(100)-LSTM(100)x2(shared)-Dense(20)-Dense(2, sigmoid)
+    (ml.py:209-229); MSE + Adam 1e-4, 200 epochs (ml.py:245-284, batches of
+    32 via tf.data default).
+    """
+
+    input_width: int = 3
+    label_width: int = 3
+    shift: int = 3
+    hidden_pre: int = 20
+    hidden_mid: int = 100
+    lstm_features: int = 100
+    hidden_post: int = 20
+    n_targets: int = 2
+    learning_rate: float = 1e-4
+    batch_size: int = 32
+    epochs: int = 200
+
+
+@dataclass(frozen=True)
 class SimConfig:
     """Simulation time base and community shape.
 
@@ -225,6 +248,7 @@ class ExperimentConfig:
     qlearning: QLearningConfig = QLearningConfig()
     dqn: DQNConfig = DQNConfig()
     ddpg: DDPGConfig = DDPGConfig()
+    forecast: ForecastConfig = ForecastConfig()
     train: TrainConfig = TrainConfig()
 
     @property

@@ -13,6 +13,7 @@ from p2pmicrogrid_tpu.data.traces import (
     train_validation_test_split,
     agent_profiles,
 )
+from p2pmicrogrid_tpu.data.results import ResultsStore, save_eval_outputs
 
 __all__ = [
     "TraceSet",
@@ -20,4 +21,6 @@ __all__ = [
     "load_reference_db",
     "train_validation_test_split",
     "agent_profiles",
+    "ResultsStore",
+    "save_eval_outputs",
 ]

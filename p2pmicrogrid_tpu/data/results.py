@@ -1,0 +1,278 @@
+"""Relational results store (SQLite) — the metrics/observability backend.
+
+Keeps the reference's schema (microgrid/database.py:28-81) so its whole
+analysis layer's data model carries over: per-slot validation/test traces,
+per-round decisions, training progress, and the single-day sweep tables. Two
+reference defects are fixed rather than copied (SURVEY.md section 7):
+``training_progress`` gets a CREATE TABLE (the reference inserts into a table
+it never creates, database.py:202 vs 28-81), and nothing references undefined
+globals (database.py:96-125's ``conn``).
+
+The loggers accept numpy arrays straight from the simulator's ``SlotOutputs``
+(envs/community.py) — the bridge from device land to the relational store.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Optional, Sequence
+
+import numpy as np
+
+_DDL = [
+    # Measurement ingest tables (database.py:31-43).
+    """CREATE TABLE IF NOT EXISTS environment
+       (date text NOT NULL, time text NOT NULL, utc text NOT NULL,
+        temperature real, cloud_cover real, humidity real, irradiation real,
+        pv real,
+        PRIMARY KEY (date, time, utc))""",
+    """CREATE TABLE IF NOT EXISTS load
+       (date text NOT NULL, time text NOT NULL, utc text NOT NULL,
+        l0 real, l1 real, l2 real, l3 real, l4 real,
+        PRIMARY KEY (date, time, utc))""",
+    # Sweep tables (database.py:45-57).
+    """CREATE TABLE IF NOT EXISTS hyperparameters_single_day
+       (settings text NOT NULL, trial integer NOT NULL,
+        episode integer NOT NULL, training real NOT NULL,
+        validation real NOT NULL,
+        PRIMARY KEY (settings, trial, episode))""",
+    """CREATE TABLE IF NOT EXISTS single_day_best_results
+       (settings text NOT NULL, date text NOT NULL, time text NOT NULL,
+        load real, pv real, target_load real, target_pv real,
+        PRIMARY KEY (settings, date, time))""",
+    # Run results (database.py:59-78).
+    """CREATE TABLE IF NOT EXISTS validation_results
+       (setting text NOT NULL, implementation text NOT NULL,
+        agent integer NOT NULL, day integer NOT NULL, time real NOT NULL,
+        load real, pv real, temperature real, heatpump real, cost real,
+        PRIMARY KEY (setting, implementation, agent, day, time))""",
+    """CREATE TABLE IF NOT EXISTS test_results
+       (setting text NOT NULL, implementation text NOT NULL,
+        agent integer NOT NULL, day integer NOT NULL, time real NOT NULL,
+        load real, pv real, temperature real, heatpump real, cost real,
+        PRIMARY KEY (setting, implementation, agent, day, time))""",
+    """CREATE TABLE IF NOT EXISTS rounds_comparison
+       (setting text NOT NULL, agent integer NOT NULL, day integer NOT NULL,
+        time real NOT NULL, round integer NOT NULL, decision real,
+        PRIMARY KEY (setting, agent, day, time, round))""",
+    # Missing in the reference (used at database.py:196-209 but never created).
+    """CREATE TABLE IF NOT EXISTS training_progress
+       (setting text NOT NULL, implementation text NOT NULL,
+        episode integer NOT NULL, reward real, error real,
+        PRIMARY KEY (setting, implementation, episode))""",
+]
+
+
+class ResultsStore:
+    """Thin, explicit wrapper over an SQLite results database."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.con = sqlite3.connect(path)
+        self.create_tables()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create_tables(self) -> None:
+        cur = self.con.cursor()
+        try:
+            for ddl in _DDL:
+                cur.execute(ddl)
+            self.con.commit()
+        finally:
+            cur.close()
+
+    def close(self) -> None:
+        self.con.close()
+
+    def __enter__(self) -> "ResultsStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- writers -----------------------------------------------------------
+
+    def log_training_progress(
+        self,
+        setting: str,
+        implementation: str,
+        episode: int,
+        reward: float,
+        error: float,
+    ) -> None:
+        """Running-average reward/error every decay window
+        (community.py:288, database.py:196-209)."""
+        with self.con:
+            self.con.execute(
+                "INSERT OR REPLACE INTO training_progress VALUES (?,?,?,?,?)",
+                (setting, implementation, episode, float(reward), float(error)),
+            )
+
+    def log_run_results(
+        self,
+        setting: str,
+        implementation: str,
+        is_testing: bool,
+        day: int,
+        time: np.ndarray,
+        load: np.ndarray,
+        pv: np.ndarray,
+        temperature: np.ndarray,
+        heatpump: np.ndarray,
+        cost: np.ndarray,
+    ) -> None:
+        """Per-slot per-agent traces for one evaluated day
+        (community.py:341-356, database.py:226-293).
+
+        Arrays: time [T]; load/pv/temperature/heatpump/cost [T, A].
+        """
+        table = "test_results" if is_testing else "validation_results"
+        t = np.asarray(time, dtype=float)
+        arrs = [np.asarray(a, dtype=float) for a in (load, pv, temperature, heatpump, cost)]
+        n_slots, n_agents = arrs[0].shape
+        records = [
+            (
+                setting,
+                implementation,
+                a,
+                int(day),
+                float(t[s]),
+                *(arr[s, a] for arr in arrs),
+            )
+            for a in range(n_agents)
+            for s in range(n_slots)
+        ]
+        with self.con:
+            self.con.executemany(
+                f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?)",
+                records,
+            )
+
+    def log_rounds_decisions(
+        self,
+        setting: str,
+        day: int,
+        time: np.ndarray,
+        decisions: np.ndarray,
+    ) -> None:
+        """Per-round heat-pump decisions (community.py:358-361,
+        database.py:296-312). decisions: [T, rounds+1, A]."""
+        d = np.asarray(decisions, dtype=float)
+        t = np.asarray(time, dtype=float)
+        n_slots, n_rounds, n_agents = d.shape
+        records = [
+            (setting, a, int(day), float(t[s]), r, d[s, r, a])
+            for a in range(n_agents)
+            for r in range(n_rounds)
+            for s in range(n_slots)
+        ]
+        with self.con:
+            self.con.executemany(
+                "INSERT OR REPLACE INTO rounds_comparison VALUES (?,?,?,?,?,?)",
+                records,
+            )
+
+    def log_sweep_point(
+        self,
+        settings: str,
+        trial: int,
+        episode: int,
+        training: float,
+        validation: float,
+    ) -> None:
+        """Hyperparameter-sweep curve point (database.py:160-173)."""
+        with self.con:
+            self.con.execute(
+                "INSERT OR REPLACE INTO hyperparameters_single_day VALUES (?,?,?,?,?)",
+                (settings, trial, episode, float(training), float(validation)),
+            )
+
+    def log_predictions(
+        self,
+        settings: str,
+        date: Sequence[str],
+        time: Sequence[str],
+        load: Sequence[float],
+        pv: Sequence[float],
+        target_load: Sequence[float],
+        target_pv: Sequence[float],
+    ) -> None:
+        """Forecaster outputs vs targets (database.py:176-193)."""
+        records = [
+            *zip(
+                [settings] * len(load),
+                date,
+                [str(t) for t in time],
+                map(float, load),
+                map(float, pv),
+                map(float, target_load),
+                map(float, target_pv),
+            )
+        ]
+        with self.con:
+            self.con.executemany(
+                "INSERT OR REPLACE INTO single_day_best_results VALUES (?,?,?,?,?,?,?)",
+                records,
+            )
+
+    # -- readers (database.py:212-345) --------------------------------------
+
+    def _read(self, table: str, where: str = "", params: tuple = ()):
+        import pandas as pd
+
+        return pd.read_sql_query(f"SELECT * FROM {table} {where}", self.con, params=params)
+
+    def get_training_progress(self):
+        return self._read("training_progress")
+
+    def get_validation_results(self):
+        return self._read("validation_results")
+
+    def get_test_results(self):
+        return self._read("test_results")
+
+    def get_rounds_decisions(self):
+        return self._read("rounds_comparison")
+
+    def get_sweep_data(self):
+        return self._read("hyperparameters_single_day")
+
+    def get_predictions(self):
+        return self._read("single_day_best_results")
+
+
+def save_eval_outputs(
+    store: ResultsStore,
+    setting: str,
+    implementation: str,
+    is_testing: bool,
+    days: np.ndarray,
+    outputs,
+    arrays_per_day,
+) -> None:
+    """Persist ``evaluate_community`` outputs for every day in one call
+    (the reference's save_community_results, community.py:341-361).
+
+    outputs: SlotOutputs with leaves [D, T, ...]; arrays_per_day: EpisodeArrays
+    with leaves [D, T, ...] (for the load/pv traces).
+    """
+    for i, day in enumerate(np.asarray(days).tolist()):
+        store.log_run_results(
+            setting,
+            implementation,
+            is_testing,
+            day,
+            time=np.asarray(arrays_per_day.time[i]),
+            load=np.asarray(arrays_per_day.load_w[i]),
+            pv=np.asarray(arrays_per_day.pv_w[i]),
+            temperature=np.asarray(outputs.t_in[i]),
+            heatpump=np.asarray(outputs.hp_power_w[i]),
+            cost=np.asarray(outputs.cost[i]),
+        )
+        if is_testing:
+            store.log_rounds_decisions(
+                setting,
+                day,
+                time=np.asarray(arrays_per_day.time[i]),
+                decisions=np.asarray(outputs.decisions[i]),
+            )

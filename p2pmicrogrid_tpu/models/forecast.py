@@ -1,0 +1,186 @@
+"""Load/PV forecasting model (reference: microgrid/ml.py).
+
+The reference trains a windowed LSTM forecaster over November traces: a
+Dense(20)-Dense(100) encoder, an LSTM(100) applied twice (the same layer,
+shared weights, ml.py:216-228), a Dense(20)-Dense(2, sigmoid) head predicting
+normalized (load, pv) for each window step; MSE loss, Adam 1e-4, window
+input_width = shift = label_width = 3 (ml.py:198-201).
+
+Flax/optax rebuild: windows are precomputed host-side into dense arrays (the
+reference's WindowGenerator, ml.py:51-186, replaced by ``make_windows``) and
+the train step is jitted; an epoch is one scanned device call.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from p2pmicrogrid_tpu.config import ForecastConfig
+
+
+class ForecastModel(nn.Module):
+    """Dense(20)-Dense(100) -> shared LSTM(100) x2 -> Dense(20)-Dense(2)
+    (ml.py:209-229)."""
+
+    hidden_pre: int = 20
+    hidden_mid: int = 100
+    lstm_features: int = 100
+    hidden_post: int = 20
+    n_targets: int = 2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """x: [B, W, F] -> [B, W, n_targets]."""
+        h = nn.relu(nn.Dense(self.hidden_pre)(x))
+        h = nn.relu(nn.Dense(self.hidden_mid)(h))
+        lstm = nn.RNN(
+            nn.OptimizedLSTMCell(self.lstm_features), return_carry=False
+        )
+        # The reference inserts the SAME LSTM layer twice: two passes with
+        # shared weights (ml.py:222-227).
+        h = lstm(h)
+        h = lstm(h)
+        h = nn.relu(nn.Dense(self.hidden_post)(h))
+        return nn.sigmoid(nn.Dense(self.n_targets)(h))
+
+
+class ForecastState(NamedTuple):
+    params: dict
+    opt_state: tuple
+
+
+def make_windows(
+    data: np.ndarray,
+    input_width: int,
+    label_width: int,
+    shift: int,
+    label_columns: Optional[Tuple[int, ...]] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Sliding (input, label) windows (WindowGenerator.split_window,
+    ml.py:119-147).
+
+    data: [T, F] time-major features. Inputs take all F features over
+    ``input_width`` steps; labels take ``label_columns`` (default: last 2,
+    the load/pv pair, ml.py:253) over the last ``label_width`` steps of each
+    ``input_width + shift`` window.
+
+    Returns (inputs [N, input_width, F], labels [N, label_width, C]).
+    """
+    total = input_width + shift
+    data = np.asarray(data, dtype=np.float32)
+    T = data.shape[0]
+    n = T - total + 1
+    if n <= 0:
+        raise ValueError(f"need at least {total} steps, have {T}")
+    idx = np.arange(n)[:, None] + np.arange(total)[None, :]
+    windows = data[idx]  # [N, total, F]
+    inputs = windows[:, :input_width, :]
+    labels = windows[:, total - label_width :, :]
+    cols = label_columns if label_columns is not None else tuple(range(data.shape[1] - 2, data.shape[1]))
+    labels = labels[:, :, list(cols)]
+    return inputs, labels
+
+
+def _model(cfg: ForecastConfig) -> ForecastModel:
+    return ForecastModel(
+        hidden_pre=cfg.hidden_pre,
+        hidden_mid=cfg.hidden_mid,
+        lstm_features=cfg.lstm_features,
+        hidden_post=cfg.hidden_post,
+        n_targets=cfg.n_targets,
+    )
+
+
+def forecast_init(
+    cfg: ForecastConfig, n_features: int, key: jax.Array
+) -> ForecastState:
+    model = _model(cfg)
+    params = model.init(key, jnp.zeros((1, cfg.input_width, n_features)))["params"]
+    opt_state = optax.adam(cfg.learning_rate).init(params)
+    return ForecastState(params=params, opt_state=opt_state)
+
+
+def forecast_train_epoch(
+    cfg: ForecastConfig,
+    state: ForecastState,
+    inputs: jnp.ndarray,
+    labels: jnp.ndarray,
+    key: jax.Array,
+) -> Tuple[ForecastState, jnp.ndarray]:
+    """One epoch: shuffle, batch, scan jitted MSE/Adam steps (ml.py:242-284).
+
+    inputs [N, W, F], labels [N, W, C]. Returns (state, mean epoch loss).
+    The trailing partial batch is dropped (static shapes under scan).
+    """
+    model = _model(cfg)
+    opt = optax.adam(cfg.learning_rate)
+    n = inputs.shape[0]
+    bs = min(cfg.batch_size, n)  # short traces: one smaller batch
+    n_batches = n // bs
+
+    perm = jax.random.permutation(key, n)[: n_batches * bs]
+    xb = inputs[perm].reshape(n_batches, bs, *inputs.shape[1:])
+    yb = labels[perm].reshape(n_batches, bs, *labels.shape[1:])
+
+    def step(carry, xy):
+        params, opt_state = carry
+        x, y = xy
+
+        def loss_fn(p):
+            pred = model.apply({"params": p}, x)
+            return jnp.mean(jnp.square(pred - y))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    (params, opt_state), losses = jax.lax.scan(
+        step, (state.params, state.opt_state), (xb, yb)
+    )
+    return ForecastState(params=params, opt_state=opt_state), jnp.mean(losses)
+
+
+def forecast_predict(
+    cfg: ForecastConfig, state: ForecastState, inputs: jnp.ndarray
+) -> jnp.ndarray:
+    """Predictions [N, W, C] for windows [N, W, F]."""
+    return _model(cfg).apply({"params": state.params}, inputs)
+
+
+def train_forecaster(
+    cfg: ForecastConfig,
+    train_inputs: np.ndarray,
+    train_labels: np.ndarray,
+    key: jax.Array,
+    val_inputs: Optional[np.ndarray] = None,
+    val_labels: Optional[np.ndarray] = None,
+    verbose: bool = False,
+):
+    """The reference's 200-epoch training driver (ml.py:265-284)."""
+    state = forecast_init(cfg, train_inputs.shape[-1], key)
+    epoch_fn = jax.jit(
+        lambda st, k: forecast_train_epoch(
+            cfg, st, jnp.asarray(train_inputs), jnp.asarray(train_labels), k
+        )
+    )
+    history = []
+    for epoch in range(cfg.epochs):
+        key, k = jax.random.split(key)
+        state, loss = epoch_fn(state, k)
+        train_l = float(loss)
+        val_l = None
+        if val_inputs is not None:
+            pred = forecast_predict(cfg, state, jnp.asarray(val_inputs))
+            val_l = float(jnp.mean(jnp.square(pred - jnp.asarray(val_labels))))
+        history.append((train_l, val_l))
+        if verbose and epoch % 10 == 0:
+            print(f"epoch {epoch}: train mse {train_l:.5f}"
+                  + (f", val mse {val_l:.5f}" if val_l is not None else ""))
+    return state, history

@@ -18,8 +18,18 @@ from p2pmicrogrid_tpu.train.loop import (
     evaluate_community,
     init_dqn_buffers,
 )
+from p2pmicrogrid_tpu.train.checkpoint import (
+    checkpoint_dir,
+    save_checkpoint,
+    restore_checkpoint,
+    latest_checkpoint,
+)
 
 __all__ = [
+    "checkpoint_dir",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_checkpoint",
     "make_tabular_policy",
     "make_dqn_policy",
     "make_ddpg_policy",

@@ -1,0 +1,87 @@
+"""Checkpoint/resume via Orbax.
+
+The reference persists each agent's actor separately — tabular Q as ``.npy``
+(rl.py:83-87), DQN as Keras weight files plus ``_target`` copies
+(rl.py:164-168,278-282) — named by the experiment setting string
+(agent.py:248-252), saved every ``save_episodes`` episodes
+(community.py:290-298). Here the unit of persistence is the whole community
+learner state (one PyTree: all agents' params/targets/optimizers/replay plus
+the episode counter), which restores atomically — no per-agent file skew.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def checkpoint_dir(base_dir: str, setting: str, implementation: str) -> str:
+    """Directory naming mirrors the reference's ``models_{impl}/{setting}``
+    layout (rl.py:84-87)."""
+    return os.path.join(
+        os.path.abspath(base_dir), f"models_{implementation}", setting.replace("-", "_")
+    )
+
+
+def save_checkpoint(
+    path: str, pol_state, episode: int, keep_old: bool = False
+) -> str:
+    """Write the learner state + episode counter. Returns the step path."""
+    ckptr = _checkpointer()
+    step_path = os.path.join(os.path.abspath(path), f"ep_{episode}")
+    payload = {
+        "pol_state": jax.tree_util.tree_map(np.asarray, pol_state),
+        "episode": episode,
+    }
+    ckptr.save(step_path, payload, force=True)
+    if not keep_old:
+        # Prune everything EXCEPT the step just written (not the max-numbered
+        # one: a stale higher-episode dir from a previous run must not survive
+        # and shadow this save).
+        import shutil
+
+        keep = os.path.basename(step_path)
+        for d in os.listdir(path):
+            if d.startswith("ep_") and d != keep:
+                shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+    return step_path
+
+
+def latest_checkpoint(path: str) -> Optional[str]:
+    if not os.path.isdir(path):
+        return None
+    steps = [d for d in os.listdir(path) if d.startswith("ep_")]
+    if not steps:
+        return None
+    return os.path.join(path, max(steps, key=lambda d: int(d.split("_")[1])))
+
+
+def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
+    """Restore (pol_state, episode) from the newest step under ``path``.
+
+    ``template_pol_state`` provides the PyTree structure/dtypes (e.g. a fresh
+    ``init_policy_state`` result).
+    """
+    step_path = latest_checkpoint(path)
+    if step_path is None:
+        raise FileNotFoundError(f"no checkpoint under {path}")
+    ckptr = _checkpointer()
+    template = {
+        "pol_state": jax.tree_util.tree_map(np.asarray, template_pol_state),
+        "episode": 0,
+    }
+    restored = ckptr.restore(step_path, item=template)
+    # Rebuild the original NamedTuple/PyTree structure with restored leaves.
+    _, treedef = jax.tree_util.tree_flatten(template_pol_state)
+    restored_leaves = jax.tree_util.tree_leaves(restored["pol_state"])
+    pol_state = jax.tree_util.tree_unflatten(treedef, restored_leaves)
+    return pol_state, int(restored["episode"])

@@ -249,8 +249,9 @@ def evaluate_community(
     per-agent load/PV profile scales are re-drawn ~N(0.7,0.2)/N(4,0.2) kW
     (homogeneous: fixed means), independent of the training ratings.
 
-    Returns (days, outputs) where every SlotOutputs leaf has shape
-    [n_days, slots_per_day, ...].
+    Returns (days, outputs, day_arrays): SlotOutputs leaves are
+    [n_days, slots_per_day, ...]; day_arrays are the stacked per-day
+    EpisodeArrays (same leading shape) for persisting load/PV traces.
     """
     by_day = traces.split_by_day()
     days = np.array(sorted(by_day), dtype=np.int32)
@@ -286,4 +287,4 @@ def evaluate_community(
 
     keys = jax.random.split(key, len(days))
     outputs = eval_all(pol_state, keys)
-    return days, outputs
+    return days, outputs, stacked

@@ -1,0 +1,50 @@
+"""Checkpoint round-trip tests (SURVEY.md section 4 oracle d)."""
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import DQNConfig, SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.train import init_policy_state
+from p2pmicrogrid_tpu.train.checkpoint import (
+    checkpoint_dir,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+@pytest.mark.parametrize("impl", ["tabular", "dqn", "ddpg"])
+def test_roundtrip(tmp_path, impl):
+    cfg = default_config(
+        sim=SimConfig(n_agents=2),
+        train=TrainConfig(implementation=impl),
+        dqn=DQNConfig(buffer_size=32),
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    path = checkpoint_dir(str(tmp_path), cfg.setting, impl)
+    save_checkpoint(path, ps, episode=7)
+
+    template = init_policy_state(cfg, jax.random.PRNGKey(99))  # different init
+    restored, episode = restore_checkpoint(path, template)
+    assert episode == 7
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ps), jax.tree_util.tree_leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_latest_checkpoint_keeps_newest(tmp_path):
+    cfg = default_config(sim=SimConfig(n_agents=2))
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    path = checkpoint_dir(str(tmp_path), cfg.setting, "tabular")
+    save_checkpoint(path, ps, episode=10)
+    save_checkpoint(path, ps, episode=20)
+    assert latest_checkpoint(path).endswith("ep_20")
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    cfg = default_config(sim=SimConfig(n_agents=2))
+    template = init_policy_state(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path / "nope"), template)

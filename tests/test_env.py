@@ -280,9 +280,10 @@ class TestEvaluation:
         ratings = make_ratings(cfg, rng)
         policy = make_policy(cfg)
         ps = init_policy_state(cfg, jax.random.PRNGKey(1))
-        days, out = evaluate_community(
+        days, out, day_arrays = evaluate_community(
             cfg, policy, ps, traces, ratings, jax.random.PRNGKey(0), rng=rng
         )
         assert days.tolist() == [8, 9, 10]
         assert out.cost.shape == (3, 96, 2)
         assert np.isfinite(np.asarray(out.cost)).all()
+        assert day_arrays.load_w.shape == (3, 96, 2)

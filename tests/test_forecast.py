@@ -1,0 +1,74 @@
+"""Forecaster tests (reference capability: microgrid/ml.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import ForecastConfig
+from p2pmicrogrid_tpu.data import synthetic_traces
+from p2pmicrogrid_tpu.models.forecast import (
+    forecast_init,
+    forecast_predict,
+    forecast_train_epoch,
+    make_windows,
+    train_forecaster,
+)
+
+
+class TestWindows:
+    def test_shapes(self):
+        data = np.arange(40, dtype=np.float32).reshape(10, 4)
+        x, y = make_windows(data, input_width=3, label_width=3, shift=3)
+        # N = 10 - 6 + 1 = 5 windows.
+        assert x.shape == (5, 3, 4)
+        assert y.shape == (5, 3, 2)
+
+    def test_label_alignment(self):
+        # Labels are the last label_width rows of each window, last 2 cols.
+        data = np.arange(40, dtype=np.float32).reshape(10, 4)
+        x, y = make_windows(data, input_width=3, label_width=3, shift=3)
+        np.testing.assert_array_equal(x[0], data[0:3])
+        np.testing.assert_array_equal(y[0], data[3:6, 2:4])
+
+    def test_too_short_raises(self):
+        with pytest.raises(ValueError, match="at least"):
+            make_windows(np.zeros((4, 2), np.float32), 3, 3, 3)
+
+
+class TestModel:
+    def setup_method(self):
+        self.cfg = ForecastConfig(epochs=2, batch_size=8)
+        traces = synthetic_traces(n_days=2, start_day=11).normalized()
+        data = np.stack(
+            [traces.time, traces.t_out / 20.0, traces.load[:, 0], traces.pv[:, 0]],
+            axis=1,
+        )
+        self.x, self.y = make_windows(
+            data, self.cfg.input_width, self.cfg.label_width, self.cfg.shift
+        )
+
+    def test_output_shape_and_range(self):
+        st = forecast_init(self.cfg, self.x.shape[-1], jax.random.PRNGKey(0))
+        pred = forecast_predict(self.cfg, st, jnp.asarray(self.x[:5]))
+        assert pred.shape == (5, 3, 2)
+        assert float(pred.min()) >= 0.0
+        assert float(pred.max()) <= 1.0  # sigmoid head (ml.py:228)
+
+    def test_epoch_reduces_loss(self):
+        st = forecast_init(self.cfg, self.x.shape[-1], jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        _, l0 = forecast_train_epoch(self.cfg, st, jnp.asarray(self.x), jnp.asarray(self.y), key)
+        st2, _ = forecast_train_epoch(self.cfg, st, jnp.asarray(self.x), jnp.asarray(self.y), key)
+        for _ in range(10):
+            key, k = jax.random.split(key)
+            st2, l = forecast_train_epoch(self.cfg, st2, jnp.asarray(self.x), jnp.asarray(self.y), k)
+        assert float(l) < float(l0)
+
+    def test_train_driver(self):
+        st, history = train_forecaster(
+            self.cfg, self.x, self.y, jax.random.PRNGKey(0),
+            val_inputs=self.x[:10], val_labels=self.y[:10],
+        )
+        assert len(history) == 2
+        assert history[-1][1] is not None

@@ -1,0 +1,158 @@
+"""Results-store and analysis-layer tests.
+
+End-to-end oracle: train briefly, evaluate, persist to the relational store,
+read back, and run the statistics/plots on real (tiny) data.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from p2pmicrogrid_tpu.analysis import (
+    analyse_community_output,
+    community_summary,
+    paired_cost_ttest,
+    plot_cost_comparison,
+    plot_day_traces,
+    plot_learning_curves,
+    plot_qtable_heatmap,
+    plot_rounds_decisions,
+    statistical_tests,
+)
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.data import ResultsStore, save_eval_outputs, synthetic_traces
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.train import evaluate_community, init_policy_state, make_policy
+
+
+@pytest.fixture(scope="module")
+def eval_run():
+    """One tiny eval run persisted under two fake settings."""
+    cfg = default_config(
+        sim=SimConfig(n_agents=2),
+        train=TrainConfig(max_episodes=1, implementation="tabular"),
+    )
+    traces = synthetic_traces(n_days=3, start_day=8).normalized()
+    rng = np.random.default_rng(42)
+    ratings = make_ratings(cfg, rng)
+    policy = make_policy(cfg)
+    ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+    days, outputs, day_arrays = evaluate_community(
+        cfg, policy, ps, traces, ratings, jax.random.PRNGKey(0), rng=rng
+    )
+
+    store = ResultsStore(":memory:")
+    for setting in ("2-multi-agent-com-rounds-1-hetero", "3-multi-agent-com-rounds-2-hetero"):
+        save_eval_outputs(store, setting, "tabular", True, days, outputs, day_arrays)
+        save_eval_outputs(store, setting, "tabular", False, days, outputs, day_arrays)
+    for ep in range(0, 200, 50):
+        store.log_training_progress(
+            "2-multi-agent-com-rounds-1-hetero", "tabular", ep, -30000 + 100 * ep, 1.0
+        )
+    return cfg, store, days, outputs, day_arrays, ps
+
+
+class TestResultsStore:
+    def test_tables_exist_including_training_progress(self):
+        store = ResultsStore(":memory:")
+        rows = store.con.execute(
+            "SELECT name FROM sqlite_master WHERE type='table'"
+        ).fetchall()
+        names = {r[0] for r in rows}
+        assert {
+            "environment",
+            "load",
+            "hyperparameters_single_day",
+            "single_day_best_results",
+            "validation_results",
+            "test_results",
+            "rounds_comparison",
+            "training_progress",  # missing DDL in the reference, fixed here
+        } <= names
+
+    def test_roundtrip_test_results(self, eval_run):
+        _, store, days, outputs, _, _ = eval_run
+        df = store.get_test_results()
+        n_days, T, A = np.asarray(outputs.cost).shape
+        assert len(df) == 2 * n_days * T * A  # two settings
+        # Costs survive the round trip.
+        got = df[
+            (df["setting"] == "2-multi-agent-com-rounds-1-hetero")
+            & (df["day"] == int(days[0]))
+            & (df["agent"] == 0)
+        ].sort_values("time")["cost"].to_numpy()
+        np.testing.assert_allclose(got, np.asarray(outputs.cost)[0, :, 0], rtol=1e-6)
+
+    def test_rounds_decisions_roundtrip(self, eval_run):
+        cfg, store, days, outputs, _, _ = eval_run
+        df = store.get_rounds_decisions()
+        assert set(df["round"].unique()) == set(range(cfg.sim.rounds + 1))
+
+    def test_training_progress_roundtrip(self, eval_run):
+        _, store, *_ = eval_run
+        df = store.get_training_progress()
+        assert len(df) == 4
+        assert df["episode"].tolist() == [0, 50, 100, 150]
+
+
+class TestReport:
+    def test_summary_shapes_and_sanity(self, eval_run):
+        cfg, _, _, outputs, day_arrays, _ = eval_run
+        s = community_summary(outputs, day_arrays)
+        A = cfg.sim.n_agents
+        for k, v in s.items():
+            assert v.shape == (A,), k
+        assert (s["self_consumption_ratio"] <= 1.0 + 1e-6).all()
+        assert (s["pv_energy_kwh"] > 0).all()
+
+    def test_figures_render_and_save(self, eval_run, tmp_path):
+        _, _, days, outputs, day_arrays, _ = eval_run
+        summary, figs = analyse_community_output(
+            days, outputs, day_arrays, save_dir=str(tmp_path)
+        )
+        assert {"costs", "self_consumption", "grid_load", "agent_0", "agent_1"} <= set(figs)
+        assert (tmp_path / "grid_load.png").exists()
+
+
+class TestStats:
+    def test_paired_ttest(self, eval_run):
+        _, store, *_ = eval_run
+        df = store.get_test_results()
+        r = paired_cost_ttest(
+            df, "2-multi-agent-com-rounds-1-hetero", "3-multi-agent-com-rounds-2-hetero"
+        )
+        # Identical data -> zero diff, p is nan (0/0) or 1; mean_diff must be 0.
+        assert r["mean_diff"] == pytest.approx(0.0)
+
+    def test_battery_runs_on_store(self, eval_run):
+        _, store, *_ = eval_run
+        out = statistical_tests(store)
+        assert "community_scale" in out
+        assert "nr_rounds" in out
+        assert 0 <= out["community_scale"]["p_anova"] <= 1 or np.isnan(
+            out["community_scale"]["p_anova"]
+        )
+
+
+class TestPlots:
+    def test_all_plots_render(self, eval_run):
+        cfg, store, days, _, _, ps = eval_run
+        assert plot_learning_curves(store.get_training_progress()) is not None
+        assert plot_cost_comparison(store.get_test_results()) is not None
+        assert (
+            plot_day_traces(
+                store.get_test_results(),
+                "2-multi-agent-com-rounds-1-hetero",
+                int(days[0]),
+            )
+            is not None
+        )
+        assert (
+            plot_rounds_decisions(
+                store.get_rounds_decisions(),
+                "2-multi-agent-com-rounds-1-hetero",
+                int(days[0]),
+            )
+            is not None
+        )
+        assert plot_qtable_heatmap(np.asarray(ps.q_table)[0]) is not None
