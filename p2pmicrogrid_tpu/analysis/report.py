@@ -113,7 +113,8 @@ def analyse_community_output(
     fig.colorbar(pcm, ax=ax, orientation="horizontal", label="Power [kW]")
     figures["grid_load"] = fig
 
-    # Per-agent day-1 traces (data_analysis.py:212-240).
+    # Per-agent traces for the first evaluated day (data_analysis.py:212-240).
+    day0 = int(np.asarray(days).reshape(-1)[0]) if days is not None else 0
     t = np.arange(T) * slot_hours
     t_in = np.asarray(outputs.t_in)
     hp = np.asarray(outputs.hp_power_w)
@@ -125,16 +126,16 @@ def analyse_community_output(
         axes[0].plot(t, power[0, :, i] * 1e-3, label="Loads")
         axes[0].plot(t, pv[0, :, i] * 1e-3, label="PV")
         axes[0].set_ylabel("Power [kW]")
-        axes[0].set_title(f"Agent profiles (agent {i})")
+        axes[0].set_title(f"Agent profiles (agent {i}, day {day0})")
         axes[0].legend()
         axes[1].plot(t, t_in[0, :, i])
         axes[1].axhspan(*comfort_bounds, alpha=0.15, color="green")
         axes[1].set_ylabel("Temperature [°C]")
-        axes[1].set_title(f"Indoor temperature (agent {i})")
+        axes[1].set_title(f"Indoor temperature (agent {i}, day {day0})")
         axes[2].plot(t, hp[0, :, i])
         axes[2].set_ylabel("Power [W]")
         axes[2].set_xlabel("Time [h]")
-        axes[2].set_title(f"Heat pump power (agent {i})")
+        axes[2].set_title(f"Heat pump power (agent {i}, day {day0})")
         figures[f"agent_{i}"] = fig
 
     if save_dir:

@@ -16,38 +16,58 @@ import numpy as np
 from scipy import stats
 
 
+def _labelled(df):
+    """Add a run-identity ``label`` column: the setting alone when only one
+    implementation was stored under it, else ``setting[implementation]`` —
+    so baseline and RL runs sharing a setting never aggregate together."""
+    df = df.copy()
+    multi = df.groupby("setting")["implementation"].nunique()
+    df["label"] = np.where(
+        df["setting"].map(multi) > 1,
+        df["setting"] + "[" + df["implementation"] + "]",
+        df["setting"],
+    )
+    return df
+
+
 def daily_cost_table(df):
-    """Pivot test-result rows into a [day x setting] cost table.
+    """Pivot test-result rows into a [day x run-label] cost table.
 
     Reference pattern (data_analysis.py:1326-1331): sum cost over slots per
-    (setting, day, agent), then average over agents.
+    (run, day, agent), then average over agents. Rows are grouped by
+    (setting, implementation) so two implementations stored under one setting
+    (e.g. 'rule-based' baseline vs 'tabular' eval) stay separate columns
+    instead of being summed together.
     """
     g = (
-        df[["setting", "day", "agent", "cost"]]
-        .groupby(["setting", "day", "agent"]).sum()
-        .groupby(["setting", "day"]).mean()
+        _labelled(df)[["label", "day", "agent", "cost"]]
+        .groupby(["label", "day", "agent"]).sum()
+        .groupby(["label", "day"]).mean()
     )
-    return g.reset_index().pivot(index="day", columns="setting", values="cost")
+    return g.reset_index().pivot(index="day", columns="label", values="cost")
 
 
 def mean_cost_per_setting_agent(df):
-    """Per-(setting, agent) mean daily cost (the reference's scale/rounds
+    """Per-(run-label, agent) mean daily cost (the reference's scale/rounds
     aggregation, data_analysis.py:1383-1387,1421-1424)."""
-    return (
-        df[["setting", "agent", "day", "cost"]]
-        .groupby(["setting", "agent", "day"]).sum()
-        .groupby(["setting", "agent"]).mean()
+    out = (
+        _labelled(df)[["label", "agent", "day", "cost"]]
+        .groupby(["label", "agent", "day"]).sum()
+        .groupby(["label", "agent"]).mean()
         .reset_index()
     )
+    return out.rename(columns={"label": "setting"})
 
 
 def paired_cost_ttest(
     df, setting_a: str, setting_b: str
 ) -> Dict[str, float]:
-    """Paired per-day t-test of total daily cost between two settings
-    (data_analysis.py:1310-1320,1339-1349). Days present in only one setting
-    are dropped (and counted) rather than silently poisoning the test with
-    NaN."""
+    """Paired per-day t-test of total daily cost between two run labels
+    (data_analysis.py:1310-1320,1339-1349). A label is the setting string, or
+    ``setting[implementation]`` when several implementations share a setting
+    (see ``_labelled``) — this is how baseline-vs-RL comparisons are keyed.
+    Days present in only one run are dropped (and counted) rather than
+    silently poisoning the test with NaN."""
     costs = daily_cost_table(df)[[setting_a, setting_b]].dropna()
     diff = np.asarray(costs[setting_a]) - np.asarray(costs[setting_b])
     t, p = stats.ttest_1samp(diff, 0)

@@ -36,6 +36,7 @@ def _build_cfg(args) -> "ExperimentConfig":
             rounds=args.rounds,
             homogeneous=args.homogeneous,
             n_scenarios=getattr(args, "scenarios", 1),
+            trading=not getattr(args, "no_trading", False),
         ),
         battery=BatteryConfig(enabled=args.battery),
         train=TrainConfig(
@@ -45,6 +46,25 @@ def _build_cfg(args) -> "ExperimentConfig":
             episodes_per_jit_block=getattr(args, "jit_block", 1),
         ),
     )
+
+
+def _save_times(path: str, setting: str, train_time=None, run_time=None) -> None:
+    """Per-setting wall-clock record (the reference's save_times,
+    community.py:324-338, fixed: missing file starts an empty record instead
+    of crashing)."""
+    import os
+
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    entry = data.setdefault(setting, {})
+    if train_time is not None:
+        entry["train"] = train_time
+    if run_time is not None:
+        entry["run"] = run_time
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
 
 
 def _load_traces(args):
@@ -97,6 +117,8 @@ def cmd_train(args) -> int:
         progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
     )
     save_checkpoint(ckpt_dir, result.pol_state, cfg.train.max_episodes - 1)
+    if args.timing_json:
+        _save_times(args.timing_json, cfg.setting, train_time=result.train_seconds)
     print(
         f"trained {cfg.train.max_episodes} episodes in {result.train_seconds:.1f}s "
         f"({result.env_steps_per_sec:.0f} env-steps/s); checkpoint: {ckpt_dir}"
@@ -130,9 +152,15 @@ def cmd_eval(args) -> int:
     pol_state, episode = restore_checkpoint(ckpt_dir, template)
     print(f"restored {ckpt_dir} at episode {episode}")
 
+    import time as _time
+
+    t0 = _time.time()
     days, outputs, day_arrays = evaluate_community(
-        cfg, policy, pol_state, traces, ratings, key, rng=rng
+        cfg, policy, pol_state, traces, ratings, key, rng=rng,
+        arrays_transform=(lambda a: _maybe_pv_drop(args, a)) if args.pv_drop else None,
     )
+    if args.timing_json:
+        _save_times(args.timing_json, cfg.setting, run_time=_time.time() - t0)
     costs = np.asarray(outputs.cost).sum(axis=(1, 2))
     for d, c in zip(days.tolist(), costs.tolist()):
         print(f"day {d}: community cost {c:+.3f} €")
@@ -140,7 +168,13 @@ def cmd_eval(args) -> int:
     if args.results_db:
         store = ResultsStore(args.results_db)
         save_eval_outputs(
-            store, cfg.setting, cfg.train.implementation, args.test, days, outputs, day_arrays
+            store,
+            _persist_setting(args, cfg),
+            cfg.train.implementation,
+            args.test,
+            days,
+            outputs,
+            day_arrays,
         )
         print(f"results -> {args.results_db}")
     if args.figures_dir:
@@ -159,6 +193,7 @@ def cmd_baseline(args) -> int:
         init_physical,
         make_ratings,
         rule_baseline_episode,
+        semi_intelligent_baseline_episode,
     )
 
     cfg = _build_cfg(args)
@@ -166,18 +201,24 @@ def cmd_baseline(args) -> int:
     traces = test_traces if args.test else val_traces
     rng = np.random.default_rng(cfg.train.seed)
     ratings = make_ratings(cfg, rng)
+    episode_fn = (
+        semi_intelligent_baseline_episode
+        if args.kind == "semi-intelligent"
+        else rule_baseline_episode
+    )
 
     store = ResultsStore(args.results_db) if args.results_db else None
     for day, day_traces in sorted(traces.split_by_day().items()):
         arrays = build_episode_arrays(cfg, day_traces, ratings)
+        arrays = _maybe_pv_drop(args, arrays)
         phys = init_physical(cfg, jax.random.PRNGKey(cfg.train.seed))
-        _, out = rule_baseline_episode(cfg, phys, arrays)
+        _, out = episode_fn(cfg, phys, arrays)
         cost = float(np.asarray(out.cost).sum())
-        print(f"day {day}: rule-based community cost {cost:+.3f} €")
+        print(f"day {day}: {args.kind} community cost {cost:+.3f} €")
         if store:
             store.log_run_results(
-                "rule-based",
-                "rule-based",
+                "single-agent" if cfg.sim.n_agents == 1 else _persist_setting(args, cfg),
+                args.kind,
                 args.test,
                 day,
                 time=np.asarray(arrays.time),
@@ -188,6 +229,32 @@ def cmd_baseline(args) -> int:
                 cost=np.asarray(out.cost),
             )
     return 0
+
+
+def _maybe_pv_drop(args, arrays):
+    """--pv-drop AGENT[:START_SLOT[:FACTOR]] — fault-inject one agent's PV."""
+    spec = getattr(args, "pv_drop", None)
+    if not spec:
+        return arrays
+    from p2pmicrogrid_tpu.envs import with_pv_drop
+
+    parts = spec.split(":")
+    agent = int(parts[0])
+    start = int(parts[1]) if len(parts) > 1 else 0
+    factor = float(parts[2]) if len(parts) > 2 else 0.0
+    return with_pv_drop(arrays, agent, start, factor)
+
+
+def _persist_setting(args, cfg) -> str:
+    """Setting string used as the results-store identity. PV-drop runs get
+    their own name (the reference's '2-agent-1-pv-drop-{com,no-com}' keys,
+    data_analysis.py:1104) so they never clobber the clean run's rows."""
+    spec = getattr(args, "pv_drop", None)
+    if not spec:
+        return cfg.setting
+    agent = spec.split(":")[0]
+    com = "com" if cfg.sim.trading else "no-com"
+    return f"{cfg.sim.n_agents}-agent-{agent}-pv-drop-{com}"
 
 
 def cmd_bench(args) -> int:
@@ -226,10 +293,12 @@ def cmd_analyse(args) -> int:
     return 0
 
 
-def _add_common(p: argparse.ArgumentParser, train_knobs: bool = True) -> None:
+def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--agents", type=int, default=2)
     p.add_argument("--rounds", type=int, default=1)
     p.add_argument("--homogeneous", action="store_true")
+    p.add_argument("--no-trading", action="store_true", dest="no_trading",
+                   help="no-com community: no P2P negotiation or trading")
     p.add_argument("--battery", action="store_true")
     p.add_argument("--implementation", choices=["tabular", "dqn", "ddpg"], default="tabular")
     p.add_argument("--episodes", type=int, default=1000)
@@ -237,6 +306,8 @@ def _add_common(p: argparse.ArgumentParser, train_knobs: bool = True) -> None:
     p.add_argument("--db", help="reference SQLite measurement DB (default: synthetic)")
     p.add_argument("--results-db", help="SQLite results store path")
     p.add_argument("--model-dir", default="./models")
+    p.add_argument("--timing-json", dest="timing_json",
+                   help="append per-setting wall-clock times to this JSON file")
 
 
 def main(argv=None) -> int:
@@ -253,11 +324,16 @@ def main(argv=None) -> int:
     _add_common(p)
     p.add_argument("--test", action="store_true", help="test days (default: validation)")
     p.add_argument("--figures-dir")
+    p.add_argument("--pv-drop", dest="pv_drop", metavar="AGENT[:START[:FACTOR]]",
+                   help="fault-inject one agent's PV production")
     p.set_defaults(fn=cmd_eval)
 
-    p = sub.add_parser("baseline", help="rule-based thermostat baseline")
+    p = sub.add_parser("baseline", help="rule-based / semi-intelligent baseline")
     _add_common(p)
     p.add_argument("--test", action="store_true")
+    p.add_argument("--kind", choices=["rule-based", "semi-intelligent"],
+                   default="rule-based")
+    p.add_argument("--pv-drop", dest="pv_drop", metavar="AGENT[:START[:FACTOR]]")
     p.set_defaults(fn=cmd_baseline)
 
     p = sub.add_parser("bench", help="run the benchmark")

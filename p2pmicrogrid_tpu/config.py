@@ -203,6 +203,11 @@ class SimConfig:
     rounds: int = 1                  # negotiation rounds (setup.py:34)
     homogeneous: bool = False        # (setup.py:35)
     n_scenarios: int = 1             # Monte-Carlo scenario batch (TPU-native axis)
+    # The reference's "no-com" thesis settings (e.g. 2-multi-agent-no-com-homo,
+    # data_analysis.py:1324-1330) were produced by code edits not shipped;
+    # here no-communication communities are a first-class knob: False means
+    # no P2P negotiation or trading — every agent settles with the grid.
+    trading: bool = True
     # Reference quirk (agent.py:293-296, community.py:161): the next-state
     # observation reuses the *current* indoor temperature (assets step after
     # training) and a zero p2p signal. True = replicate; False = use the
@@ -253,11 +258,14 @@ class ExperimentConfig:
 
     @property
     def setting(self) -> str:
+        """Experiment-identity string (community.py:423). The no-com variant
+        follows the reference's result-data naming, which omits the round
+        count (data_analysis.py:1324-1330)."""
         s = self.sim
-        return (
-            f"{s.n_agents}-multi-agent-com-rounds-{s.rounds}-"
-            f"{'homo' if s.homogeneous else 'hetero'}"
-        )
+        hom = "homo" if s.homogeneous else "hetero"
+        if not s.trading:
+            return f"{s.n_agents}-multi-agent-no-com-{hom}"
+        return f"{s.n_agents}-multi-agent-com-rounds-{s.rounds}-{hom}"
 
     def replace(self, **kwargs) -> "ExperimentConfig":
         return dataclasses.replace(self, **kwargs)

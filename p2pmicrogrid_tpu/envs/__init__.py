@@ -18,10 +18,14 @@ from p2pmicrogrid_tpu.envs.community import (
     make_ratings,
     run_episode,
     rule_baseline_episode,
+    semi_intelligent_baseline_episode,
     slot_dynamics,
+    with_pv_drop,
 )
 
 __all__ = [
+    "semi_intelligent_baseline_episode",
+    "with_pv_drop",
     "AgentRatings",
     "EpisodeArrays",
     "PhysState",

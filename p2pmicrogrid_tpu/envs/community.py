@@ -176,6 +176,26 @@ def build_episode_arrays(
     )
 
 
+def with_pv_drop(
+    arrays: EpisodeArrays,
+    agent: int,
+    start_slot: int = 0,
+    factor: float = 0.0,
+) -> EpisodeArrays:
+    """Fault injection: scale one agent's PV production from ``start_slot``
+    onward (the reference's artificial "PV drop" scenario, analyzed at
+    data_analysis.py:1099-1211 under settings ``2-agent-1-pv-drop-{com,no-com}``
+    — its generating code was never shipped; here it is a first-class
+    transform)."""
+    mask = (jnp.arange(arrays.time.shape[0]) >= start_slot).astype(jnp.float32)
+    scale = 1.0 - (1.0 - factor) * mask  # 1 before the drop, `factor` after
+    pv_w = arrays.pv_w.at[:, agent].multiply(scale)
+    # next_pv_w[t] mirrors pv_w[t+1] (np.roll pairing), so its scale is the
+    # rolled one — the slot-(start-1) transition must already see the fault.
+    next_pv_w = arrays.next_pv_w.at[:, agent].multiply(jnp.roll(scale, -1))
+    return arrays._replace(pv_w=pv_w, next_pv_w=next_pv_w)
+
+
 def init_physical(cfg: ExperimentConfig, key: jax.Array) -> PhysState:
     """Initial temperatures: setpoint exactly (homogeneous) or
     N(setpoint, 0.3) per agent (heating.py:101-104); battery at init SoC."""
@@ -290,12 +310,28 @@ def slot_dynamics(
             cfg.battery, soc, balance_w, cfg.sim.dt_seconds
         )
 
-    p2p, hp_frac, pol_state, obs, aux, q, hp_power_rounds = _negotiate(
-        cfg, policy, pol_state, phys, ratings, time_norm, balance_w, key,
-        explore=explore,
-    )
-
-    p_grid, p_p2p = clear_market(p2p)
+    if cfg.sim.trading:
+        p2p, hp_frac, pol_state, obs, aux, q, hp_power_rounds = _negotiate(
+            cfg, policy, pol_state, phys, ratings, time_norm, balance_w, key,
+            explore=explore,
+        )
+        p_grid, p_p2p = clear_market(p2p)
+    else:
+        # No-communication community (the reference's "no-com" settings):
+        # a single decision pass with a zero p2p signal, all power settles
+        # with the grid.
+        obs = make_observation(
+            time_norm,
+            normalized_temperature(cfg.thermal, phys.t_in),
+            balance_w / ratings.max_in,
+            jnp.zeros_like(balance_w),
+        )
+        hp_frac, aux, q, pol_state = policy.act(
+            pol_state, obs, phys.hp_frac, key, explore
+        )
+        p_grid = balance_w + hp_frac * cfg.thermal.hp_max_power
+        p_p2p = jnp.zeros_like(p_grid)
+        hp_power_rounds = (hp_frac * cfg.thermal.hp_max_power)[None, :]
     cost = compute_costs(p_grid, p_p2p, buy, inj, trade, cfg.sim.slot_hours)
 
     # Reward at pre-step indoor temperature (agent.py:225-232).
@@ -435,6 +471,82 @@ def rule_baseline_episode(
         p_grid = balance_w + hp_power
         p_p2p = jnp.zeros_like(p_grid)
 
+        cost = compute_costs(p_grid, p_p2p, buy, inj, trade, cfg.sim.slot_hours)
+        penalty = comfort_penalty(th, phys.t_in)
+        reward = -(cost + 10.0 * penalty)
+
+        t_in_new, t_bm_new = thermal_step(
+            th, cfg.sim.dt_seconds, t_out, phys.t_in, phys.t_bm, hp_power
+        )
+        new_phys = PhysState(t_in=t_in_new, t_bm=t_bm_new, soc=soc, hp_frac=hp_frac)
+        out = SlotOutputs(
+            cost=cost,
+            reward=reward,
+            loss=jnp.zeros_like(reward),
+            p_grid=p_grid,
+            p_p2p=p_p2p,
+            buy_price=buy,
+            injection_price=inj,
+            trade_price=trade,
+            t_in=phys.t_in,
+            hp_power_w=hp_power,
+            decisions=hp_power[None, :],
+            q=jnp.zeros_like(reward),
+        )
+        return new_phys, out
+
+    xs = (arrays.time, arrays.t_out, arrays.load_w, arrays.pv_w)
+    phys, outputs = jax.lax.scan(step, phys, xs)
+    return phys, outputs
+
+
+def semi_intelligent_baseline_episode(
+    cfg: ExperimentConfig,
+    phys: PhysState,
+    arrays: EpisodeArrays,
+) -> Tuple[PhysState, SlotOutputs]:
+    """Price-aware thermostat baseline, grid-only settlement.
+
+    The reference's thesis results include a 'semi-intelligent' baseline
+    (data_analysis.py:327,865,1308-1319) whose generating code was never
+    shipped. Reconstruction of the obvious mid-point between the bang-bang
+    thermostat and the RL agents: identical comfort logic, but it also
+    pre-heats (up to the comfort band's upper bound) whenever the
+    time-of-use buy price is below its daily average — buying heat in cheap
+    slots to coast through expensive ones.
+    """
+    th = cfg.thermal
+    # Daily-average buy price is a constant of the tariff (mean of the
+    # sinusoid = cost_avg, agent.py:60-64).
+    avg_price = cfg.tariff.cost_avg / 100.0
+
+    def step(carry, x):
+        phys = carry
+        time_norm, t_out, load_w, pv_w = x
+        buy, inj = grid_prices(cfg.tariff, time_norm)
+        trade = p2p_price_fn(buy, inj)
+
+        hp_frac = jnp.where(
+            phys.t_in <= th.lower_bound,
+            1.0,
+            jnp.where(phys.t_in >= th.upper_bound, 0.0, phys.hp_frac),
+        )
+        # Cheap-slot pre-heating: run at half power while below the upper
+        # bound and the price is below average.
+        cheap = buy < avg_price
+        hp_frac = jnp.where(
+            cheap & (phys.t_in < th.upper_bound), jnp.maximum(hp_frac, 0.5), hp_frac
+        )
+        hp_power = hp_frac * th.hp_max_power
+
+        balance_w = load_w - pv_w
+        soc = phys.soc
+        if cfg.battery.enabled:
+            soc, balance_w = battery_rule_update(
+                cfg.battery, soc, balance_w, cfg.sim.dt_seconds
+            )
+        p_grid = balance_w + hp_power
+        p_p2p = jnp.zeros_like(p_grid)
         cost = compute_costs(p_grid, p_p2p, buy, inj, trade, cfg.sim.slot_hours)
         penalty = comfort_penalty(th, phys.t_in)
         reward = -(cost + 10.0 * penalty)

@@ -238,7 +238,8 @@ def evaluate_community(
     key: jax.Array,
     redraw_profile_scales: bool = True,
     rng: Optional[np.random.Generator] = None,
-) -> Tuple[np.ndarray, SlotOutputs]:
+    arrays_transform: Optional[Callable[[EpisodeArrays], EpisodeArrays]] = None,
+) -> Tuple[np.ndarray, SlotOutputs, EpisodeArrays]:
     """Greedy per-day evaluation (community.py:364-412): each day runs from a
     fresh physical state so bad decisions don't propagate (community.py:380).
 
@@ -269,7 +270,10 @@ def evaluate_community(
                 max_in=ratings.max_in,
                 max_out=ratings.max_out,
             )
-        day_arrays.append(build_episode_arrays(cfg, day_traces, r))
+        arrays = build_episode_arrays(cfg, day_traces, r)
+        if arrays_transform is not None:
+            arrays = arrays_transform(arrays)  # e.g. with_pv_drop fault injection
+        day_arrays.append(arrays)
 
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *day_arrays)
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))

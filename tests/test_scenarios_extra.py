@@ -1,0 +1,116 @@
+"""Tests for no-com communities, PV-drop fault injection, and the
+semi-intelligent baseline."""
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.data import synthetic_traces
+from p2pmicrogrid_tpu.envs import (
+    build_episode_arrays,
+    init_physical,
+    make_ratings,
+    rule_baseline_episode,
+    run_episode,
+    semi_intelligent_baseline_episode,
+    with_pv_drop,
+)
+from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+
+
+@pytest.fixture(scope="module")
+def day_traces():
+    return synthetic_traces(n_days=1, start_day=11).normalized()
+
+
+class TestNoCom:
+    def test_setting_string(self):
+        cfg = default_config(sim=SimConfig(n_agents=2, trading=False, homogeneous=True))
+        assert cfg.setting == "2-multi-agent-no-com-homo"
+        cfg = default_config(sim=SimConfig(n_agents=3, rounds=2))
+        assert cfg.setting == "3-multi-agent-com-rounds-2-hetero"
+
+    def test_no_p2p_power_and_learning_works(self, day_traces):
+        cfg = default_config(
+            sim=SimConfig(n_agents=2, trading=False),
+            train=TrainConfig(implementation="tabular"),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+        _, ps2, out = run_episode(
+            cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7), training=True
+        )
+        np.testing.assert_allclose(np.asarray(out.p_p2p), 0.0)
+        assert float(np.abs(np.asarray(ps2.q_table - ps.q_table)).max()) > 0
+        # Grid power carries the whole balance + heat pump.
+        assert out.decisions.shape == (96, 1, 2)
+
+    def test_com_vs_no_com_differ(self, day_traces):
+        outs = {}
+        for trading in (True, False):
+            cfg = default_config(
+                sim=SimConfig(n_agents=2, trading=trading),
+                train=TrainConfig(implementation="tabular"),
+            )
+            ratings = make_ratings(cfg, np.random.default_rng(42))
+            arrays = build_episode_arrays(cfg, day_traces, ratings)
+            policy = make_policy(cfg)
+            ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+            ps = ps._replace(
+                q_table=jax.random.normal(jax.random.PRNGKey(5), ps.q_table.shape)
+            )
+            phys = init_physical(cfg, jax.random.PRNGKey(0))
+            _, _, out = run_episode(
+                cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7),
+                training=False,
+            )
+            outs[trading] = np.asarray(out.cost).sum()
+        assert outs[True] != outs[False]
+
+
+class TestPvDrop:
+    def test_drop_zeroes_pv_from_slot(self, day_traces):
+        cfg = default_config(sim=SimConfig(n_agents=2))
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        dropped = with_pv_drop(arrays, agent=1, start_slot=48, factor=0.0)
+        np.testing.assert_array_equal(
+            np.asarray(dropped.pv_w[:48, 1]), np.asarray(arrays.pv_w[:48, 1])
+        )
+        np.testing.assert_allclose(np.asarray(dropped.pv_w[48:, 1]), 0.0)
+        # Other agent untouched.
+        np.testing.assert_array_equal(
+            np.asarray(dropped.pv_w[:, 0]), np.asarray(arrays.pv_w[:, 0])
+        )
+
+    def test_partial_factor(self, day_traces):
+        cfg = default_config(sim=SimConfig(n_agents=2))
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        dropped = with_pv_drop(arrays, agent=0, start_slot=0, factor=0.5)
+        np.testing.assert_allclose(
+            np.asarray(dropped.pv_w[:, 0]),
+            np.asarray(arrays.pv_w[:, 0]) * 0.5,
+            rtol=1e-6,
+        )
+
+
+class TestSemiIntelligent:
+    def test_holds_comfort_and_preheats(self, day_traces):
+        cfg = default_config(sim=SimConfig(n_agents=2))
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, day_traces, ratings)
+        phys = init_physical(cfg, jax.random.PRNGKey(0))
+        _, semi = semi_intelligent_baseline_episode(cfg, phys, arrays)
+        _, rule = rule_baseline_episode(cfg, phys, arrays)
+        assert float(semi.t_in.min()) > 18.5
+        # Pre-heating buys more energy overall...
+        assert float(semi.hp_power_w.sum()) > float(rule.hp_power_w.sum())
+        # ...but concentrated in cheap slots: its mean purchase price is lower.
+        semi_price = (semi.hp_power_w * semi.buy_price[:, None]).sum() / semi.hp_power_w.sum()
+        rule_price = (rule.hp_power_w * rule.buy_price[:, None]).sum() / (rule.hp_power_w.sum() + 1e-9)
+        assert float(semi_price) < float(rule_price) + 1e-3

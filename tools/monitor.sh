@@ -1,0 +1,17 @@
+#!/bin/sh
+# Duty-cycle throttle for long trainings on a shared machine: every PERIOD
+# seconds, SIGSTOP the target PID, wait PAUSE seconds, SIGCONT it.
+# (Ops-utility parity with the reference's monitor.sh:5-11.)
+#
+# Usage: tools/monitor.sh PID [PERIOD=600] [PAUSE=60]
+
+PID=${1:?usage: monitor.sh PID [PERIOD] [PAUSE]}
+PERIOD=${2:-600}
+PAUSE=${3:-60}
+
+while kill -0 "$PID" 2>/dev/null; do
+    sleep "$PERIOD"
+    kill -STOP "$PID" 2>/dev/null || break
+    sleep "$PAUSE"
+    kill -CONT "$PID" 2>/dev/null || break
+done
