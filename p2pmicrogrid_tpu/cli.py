@@ -160,7 +160,7 @@ def cmd_eval(args) -> int:
         arrays_transform=(lambda a: _maybe_pv_drop(args, a)) if args.pv_drop else None,
     )
     if args.timing_json:
-        _save_times(args.timing_json, cfg.setting, run_time=_time.time() - t0)
+        _save_times(args.timing_json, _persist_setting(args, cfg), run_time=_time.time() - t0)
     costs = np.asarray(outputs.cost).sum(axis=(1, 2))
     for d, c in zip(days.tolist(), costs.tolist()):
         print(f"day {d}: community cost {c:+.3f} €")
@@ -216,8 +216,17 @@ def cmd_baseline(args) -> int:
         cost = float(np.asarray(out.cost).sum())
         print(f"day {day}: {args.kind} community cost {cost:+.3f} €")
         if store:
+            # Baseline rows get a non-digit-prefixed setting so the scale /
+            # rounds statistics (which collect settings by their leading
+            # agent-count digits) never pool them with RL results. Single-agent
+            # keeps the reference's 'single-agent' key (data_analysis.py:1301).
+            baseline_setting = (
+                "single-agent"
+                if cfg.sim.n_agents == 1
+                else f"baseline-{_persist_setting(args, cfg)}"
+            )
             store.log_run_results(
-                "single-agent" if cfg.sim.n_agents == 1 else _persist_setting(args, cfg),
+                baseline_setting,
                 args.kind,
                 args.test,
                 day,

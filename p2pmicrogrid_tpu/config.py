@@ -208,6 +208,10 @@ class SimConfig:
     # here no-communication communities are a first-class knob: False means
     # no P2P negotiation or trading — every agent settles with the grid.
     trading: bool = True
+    # Fused Pallas kernels for the negotiation/market matrix passes
+    # (ops/pallas_market.py). Exact to float tolerance vs the jnp path;
+    # interpreter mode on non-TPU backends.
+    use_pallas: bool = False
     # Reference quirk (agent.py:293-296, community.py:161): the next-state
     # observation reuses the *current* indoor temperature (assets step after
     # training) and a zero p2p signal. True = replicate; False = use the

@@ -187,6 +187,10 @@ def with_pv_drop(
     data_analysis.py:1099-1211 under settings ``2-agent-1-pv-drop-{com,no-com}``
     — its generating code was never shipped; here it is a first-class
     transform)."""
+    n_agents = arrays.pv_w.shape[1]
+    if not 0 <= agent < n_agents:
+        # JAX scatter silently drops out-of-bounds indices; fail loudly here.
+        raise ValueError(f"agent {agent} out of range [0, {n_agents})")
     mask = (jnp.arange(arrays.time.shape[0]) >= start_slot).astype(jnp.float32)
     scale = 1.0 - (1.0 - factor) * mask  # 1 before the drop, `factor` after
     pv_w = arrays.pv_w.at[:, agent].multiply(scale)
@@ -374,6 +378,145 @@ def slot_dynamics(
     return phys, pol_state, outputs, transition
 
 
+def slot_dynamics_batched(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state,
+    phys_s: PhysState,
+    xs,
+    key: jax.Array,
+    ratings: AgentRatings,
+    explore: bool,
+):
+    """Scenario-batched slot dynamics: same semantics as ``slot_dynamics``
+    but with an explicit leading scenario axis on all simulation state
+    (leaves [S, ...]; policy parameters shared).
+
+    Written for the shared-parameter trainer (parallel/scenarios.py): the
+    matrix passes run once over [S, A, A] — via broadcasting jnp ops, or the
+    fused Pallas kernels when ``SimConfig.use_pallas`` — instead of being
+    vmapped per scenario, and only the policy's act is vmapped.
+    """
+    time_s, t_out_s, load_w, pv_w, next_time_s, next_load_w, next_pv_w = xs
+    n_scenarios = load_w.shape[0]
+    th = cfg.thermal
+    if cfg.sim.use_pallas:
+        from p2pmicrogrid_tpu.ops.pallas_market import (
+            clear_market_fused,
+            divide_power_fused,
+            prep_mean,
+        )
+
+    buy, inj = grid_prices(cfg.tariff, time_s)  # [S]
+    trade = p2p_price_fn(buy, inj)
+
+    balance_w = load_w - pv_w  # [S, A]
+    soc = phys_s.soc
+    if cfg.battery.enabled:
+        soc, balance_w = battery_rule_update(
+            cfg.battery, soc, balance_w, cfg.sim.dt_seconds
+        )
+    norm_balance = balance_w / ratings.max_in
+
+    def act_batched(pol_state, obs, prev_frac, keys):
+        def one(o, f, k):
+            frac, aux, q, _ = policy.act(pol_state, o, f, k, explore)
+            return frac, aux, q
+
+        return jax.vmap(one)(obs, prev_frac, keys)
+
+    def round_body(carry, round_key):
+        p2p, hp_frac = carry  # p2p [S, A, A]
+        if cfg.sim.use_pallas:
+            p2p_mean = prep_mean(p2p) / ratings.max_in
+        else:
+            p2p_zd = zero_diagonal(p2p)
+            powers = -jnp.swapaxes(p2p_zd, -1, -2)
+            p2p_mean = jnp.mean(powers, axis=-1) / ratings.max_in
+
+        obs = make_observation(
+            time_s[:, None],
+            normalized_temperature(th, phys_s.t_in),
+            norm_balance,
+            p2p_mean,
+        )  # [S, A, 4]
+        keys = jax.random.split(round_key, n_scenarios)
+        hp_frac, aux, q = act_batched(pol_state, obs, hp_frac, keys)
+
+        out_power = balance_w + hp_frac * th.hp_max_power
+        if cfg.sim.use_pallas:
+            p_out = divide_power_fused(p2p, out_power)
+        else:
+            p_out = divide_power(out_power, powers)
+        return (p_out, hp_frac), (obs, aux, q, hp_frac * th.hp_max_power)
+
+    if cfg.sim.trading:
+        keys = jax.random.split(key, cfg.sim.rounds + 1)
+        (p2p, hp_frac), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
+            round_body,
+            (jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1])), phys_s.hp_frac),
+            keys,
+        )
+        obs, aux, q = obs_r[-1], aux_r[-1], q_r[-1]
+        if cfg.sim.use_pallas:
+            p_grid, p_p2p = clear_market_fused(p2p)
+        else:
+            p_grid, p_p2p = clear_market(p2p)
+    else:
+        # No-com community: one decision pass, zero p2p signal, grid-only
+        # settlement (mirrors the trading=False branch of slot_dynamics).
+        obs = make_observation(
+            time_s[:, None],
+            normalized_temperature(th, phys_s.t_in),
+            norm_balance,
+            jnp.zeros_like(norm_balance),
+        )
+        keys = jax.random.split(key, n_scenarios)
+        hp_frac, aux, q = act_batched(pol_state, obs, phys_s.hp_frac, keys)
+        p_grid = balance_w + hp_frac * th.hp_max_power
+        p_p2p = jnp.zeros_like(p_grid)
+        hp_power_r = (hp_frac * th.hp_max_power)[None]
+    cost = compute_costs(
+        p_grid, p_p2p, buy[:, None], inj[:, None], trade[:, None], cfg.sim.slot_hours
+    )
+
+    penalty = comfort_penalty(th, phys_s.t_in)
+    reward = -(cost + 10.0 * penalty)
+
+    hp_power = hp_frac * th.hp_max_power
+    t_in_pre = phys_s.t_in
+    t_in_new, t_bm_new = thermal_step(
+        th, cfg.sim.dt_seconds, t_out_s[:, None], phys_s.t_in, phys_s.t_bm, hp_power
+    )
+
+    next_temp = phys_s.t_in if cfg.sim.stale_next_temp else t_in_new
+    next_balance = (next_load_w - next_pv_w) / ratings.max_in
+    next_obs = make_observation(
+        next_time_s[:, None],
+        normalized_temperature(th, next_temp),
+        next_balance,
+        jnp.zeros_like(next_balance),
+    )
+
+    phys_s = PhysState(t_in=t_in_new, t_bm=t_bm_new, soc=soc, hp_frac=hp_frac)
+    outputs = SlotOutputs(
+        cost=cost,
+        reward=reward,
+        loss=jnp.zeros_like(reward),
+        p_grid=p_grid,
+        p_p2p=p_p2p,
+        buy_price=buy,
+        injection_price=inj,
+        trade_price=trade,
+        t_in=t_in_pre,
+        hp_power_w=hp_power,
+        decisions=jnp.swapaxes(hp_power_r, 0, 1),  # [S, rounds+1, A]
+        q=q,
+    )
+    transition = SlotTransition(obs=obs, aux=aux, reward=reward, next_obs=next_obs)
+    return phys_s, pol_state, outputs, transition
+
+
 def community_slot(
     cfg: ExperimentConfig,
     policy: Policy,
@@ -434,18 +577,15 @@ def run_episode(
     return phys, pol_state, outputs
 
 
-def rule_baseline_episode(
+def _thermostat_episode(
     cfg: ExperimentConfig,
     phys: PhysState,
     arrays: EpisodeArrays,
+    hp_rule,
 ) -> Tuple[PhysState, SlotOutputs]:
-    """Thermostat bang-bang baseline, grid-only settlement.
-
-    The reference's ``RuleAgent`` (agent.py:106-136): heat at full power below
-    the comfort band, off above it, keep the previous command inside the band;
-    the whole balance settles with the grid (its community is the no-trading
-    baseline). Pure scan, no learning, no RNG.
-    """
+    """Shared scaffold for the rule-based baselines: grid-only settlement,
+    no learning, no RNG; ``hp_rule(phys, buy_price) -> hp_frac [A]`` supplies
+    the heat-pump policy."""
     th = cfg.thermal
 
     def step(carry, x):
@@ -454,12 +594,7 @@ def rule_baseline_episode(
         buy, inj = grid_prices(cfg.tariff, time_norm)
         trade = p2p_price_fn(buy, inj)
 
-        # Bang-bang thermostat (agent.py:130-136).
-        hp_frac = jnp.where(
-            phys.t_in <= th.lower_bound,
-            1.0,
-            jnp.where(phys.t_in >= th.upper_bound, 0.0, phys.hp_frac),
-        )
+        hp_frac = hp_rule(phys, buy)
         hp_power = hp_frac * th.hp_max_power
 
         balance_w = load_w - pv_w
@@ -498,6 +633,29 @@ def rule_baseline_episode(
     xs = (arrays.time, arrays.t_out, arrays.load_w, arrays.pv_w)
     phys, outputs = jax.lax.scan(step, phys, xs)
     return phys, outputs
+
+
+def _bang_bang(cfg: ExperimentConfig, phys: PhysState) -> jnp.ndarray:
+    """Bang-bang thermostat (agent.py:130-136): full power below the comfort
+    band, off above it, hold the previous command inside it."""
+    th = cfg.thermal
+    return jnp.where(
+        phys.t_in <= th.lower_bound,
+        1.0,
+        jnp.where(phys.t_in >= th.upper_bound, 0.0, phys.hp_frac),
+    )
+
+
+def rule_baseline_episode(
+    cfg: ExperimentConfig,
+    phys: PhysState,
+    arrays: EpisodeArrays,
+) -> Tuple[PhysState, SlotOutputs]:
+    """Thermostat bang-bang baseline, grid-only settlement — the reference's
+    ``RuleAgent`` (agent.py:106-136)."""
+    return _thermostat_episode(
+        cfg, phys, arrays, lambda phys, buy: _bang_bang(cfg, phys)
+    )
 
 
 def semi_intelligent_baseline_episode(
@@ -510,67 +668,20 @@ def semi_intelligent_baseline_episode(
     The reference's thesis results include a 'semi-intelligent' baseline
     (data_analysis.py:327,865,1308-1319) whose generating code was never
     shipped. Reconstruction of the obvious mid-point between the bang-bang
-    thermostat and the RL agents: identical comfort logic, but it also
-    pre-heats (up to the comfort band's upper bound) whenever the
-    time-of-use buy price is below its daily average — buying heat in cheap
-    slots to coast through expensive ones.
+    thermostat and the RL agents: identical comfort logic, plus pre-heating
+    (at half power, up to the comfort band's upper bound) whenever the
+    time-of-use buy price is below its daily average (= tariff ``cost_avg``,
+    the mean of the sinusoid, agent.py:60-64) — buying heat in cheap slots to
+    coast through expensive ones.
     """
     th = cfg.thermal
-    # Daily-average buy price is a constant of the tariff (mean of the
-    # sinusoid = cost_avg, agent.py:60-64).
     avg_price = cfg.tariff.cost_avg / 100.0
 
-    def step(carry, x):
-        phys = carry
-        time_norm, t_out, load_w, pv_w = x
-        buy, inj = grid_prices(cfg.tariff, time_norm)
-        trade = p2p_price_fn(buy, inj)
-
-        hp_frac = jnp.where(
-            phys.t_in <= th.lower_bound,
-            1.0,
-            jnp.where(phys.t_in >= th.upper_bound, 0.0, phys.hp_frac),
-        )
-        # Cheap-slot pre-heating: run at half power while below the upper
-        # bound and the price is below average.
+    def rule(phys, buy):
+        hp_frac = _bang_bang(cfg, phys)
         cheap = buy < avg_price
-        hp_frac = jnp.where(
+        return jnp.where(
             cheap & (phys.t_in < th.upper_bound), jnp.maximum(hp_frac, 0.5), hp_frac
         )
-        hp_power = hp_frac * th.hp_max_power
 
-        balance_w = load_w - pv_w
-        soc = phys.soc
-        if cfg.battery.enabled:
-            soc, balance_w = battery_rule_update(
-                cfg.battery, soc, balance_w, cfg.sim.dt_seconds
-            )
-        p_grid = balance_w + hp_power
-        p_p2p = jnp.zeros_like(p_grid)
-        cost = compute_costs(p_grid, p_p2p, buy, inj, trade, cfg.sim.slot_hours)
-        penalty = comfort_penalty(th, phys.t_in)
-        reward = -(cost + 10.0 * penalty)
-
-        t_in_new, t_bm_new = thermal_step(
-            th, cfg.sim.dt_seconds, t_out, phys.t_in, phys.t_bm, hp_power
-        )
-        new_phys = PhysState(t_in=t_in_new, t_bm=t_bm_new, soc=soc, hp_frac=hp_frac)
-        out = SlotOutputs(
-            cost=cost,
-            reward=reward,
-            loss=jnp.zeros_like(reward),
-            p_grid=p_grid,
-            p_p2p=p_p2p,
-            buy_price=buy,
-            injection_price=inj,
-            trade_price=trade,
-            t_in=phys.t_in,
-            hp_power_w=hp_power,
-            decisions=hp_power[None, :],
-            q=jnp.zeros_like(reward),
-        )
-        return new_phys, out
-
-    xs = (arrays.time, arrays.t_out, arrays.load_w, arrays.pv_w)
-    phys, outputs = jax.lax.scan(step, phys, xs)
-    return phys, outputs
+    return _thermostat_episode(cfg, phys, arrays, rule)

@@ -1,0 +1,146 @@
+"""Pallas TPU kernels for the negotiation/market hot path.
+
+The per-slot negotiation at scenario scale streams [S, A, A] proposal
+matrices through several separate elementwise/transpose/reduce passes
+(ops/market.py): diag-zeroing, ``powers = -p2p^T``, the mean-p2p observation,
+``divide_power``'s sign-filtered proportional split, and ``clear_market``'s
+pairwise matching. Each pass is HBM-bound; XLA cannot fuse across the
+transposes. These kernels fuse each stage into a single VMEM pass over a
+block of scenarios, with the diagonal mask folded in:
+
+* ``prep_mean(p2p)``       — [S,A,A] -> [S,A]: mean over counterparties of
+  ``-p2p[:, i]`` with the diagonal zeroed (agent.py:203, community.py:76).
+* ``divide_power_fused``   — [S,A,A], [S,A] -> [S,A,A]: the full proposal
+  split (agent.py:186-195) against diag-zeroed powers.
+* ``clear_market_fused``   — [S,A,A] -> ([S,A], [S,A]): sign-opposition
+  matching + grid/p2p totals (community.py:45-54).
+
+On non-TPU backends the kernels run in interpreter mode (slow but exact), so
+the same code path is testable on the CPU mesh; ``ops/market.py`` remains the
+reference implementation and the default for single-scenario shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Scenarios per kernel block: [SB, A, A] f32 must fit VMEM (~16 MB) with
+# headroom; A<=128 pads to 128 lanes -> SB*128*128*4B = 0.5 MB at SB=8.
+_BLOCK_S = 8
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _diag_mask(a: int, dtype=jnp.float32) -> jnp.ndarray:
+    rows = jax.lax.broadcasted_iota(jnp.int32, (a, a), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (a, a), 1)
+    return (rows != cols).astype(dtype)
+
+
+def _prep_mean_kernel(p2p_ref, out_ref):
+    """out[s, i] = mean_j of (-p2p[s, j, i]) with diag zeroed."""
+    p2p = p2p_ref[:]  # [SB, A, A]
+    a = p2p.shape[-1]
+    p2p = p2p * _diag_mask(a)[None, :, :]
+    powers = -jnp.swapaxes(p2p, -1, -2)
+    out_ref[:] = jnp.mean(powers, axis=-1)
+
+
+def _divide_kernel(p2p_ref, out_power_ref, new_ref):
+    """Row i of new = divide_power(out_power[i], -diagzero(p2p)[:, i])."""
+    p2p = p2p_ref[:]  # [SB, A, A]
+    out = out_power_ref[:]  # [SB, A]
+    a = p2p.shape[-1]
+    p2p = p2p * _diag_mask(a)[None, :, :]
+    powers = -jnp.swapaxes(p2p, -1, -2)  # powers[s, i, j]
+
+    filtered = jnp.where(
+        jnp.sign(out)[..., None] != jnp.sign(powers), powers, 0.0
+    )
+    total = jnp.abs(jnp.sum(filtered, axis=-1, keepdims=True))
+    safe_total = jnp.where(total > 0.0, total, 1.0)
+    proportional = out[..., None] * jnp.abs(filtered) / safe_total
+    equal = out[..., None] / a
+    new_ref[:] = jnp.where(total > 0.0, proportional, jnp.broadcast_to(equal, powers.shape))
+
+
+def _clear_kernel(p2p_ref, grid_ref, peer_ref):
+    """Pairwise sign-opposition matching totals (community.py:45-54)."""
+    p2p = p2p_ref[:]  # [SB, A, A]
+    p2p_t = jnp.swapaxes(p2p, -1, -2)
+    p_match = jnp.where(jnp.sign(p2p) != jnp.sign(p2p_t), p2p, 0.0)
+    abs_match = jnp.abs(p_match)
+    exchange = jnp.sign(p_match) * jnp.minimum(
+        abs_match, jnp.swapaxes(abs_match, -1, -2)
+    )
+    grid_ref[:] = jnp.sum(p2p - exchange, axis=-1)
+    peer_ref[:] = jnp.sum(exchange, axis=-1)
+
+
+def _block(s: int) -> int:
+    b = min(_BLOCK_S, s)
+    while s % b:
+        b -= 1
+    return b
+
+
+@functools.partial(jax.jit, static_argnames=())
+def prep_mean(p2p: jnp.ndarray) -> jnp.ndarray:
+    """[S, A, A] -> [S, A] fused diag-zero + negate-transpose + mean."""
+    s, a, _ = p2p.shape
+    sb = _block(s)
+    return pl.pallas_call(
+        _prep_mean_kernel,
+        out_shape=jax.ShapeDtypeStruct((s, a), p2p.dtype),
+        grid=(s // sb,),
+        in_specs=[pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(p2p)
+
+
+@jax.jit
+def divide_power_fused(p2p: jnp.ndarray, out_power: jnp.ndarray) -> jnp.ndarray:
+    """[S, A, A], [S, A] -> [S, A, A] fused proposal split."""
+    s, a, _ = p2p.shape
+    sb = _block(s)
+    return pl.pallas_call(
+        _divide_kernel,
+        out_shape=jax.ShapeDtypeStruct((s, a, a), p2p.dtype),
+        grid=(s // sb,),
+        in_specs=[
+            pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(p2p, out_power)
+
+
+@jax.jit
+def clear_market_fused(p2p: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[S, A, A] -> (p_grid [S, A], p_p2p [S, A]) fused matching."""
+    s, a, _ = p2p.shape
+    sb = _block(s)
+    return pl.pallas_call(
+        _clear_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((s, a), p2p.dtype),
+            jax.ShapeDtypeStruct((s, a), p2p.dtype),
+        ),
+        grid=(s // sb,),
+        in_specs=[pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(p2p)

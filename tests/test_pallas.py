@@ -1,0 +1,96 @@
+"""Pallas kernel parity tests (interpreter mode on the CPU mesh).
+
+The fused negotiation/market kernels (ops/pallas_market.py) must match the
+jnp reference path (ops/market.py) bit-for-bit modulo float reassociation,
+including the sign-matching and equal-split edge cases; and a full
+shared-scenario episode with use_pallas=True must match use_pallas=False.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.ops.market import clear_market, divide_power, zero_diagonal
+from p2pmicrogrid_tpu.ops.pallas_market import (
+    clear_market_fused,
+    divide_power_fused,
+    prep_mean,
+)
+from p2pmicrogrid_tpu.parallel import (
+    make_scenario_traces,
+    stack_scenario_arrays,
+    train_scenarios_shared,
+)
+from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+
+S, A = 4, 6
+
+
+@pytest.fixture(scope="module")
+def p2p():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((S, A, A)).astype(np.float32) * 1e3
+    # Edge cases: exact zeros (sign 0) and a same-sign scenario where no
+    # counterparty matches (equal-split branch).
+    x[0, 0, :] = 0.0
+    x[1] = np.abs(x[1])
+    return jnp.asarray(x)
+
+
+@pytest.fixture(scope="module")
+def out_power():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((S, A)).astype(np.float32) * 1e3
+    x[2, 0] = 0.0
+    return jnp.asarray(x)
+
+
+def test_prep_mean_matches_reference(p2p):
+    p2p_zd = jax.vmap(zero_diagonal)(p2p)
+    powers = -jnp.swapaxes(p2p_zd, -1, -2)
+    ref = jnp.mean(powers, axis=-1)
+    got = prep_mean(p2p)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-3)
+
+
+def test_divide_power_matches_reference(p2p, out_power):
+    p2p_zd = jax.vmap(zero_diagonal)(p2p)
+    powers = -jnp.swapaxes(p2p_zd, -1, -2)
+    ref = jax.vmap(divide_power)(out_power, powers)
+    got = divide_power_fused(p2p, out_power)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-3)
+
+
+def test_clear_market_matches_reference(p2p):
+    ref_grid, ref_peer = clear_market(p2p)
+    got_grid, got_peer = clear_market_fused(p2p)
+    np.testing.assert_allclose(np.asarray(got_grid), np.asarray(ref_grid), rtol=1e-5, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(got_peer), np.asarray(ref_peer), rtol=1e-5, atol=1e-2)
+
+
+def test_shared_episode_pallas_parity():
+    """Full shared-tabular episode: use_pallas=True == use_pallas=False."""
+    results = {}
+    for use_pallas in (False, True):
+        cfg = default_config(
+            sim=SimConfig(n_agents=3, n_scenarios=S, use_pallas=use_pallas),
+            train=TrainConfig(implementation="tabular"),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        traces = make_scenario_traces(cfg)
+        arrays = stack_scenario_arrays(cfg, traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        ps = ps._replace(
+            q_table=jax.random.normal(jax.random.PRNGKey(5), ps.q_table.shape)
+        )
+        ps2, _, rewards, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(0), n_episodes=1
+        )
+        results[use_pallas] = (np.asarray(rewards), np.asarray(ps2.q_table))
+
+    np.testing.assert_allclose(results[True][0], results[False][0], rtol=1e-4)
+    np.testing.assert_allclose(results[True][1], results[False][1], rtol=1e-4, atol=1e-7)
