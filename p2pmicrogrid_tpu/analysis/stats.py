@@ -155,7 +155,11 @@ def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]
     if len({re.match(r"^([0-9]+)-", s).groups()[0] for s in scale_settings}) >= 2:
         results["community_scale"] = statistics_community_scale(df, scale_settings)
 
-    rounds_settings = [s for s in df["setting"].unique() if re.search(r"rounds-[0-9]+", s)]
+    # Anchored: only RL-run settings (leading agent count), never the
+    # 'baseline-'-prefixed rows.
+    rounds_settings = [
+        s for s in df["setting"].unique() if re.match(r"^[0-9]+-.*rounds-[0-9]+", s)
+    ]
     if len({re.search(r"rounds-([0-9]+)", s).groups()[0] for s in rounds_settings}) >= 2:
         results["nr_rounds"] = statistics_nr_rounds(df, rounds_settings)
 

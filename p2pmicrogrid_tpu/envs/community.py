@@ -387,6 +387,7 @@ def slot_dynamics_batched(
     key: jax.Array,
     ratings: AgentRatings,
     explore: bool,
+    settlement_hook=None,
 ):
     """Scenario-batched slot dynamics: same semantics as ``slot_dynamics``
     but with an explicit leading scenario axis on all simulation state
@@ -396,6 +397,12 @@ def slot_dynamics_batched(
     matrix passes run once over [S, A, A] — via broadcasting jnp ops, or the
     fused Pallas kernels when ``SimConfig.use_pallas`` — instead of being
     vmapped per scenario, and only the policy's act is vmapped.
+
+    ``settlement_hook(p_grid, p_p2p, buy, inj, trade) -> cost [S, A]``
+    optionally replaces the default per-agent settlement — the extension
+    point for inter-community trading (envs/multi_community.py), where the
+    leading axis is communities and part of each community's grid residual
+    settles peer-to-peer with other communities.
     """
     time_s, t_out_s, load_w, pv_w, next_time_s, next_load_w, next_pv_w = xs
     n_scenarios = load_w.shape[0]
@@ -476,9 +483,12 @@ def slot_dynamics_batched(
         p_grid = balance_w + hp_frac * th.hp_max_power
         p_p2p = jnp.zeros_like(p_grid)
         hp_power_r = (hp_frac * th.hp_max_power)[None]
-    cost = compute_costs(
-        p_grid, p_p2p, buy[:, None], inj[:, None], trade[:, None], cfg.sim.slot_hours
-    )
+    if settlement_hook is not None:
+        cost = settlement_hook(p_grid, p_p2p, buy, inj, trade)
+    else:
+        cost = compute_costs(
+            p_grid, p_p2p, buy[:, None], inj[:, None], trade[:, None], cfg.sim.slot_hours
+        )
 
     penalty = comfort_penalty(th, phys_s.t_in)
     reward = -(cost + 10.0 * penalty)

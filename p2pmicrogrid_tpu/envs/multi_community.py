@@ -1,0 +1,122 @@
+"""Multi-community simulation with inter-community trading.
+
+BASELINE.md config 5: several communities (e.g. 8 x 128 agents) run in one
+device program — communities ride the same leading batch axis the
+shared-parameter trainer uses for scenarios — and additionally trade their
+*residual* grid power with each other at the P2P midpoint price.
+
+The reference has no multi-community capability at all (SURVEY.md section 2);
+the design here reuses the community-level market math one level up: after
+intra-community clearing, each community's residual ``r_c = sum_a p_grid``
+is offered equally to the other communities, the same sign-opposition
+pairwise matching (ops/market.py:clear_market) runs on the [C, C] proposal
+matrix, and the matched share of each community's residual settles at the
+trade price instead of the grid tariff. Settlement is blended pro-rata
+across a community's agents: an agent's grid-bound power costs
+``(1 - f_c) * tariff + f_c * trade_price`` where ``f_c`` is the fraction of
+its community's residual matched inter-community.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.envs.community import AgentRatings, EpisodeArrays
+from p2pmicrogrid_tpu.ops.market import clear_market
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    make_shared_episode_fn,
+    train_scenarios_shared,
+)
+
+
+def inter_community_traded_fraction(p_grid: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of each community's grid residual matched with other
+    communities.
+
+    p_grid: [C, A] per-agent grid-bound power after intra-community clearing.
+    Returns f [C] in [0, 1]: each community offers its residual equally to
+    the other C-1 communities; sign-opposition matching clears the [C, C]
+    proposals exactly like the intra-community market (community.py:45-54,
+    one level up).
+    """
+    r = jnp.sum(p_grid, axis=-1)  # [C]
+    c = r.shape[0]
+    if c < 2:
+        return jnp.zeros_like(r)  # a lone community has no one to trade with
+    eye = jnp.eye(c, dtype=p_grid.dtype)
+    proposals = r[:, None] * (1.0 - eye) / (c - 1)
+    _, matched = clear_market(proposals)  # matched [C], same sign as r
+    safe_r = jnp.where(jnp.abs(r) > 1e-6, r, 1.0)
+    f = jnp.where(jnp.abs(r) > 1e-6, matched / safe_r, 0.0)
+    return jnp.clip(f, 0.0, 1.0)
+
+
+def make_inter_community_settlement(cfg: ExperimentConfig) -> Callable:
+    """Settlement hook for ``slot_dynamics_batched`` where the leading axis is
+    communities: intra-community P2P settles at the trade price as usual, and
+    the inter-community-matched share of grid power is re-priced from the
+    tariff to the trade price."""
+    slot_hours = cfg.sim.slot_hours
+
+    def settle(p_grid, p_p2p, buy, inj, trade):
+        # p_grid/p_p2p [C, A]; buy/inj/trade [C] (identical entries — one
+        # tariff; kept per-community for shape uniformity).
+        f = inter_community_traded_fraction(p_grid)[:, None]  # [C, 1]
+        tariff = jnp.where(p_grid >= 0.0, buy[:, None], inj[:, None])
+        grid_price = (1.0 - f) * tariff + f * trade[:, None]
+        cost = (p_grid * grid_price + p_p2p * trade[:, None]) * slot_hours * 1e-3
+        return cost
+
+    return settle
+
+
+def make_multi_community_episode_fn(
+    cfg: ExperimentConfig,
+    policy,
+    arrays_c: EpisodeArrays,
+    ratings: AgentRatings,
+) -> Callable:
+    """Jitted episode over C communities (leading axis of ``arrays_c``) with
+    shared policy parameters and inter-community trading."""
+    return make_shared_episode_fn(
+        cfg,
+        policy,
+        arrays_c,
+        ratings,
+        settlement_hook=make_inter_community_settlement(cfg),
+    )
+
+
+def train_multi_community(
+    cfg: ExperimentConfig,
+    policy,
+    pol_state,
+    arrays_c: EpisodeArrays,
+    ratings: AgentRatings,
+    key: jax.Array,
+    n_episodes: int,
+    replay_s=None,
+) -> Tuple[object, object, np.ndarray, float]:
+    """Train C communities with inter-community trading (shared parameters).
+
+    Same contract as ``train_scenarios_shared`` — communities are the leading
+    axis of ``arrays_c`` (build with ``stack_scenario_arrays`` over one trace
+    draw per community).
+    """
+    episode_fn = make_multi_community_episode_fn(cfg, policy, arrays_c, ratings)
+    return train_scenarios_shared(
+        cfg,
+        policy,
+        pol_state,
+        arrays_c,
+        ratings,
+        key,
+        n_episodes,
+        replay_s=replay_s,
+        episode_fn=episode_fn,
+    )

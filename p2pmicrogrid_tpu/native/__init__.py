@@ -1,0 +1,118 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+Currently: the trace/scenario generator (tracegen.cpp) — the data-loader hot
+path for Monte-Carlo scenario training. The library is compiled on first use
+with the system g++ into this package's ``_build`` directory and cached; all
+entry points degrade gracefully (``available()`` returns False) when no
+compiler is present, and the NumPy generator (data/traces.py) remains the
+fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SO_PATH = os.path.join(_BUILD_DIR, "libtracegen.so")
+_SRC = os.path.join(_HERE, "tracegen.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+SLOTS_PER_DAY = 96
+
+
+def _compile() -> Optional[str]:
+    """g++ -O2 -shared -fPIC tracegen.cpp; returns an error string or None."""
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _SO_PATH, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"{type(e).__name__}: {e}"
+    if proc.returncode != 0:
+        return proc.stderr[-2000:]
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        if not os.path.exists(_SO_PATH) or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC):
+            err = _compile()
+            if err is not None:
+                _build_error = err
+                return None
+        lib = ctypes.CDLL(_SO_PATH)
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.p2pmg_generate_traces.argtypes = [
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            f32p, f32p, f32p, f32p, i32p,
+        ]
+        lib.p2pmg_generate_traces.restype = None
+        lib.p2pmg_generate_scenarios.argtypes = [
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, f32p, f32p, f32p, f32p, i32p,
+        ]
+        lib.p2pmg_generate_scenarios.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native generator compiled and loaded."""
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def generate_traces(
+    seed: int, n_days: int, n_profiles: int, start_day: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """One scenario: (time [T], t_out [T], load [T, P], pv [T, P], day [T])."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native tracegen unavailable: {_build_error}")
+    T = n_days * SLOTS_PER_DAY
+    time = np.empty(T, np.float32)
+    t_out = np.empty(T, np.float32)
+    load = np.empty((T, n_profiles), np.float32)
+    pv = np.empty((T, n_profiles), np.float32)
+    day = np.empty(T, np.int32)
+    lib.p2pmg_generate_traces(seed, n_days, n_profiles, start_day, time, t_out, load, pv, day)
+    return time, t_out, load, pv, day
+
+
+def generate_scenarios(
+    seed: int, n_scenarios: int, n_days: int, n_profiles: int, start_day: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """S scenarios at once: leaves shaped [S, T(, P)]."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native tracegen unavailable: {_build_error}")
+    T = n_days * SLOTS_PER_DAY
+    time = np.empty((n_scenarios, T), np.float32)
+    t_out = np.empty((n_scenarios, T), np.float32)
+    load = np.empty((n_scenarios, T, n_profiles), np.float32)
+    pv = np.empty((n_scenarios, T, n_profiles), np.float32)
+    day = np.empty((n_scenarios, T), np.int32)
+    lib.p2pmg_generate_scenarios(
+        seed, n_scenarios, n_days, n_profiles, start_day, time, t_out, load, pv, day
+    )
+    return time, t_out, load, pv, day

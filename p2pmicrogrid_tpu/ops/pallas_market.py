@@ -22,7 +22,6 @@ reference implementation and the default for single-scenario shapes.
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -92,7 +91,7 @@ def _block(s: int) -> int:
     return b
 
 
-@functools.partial(jax.jit, static_argnames=())
+@jax.jit
 def prep_mean(p2p: jnp.ndarray) -> jnp.ndarray:
     """[S, A, A] -> [S, A] fused diag-zero + negate-transpose + mean."""
     s, a, _ = p2p.shape

@@ -59,11 +59,40 @@ def make_scenario_traces(
     n_days: int = 1,
     seed: int = 0,
     start_day: int = 11,
+    backend: str = "auto",
 ) -> TraceSet:
     """S independent synthetic draws (S = ``cfg.sim.n_scenarios`` unless
     overridden), stacked on a leading scenario axis: leaves are [S, T(, P)].
+
+    ``backend``: 'numpy' uses data/traces.py's generator per scenario;
+    'native' the C++ generator (p2pmicrogrid_tpu/native, ~7x faster per
+    scenario); 'auto' picks native when it is available and S >= 64. The two
+    backends draw from the same profile family but different RNGs — seeds are
+    deterministic within a backend, not across backends.
     """
     S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
+    if backend == "auto":
+        from p2pmicrogrid_tpu import native
+
+        backend = "native" if S >= 64 and native.available() else "numpy"
+
+    if backend == "native":
+        from p2pmicrogrid_tpu import native
+
+        time, t_out, load, pv, day = native.generate_scenarios(
+            seed, S, n_days, 5, start_day
+        )
+        # Per-scenario, per-column max-normalization (dataset.py:47-49).
+        load = load / load.max(axis=1, keepdims=True)
+        pv = pv / pv.max(axis=1, keepdims=True)
+        return TraceSet(
+            time=time,
+            t_out=t_out,
+            load=load.astype(np.float32),
+            pv=pv.astype(np.float32),
+            day=day,
+        )
+
     draws = [
         synthetic_traces(n_days=n_days, seed=seed + s, start_day=start_day).normalized()
         for s in range(S)
@@ -282,11 +311,13 @@ def make_shared_episode_fn(
     policy: Policy,
     arrays_s: EpisodeArrays,
     ratings: AgentRatings,
+    settlement_hook=None,
 ) -> Callable:
     """Jitted: one shared-parameter training episode over S scenarios.
 
     Signature: ((pol_state, replay_s), key) -> ((pol_state, replay_s),
-    rewards [S]). ``replay_s`` is None for tabular.
+    rewards [S]). ``replay_s`` is None for tabular. ``settlement_hook`` is
+    forwarded to ``slot_dynamics_batched`` (inter-community trading).
     """
     impl = cfg.train.implementation
     if impl not in ("tabular", "dqn"):
@@ -299,7 +330,8 @@ def make_shared_episode_fn(
         key, k_act, k_learn = jax.random.split(key, 3)
 
         phys_s, _, outputs_s, tr_s = slot_dynamics_batched(
-            cfg, policy, pol_state, phys_s, xs_t, k_act, ratings_j, explore=True
+            cfg, policy, pol_state, phys_s, xs_t, k_act, ratings_j, explore=True,
+            settlement_hook=settlement_hook,
         )
 
         if impl == "tabular":

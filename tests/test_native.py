@@ -1,0 +1,76 @@
+"""Native (C++) trace-generator tests.
+
+Skip cleanly when no compiler is available; the NumPy generator remains the
+functional fallback either way.
+"""
+
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu import native
+from p2pmicrogrid_tpu.config import SimConfig, default_config
+from p2pmicrogrid_tpu.data.traces import (
+    TESTING_DAYS,
+    TRAINING_DAYS,
+    VALIDATION_DAYS,
+    synthetic_traces_native,
+    train_validation_test_split,
+)
+from p2pmicrogrid_tpu.parallel import make_scenario_traces
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native tracegen unavailable: {native.build_error()}"
+)
+
+
+class TestNativeGenerator:
+    def test_shapes_and_ranges(self):
+        tr = synthetic_traces_native(n_days=3, n_profiles=4, seed=7, start_day=11)
+        assert tr.time.shape == (288,)
+        assert tr.load.shape == (288, 4)
+        assert tr.pv.shape == (288, 4)
+        # Same families as the NumPy generator: positive load, clipped PV,
+        # plausible October temperatures.
+        assert tr.load.min() >= 0.02 - 1e-6
+        assert tr.pv.min() >= 0.0
+        assert -10 < tr.t_out.mean() < 25
+        # Night slots have zero PV.
+        assert float(tr.pv[:8].max()) == 0.0
+
+    def test_time_and_day_encoding(self):
+        tr = synthetic_traces_native(n_days=2, start_day=11)
+        np.testing.assert_allclose(tr.time[:96], np.arange(96) / 96, rtol=1e-6)
+        assert set(np.unique(tr.day)) == {11, 12}
+
+    def test_deterministic_and_seed_sensitive(self):
+        a = synthetic_traces_native(seed=3)
+        b = synthetic_traces_native(seed=3)
+        c = synthetic_traces_native(seed=4)
+        np.testing.assert_array_equal(a.load, b.load)
+        assert not np.allclose(a.load, c.load)
+
+    def test_day_splits_apply(self):
+        tr = synthetic_traces_native(n_days=13, start_day=8)
+        train, val, test = train_validation_test_split(tr)
+        assert set(np.unique(train.day)) == set(TRAINING_DAYS)
+        assert set(np.unique(val.day)) == set(VALIDATION_DAYS)
+        assert set(np.unique(test.day)) == set(TESTING_DAYS)
+
+
+class TestScenarioBackend:
+    def test_native_scenarios_normalized_and_aligned(self):
+        cfg = default_config(sim=SimConfig(n_scenarios=64))
+        tr = make_scenario_traces(cfg, backend="native")
+        assert tr.time.shape == (64, 96)
+        # Per-scenario normalization to max 1.
+        np.testing.assert_allclose(tr.load.max(axis=1), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(tr.pv.max(axis=1), 1.0, rtol=1e-6)
+        # Shared slot grid (required by the shared-tabular update).
+        assert (np.asarray(tr.time) == np.asarray(tr.time[:1])).all()
+        # Scenarios are independent draws.
+        assert not np.allclose(tr.load[0], tr.load[1])
+
+    def test_auto_backend_small_s_uses_numpy(self):
+        cfg = default_config(sim=SimConfig(n_scenarios=2))
+        tr = make_scenario_traces(cfg, backend="auto")
+        assert tr.time.shape == (2, 96)
