@@ -21,6 +21,7 @@ from p2pmicrogrid_tpu.analysis.plots import (
     plot_day_traces,
     plot_rounds_decisions,
     plot_qtable_heatmap,
+    plot_sweep_curves,
 )
 
 __all__ = [

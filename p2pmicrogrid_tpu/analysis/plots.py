@@ -118,6 +118,23 @@ def plot_rounds_decisions(rounds_df, setting: str, day: int):
     return fig
 
 
+def plot_sweep_curves(sweep_df, metric: str = "training"):
+    """Hyperparameter-sweep curves from the ``hyperparameters_single_day``
+    table (the reference's DDPG sweep figures, data_analysis.py:1460-1629):
+    one line per (settings, trial), episode on x."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for (settings, trial), g in sweep_df.groupby(["settings", "trial"]):
+        g = g.sort_values("episode")
+        ax.plot(g["episode"], g[metric], label=f"{settings} #{trial}", alpha=0.8)
+    ax.set_xlabel("Episode")
+    ax.set_ylabel(metric)
+    ax.set_title(f"Hyperparameter sweep — {metric}")
+    ax.legend(fontsize=6)
+    fig.tight_layout()
+    return fig
+
+
 def plot_qtable_heatmap(q_table: np.ndarray):
     """Greedy-policy heatmap over (time, temperature), marginalizing the
     balance/p2p state dims (data_analysis.py:1214-1297).

@@ -112,10 +112,21 @@ def cmd_train(args) -> int:
         save_checkpoint(ckpt_dir, ps, ep)
 
     print(f"setting: {cfg.setting} ({cfg.train.implementation})")
-    result = train_community(
-        cfg, policy, pol_state, train_traces, ratings, key,
-        progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
-    )
+    if args.profile_dir:
+        # jax.profiler trace of the training run (SURVEY.md section 5: the
+        # reference only has wall-clock brackets, community.py:269-316).
+        import contextlib
+
+        profile_ctx = jax.profiler.trace(args.profile_dir)
+    else:
+        import contextlib
+
+        profile_ctx = contextlib.nullcontext()
+    with profile_ctx:
+        result = train_community(
+            cfg, policy, pol_state, train_traces, ratings, key,
+            progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
+        )
     save_checkpoint(ckpt_dir, result.pol_state, cfg.train.max_episodes - 1)
     if args.timing_json:
         _save_times(args.timing_json, cfg.setting, train_time=result.train_seconds)
@@ -266,6 +277,75 @@ def _persist_setting(args, cfg) -> str:
     return f"{cfg.sim.n_agents}-agent-{agent}-pv-drop-{com}"
 
 
+def cmd_sweep(args) -> int:
+    """DDPG hyperparameter sweep (the capability behind the reference's
+    commented-out sweep harness, rl.py:553-652, and its
+    hyperparameters_single_day result table): grid over actor learning rate,
+    tau, and OU sigma on a single-agent community; per-trial training reward
+    and greedy validation reward logged per progress window."""
+    import dataclasses
+    import itertools
+
+    import jax
+
+    from p2pmicrogrid_tpu.config import DDPGConfig
+    from p2pmicrogrid_tpu.data import ResultsStore
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.train import (
+        evaluate_community,
+        init_policy_state,
+        make_policy,
+        train_community,
+    )
+
+    cfg0 = _build_cfg(args)
+    train_traces, val_traces, _ = _load_traces(args)
+    store = ResultsStore(args.results_db) if args.results_db else None
+
+    grid = list(
+        itertools.product(
+            [float(x) for x in args.actor_lrs.split(",")],
+            [float(x) for x in args.taus.split(",")],
+            [float(x) for x in args.ou_sigmas.split(",")],
+        )
+    )
+    for trial, (lr, tau, sigma) in enumerate(grid):
+        cfg = cfg0.replace(
+            ddpg=dataclasses.replace(
+                cfg0.ddpg, actor_lr=lr, critic_lr=2 * lr, tau=tau, ou_sigma=sigma
+            ),
+            train=dataclasses.replace(cfg0.train, implementation="ddpg"),
+        )
+        settings = f"ddpg-lr{lr:g}-tau{tau:g}-sigma{sigma:g}"
+        rng = np.random.default_rng(cfg.train.seed)
+        ratings = make_ratings(cfg, rng)
+        key = jax.random.PRNGKey(cfg.train.seed + trial)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, key)
+
+        res = train_community(cfg, policy, ps, train_traces, ratings, key)
+        val = float("nan")
+        if store:
+            # Per-window training rewards from the run, then one greedy
+            # validation pass with the final parameters.
+            _, outs, _ = evaluate_community(
+                cfg, policy, res.pol_state, val_traces, ratings,
+                jax.random.PRNGKey(0), rng=np.random.default_rng(0),
+            )
+            val = float(np.asarray(outs.reward).sum())
+            for ep, train_r, _err in res.progress:
+                store.log_sweep_point(settings, trial, ep, train_r, val)
+            store.log_sweep_point(
+                settings, trial, cfg.train.max_episodes,
+                res.episode_rewards[-1], val,
+            )
+        print(
+            f"trial {trial} {settings}: final train reward "
+            f"{res.episode_rewards[-1]:.1f}, validation {val:.1f}"
+        )
+    return 0
+
+
 def cmd_bench(args) -> int:
     from p2pmicrogrid_tpu.benchmarks import main as bench_main
 
@@ -327,6 +407,8 @@ def main(argv=None) -> int:
     _add_common(p)
     p.add_argument("--jit-block", type=int, default=1, dest="jit_block")
     p.add_argument("--scenarios", type=int, default=1)
+    p.add_argument("--profile-dir", dest="profile_dir",
+                   help="write a jax.profiler trace of the training run here")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a trained community per day")
@@ -344,6 +426,13 @@ def main(argv=None) -> int:
                    default="rule-based")
     p.add_argument("--pv-drop", dest="pv_drop", metavar="AGENT[:START[:FACTOR]]")
     p.set_defaults(fn=cmd_baseline)
+
+    p = sub.add_parser("sweep", help="DDPG hyperparameter sweep")
+    _add_common(p)
+    p.add_argument("--actor-lrs", default="1e-4,3e-4", dest="actor_lrs")
+    p.add_argument("--taus", default="0.005", dest="taus")
+    p.add_argument("--ou-sigmas", default="0.1", dest="ou_sigmas")
+    p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser("bench", help="run the benchmark")
     p.set_defaults(fn=cmd_bench)

@@ -215,6 +215,80 @@ class ResultsStore:
                 records,
             )
 
+    def ingest_measurements(self, df) -> None:
+        """Load a measurement DataFrame into the ``environment`` + ``load``
+        tables (the reference's ``insert_data_from_dict``, database.py:84-93,
+        generalized to the l0..l4 load schema).
+
+        Expects columns: date, time, utc, temperature, cloud_cover, humidity,
+        pv, and any subset of l0..l4 (missing ones stored as NULL). This is
+        the working replacement for the reference's empty
+        ``access_smarthor_data_api.py`` ingestion stub.
+        """
+        n = len(df)
+        zeros = [0.0] * n
+        env_records = list(
+            zip(
+                df["date"],
+                df["time"],
+                df["utc"],
+                df.get("temperature", zeros),
+                df.get("cloud_cover", zeros),
+                df.get("humidity", zeros),
+                df.get("irradiation", zeros),
+                df.get("pv", zeros),
+            )
+        )
+        nulls = [None] * n
+        load_records = list(
+            zip(
+                df["date"],
+                df["time"],
+                df["utc"],
+                *(df.get(c, nulls) for c in ("l0", "l1", "l2", "l3", "l4")),
+            )
+        )
+        with self.con:
+            self.con.executemany(
+                "INSERT OR REPLACE INTO environment VALUES (?,?,?,?,?,?,?,?)",
+                env_records,
+            )
+            self.con.executemany(
+                "INSERT OR REPLACE INTO load VALUES (?,?,?,?,?,?,?,?)", load_records
+            )
+
+    def derive_additional_load(
+        self, source_col: str = "l0", target_col: str = "l4", seed: int = 0
+    ) -> None:
+        """Synthesize an extra household column by day-permuting an existing
+        one (the reference's ``generate_additional_load``, database.py:96-125,
+        with its undefined-``conn`` bug fixed): clip outliers at 2x median,
+        invert around the max, and permute whole days."""
+        import pandas as pd
+
+        df = pd.read_sql_query("SELECT * FROM load", self.con)
+        if df.empty:
+            return
+        src = df[source_col].astype(float)
+        med2 = src.median() * 2
+        src = src.clip(upper=med2)
+        max_l = src.max()
+        inverted = 1.0 - src / max_l
+        df["_day"] = df["date"]
+        days = df["_day"].unique().tolist()
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(days))
+        permuted = pd.concat(
+            [inverted[df["_day"] == days[i]] for i in order]
+        ).reset_index(drop=True)
+        values = (permuted * max_l).tolist()
+        records = list(zip(values, df["date"], df["time"], df["utc"]))
+        with self.con:
+            self.con.executemany(
+                f"UPDATE load SET {target_col} = ? WHERE date = ? AND time = ? AND utc = ?",
+                records,
+            )
+
     # -- readers (database.py:212-345) --------------------------------------
 
     def _read(self, table: str, where: str = "", params: tuple = ()):

@@ -1,0 +1,86 @@
+"""Golden-trace regression test (SURVEY.md section 4, oracle c).
+
+A fully deterministic scripted 2-agent greedy episode (planted Q-table, fixed
+seeds, CPU) is pinned to values generated at framework version 0.1.0. Any
+semantic drift in observation assembly, negotiation, market clearing,
+settlement, rewards, or the thermal model shows up here first.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.data import synthetic_traces
+from p2pmicrogrid_tpu.envs import (
+    build_episode_arrays,
+    init_physical,
+    make_ratings,
+    run_episode,
+)
+from p2pmicrogrid_tpu.models import tabular_init
+from p2pmicrogrid_tpu.train import make_policy
+
+GOLDEN = {
+    "cost": [
+        [0.002175, 0.087167],
+        [0.082664, 0.001432],
+        [0.042346, 0.039303],
+        [0.037923, 0.036898],
+    ],
+    "p_grid": [
+        [77.043663, 3087.079102],
+        [3103.469238, 53.755768],
+        [1687.529175, 1566.260376],
+        [1604.566772, 1561.177368],
+    ],
+    "t_in": [
+        [21.301205, 20.728098],
+        [20.790424, 21.540831],
+        [21.560457, 21.001354],
+        [21.548647, 21.147606],
+    ],
+    "hp_power_w": [
+        [0.0, 3000.0],
+        [3000.0, 0.0],
+        [1500.0, 1500.0],
+        [1500.0, 1500.0],
+    ],
+    "max_in": [4565.099121, 4606.924316],
+}
+
+
+def test_scripted_episode_matches_golden():
+    cfg = default_config(
+        sim=SimConfig(n_agents=2, rounds=1),
+        train=TrainConfig(implementation="tabular"),
+    )
+    traces = synthetic_traces(n_days=1, start_day=11).normalized()
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    np.testing.assert_allclose(ratings.max_in, GOLDEN["max_in"], rtol=1e-5)
+
+    arrays = build_episode_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    ps = tabular_init(cfg.qlearning, 2)
+    ps = ps._replace(
+        q_table=jax.random.normal(jax.random.PRNGKey(5), ps.q_table.shape)
+    )
+    phys = init_physical(cfg, jax.random.PRNGKey(0))
+
+    _, _, out = run_episode(
+        cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7), training=False
+    )
+
+    for name in ("cost", "p_grid", "t_in", "hp_power_w"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(out, name))[:4],
+            GOLDEN[name],
+            rtol=2e-4,
+            atol=1e-5,
+            err_msg=name,
+        )
+    # Reward is exactly -cost here (temperatures stay inside the comfort band
+    # in these slots, zero penalty).
+    np.testing.assert_allclose(
+        np.asarray(out.reward)[:4], -np.asarray(GOLDEN["cost"]), rtol=2e-4, atol=1e-5
+    )
