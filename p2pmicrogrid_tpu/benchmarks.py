@@ -51,7 +51,11 @@ def jax_steps_per_sec() -> float:
         train=TrainConfig(implementation="tabular"),
     )
     ratings = make_ratings(cfg, np.random.default_rng(42))
-    traces = make_scenario_traces(cfg)
+    from p2pmicrogrid_tpu import native
+
+    traces = make_scenario_traces(
+        cfg, backend="native" if native.available() else "numpy"
+    )
     arrays = stack_scenario_arrays(cfg, traces, ratings)
     key = jax.random.PRNGKey(0)
     policy = make_policy(cfg)

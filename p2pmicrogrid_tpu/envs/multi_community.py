@@ -11,10 +11,12 @@ intra-community clearing, each community's residual ``r_c = sum_a p_grid``
 is offered equally to the other communities, the same sign-opposition
 pairwise matching (ops/market.py:clear_market) runs on the [C, C] proposal
 matrix, and the matched share of each community's residual settles at the
-trade price instead of the grid tariff. Settlement is blended pro-rata
-across a community's agents: an agent's grid-bound power costs
-``(1 - f_c) * tariff + f_c * trade_price`` where ``f_c`` is the fraction of
-its community's residual matched inter-community.
+trade price instead of the grid tariff. Settlement is conservative: the
+matched power ``f_c * r_c`` is re-priced pro-rata across only the agents
+whose grid power has the residual's sign (they are the ones physically
+backing the inter-community exchange), so the energy re-priced at the trade
+price equals the matched energy exactly; counter-sign agents settle at the
+plain tariff.
 """
 
 from __future__ import annotations
@@ -60,15 +62,27 @@ def make_inter_community_settlement(cfg: ExperimentConfig) -> Callable:
     """Settlement hook for ``slot_dynamics_batched`` where the leading axis is
     communities: intra-community P2P settles at the trade price as usual, and
     the inter-community-matched share of grid power is re-priced from the
-    tariff to the trade price."""
+    tariff to the trade price, spread only over the agents that back the
+    residual so re-priced energy equals matched energy."""
     slot_hours = cfg.sim.slot_hours
 
     def settle(p_grid, p_p2p, buy, inj, trade):
         # p_grid/p_p2p [C, A]; buy/inj/trade [C] (identical entries — one
         # tariff; kept per-community for shape uniformity).
-        f = inter_community_traded_fraction(p_grid)[:, None]  # [C, 1]
+        f = inter_community_traded_fraction(p_grid)  # [C]
+        r = jnp.sum(p_grid, axis=-1)                 # [C] residual
+        matched = f * r                              # [C] power re-priced
+        # Only agents whose grid power carries the residual's sign back the
+        # inter-community exchange; spreading the matched power over them
+        # pro-rata keeps Σ re-priced power == matched power (conservation).
+        same_sign = jnp.sign(p_grid) == jnp.sign(r)[:, None]  # [C, A]
+        backing = jnp.sum(jnp.where(same_sign, p_grid, 0.0), axis=-1)  # [C]
+        safe_b = jnp.where(jnp.abs(backing) > 1e-6, backing, 1.0)
+        share = jnp.where(jnp.abs(backing) > 1e-6, matched / safe_b, 0.0)
+        # |backing| >= |r| >= |matched|, so share stays in [0, 1].
+        frac = jnp.where(same_sign, share[:, None], 0.0)      # [C, A]
         tariff = jnp.where(p_grid >= 0.0, buy[:, None], inj[:, None])
-        grid_price = (1.0 - f) * tariff + f * trade[:, None]
+        grid_price = (1.0 - frac) * tariff + frac * trade[:, None]
         cost = (p_grid * grid_price + p_p2p * trade[:, None]) * slot_hours * 1e-3
         return cost
 

@@ -59,22 +59,32 @@ def make_scenario_traces(
     n_days: int = 1,
     seed: int = 0,
     start_day: int = 11,
-    backend: str = "auto",
+    backend: str = "numpy",
 ) -> TraceSet:
     """S independent synthetic draws (S = ``cfg.sim.n_scenarios`` unless
     overridden), stacked on a leading scenario axis: leaves are [S, T(, P)].
 
-    ``backend``: 'numpy' uses data/traces.py's generator per scenario;
-    'native' the C++ generator (p2pmicrogrid_tpu/native, ~7x faster per
-    scenario); 'auto' picks native when it is available and S >= 64. The two
-    backends draw from the same profile family but different RNGs — seeds are
-    deterministic within a backend, not across backends.
+    ``backend``: 'numpy' (default) uses data/traces.py's generator per
+    scenario; 'native' the C++ generator (p2pmicrogrid_tpu/native, ~7x faster
+    per scenario). The two backends draw from the same profile family but
+    different RNGs, so the default is the one deterministic everywhere —
+    'native' is an explicit opt-in (it also needs g++ at first use). 'auto'
+    (deprecated) picks native when available and S >= 64, and warns with the
+    chosen backend since the choice changes seeded trace values.
     """
     S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
     if backend == "auto":
+        import warnings
+
         from p2pmicrogrid_tpu import native
 
         backend = "native" if S >= 64 and native.available() else "numpy"
+        warnings.warn(
+            f"make_scenario_traces(backend='auto') chose {backend!r}; seeded "
+            "trace values differ between backends — pass backend= explicitly "
+            "for reproducible runs",
+            stacklevel=2,
+        )
 
     if backend == "native":
         from p2pmicrogrid_tpu import native

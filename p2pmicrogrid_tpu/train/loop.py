@@ -73,8 +73,10 @@ def make_train_step(
     policy: Policy,
     arrays: EpisodeArrays,
     ratings: AgentRatings,
+    block: Optional[int] = None,
 ) -> Callable:
-    """Jitted function running ``episodes_per_jit_block`` training episodes.
+    """Jitted function running ``block`` training episodes (defaults to
+    ``episodes_per_jit_block``).
 
     Each episode starts from a freshly drawn physical state (the reference
     re-randomizes indoor temperatures on every reset, heating.py:145-152) and
@@ -83,7 +85,8 @@ def make_train_step(
     runs *inside* the block via ``lax.cond`` keyed on the global episode index,
     so fused blocks follow the reference schedule exactly.
     """
-    block = cfg.train.episodes_per_jit_block
+    if block is None:
+        block = cfg.train.episodes_per_jit_block
     criterion = cfg.train.min_episodes_criterion
 
     def one_episode(pol_state, key):
@@ -187,9 +190,21 @@ def train_community(
     start = _time.time()
     episode = t.starting_episodes
     phys = None
+    tail_block = None  # compiled lazily for a non-multiple final block
     while episode < t.max_episodes:
         key, k_block = jax.random.split(key)
-        pol_state, phys, rewards, losses = train_block(
+        remaining = t.max_episodes - episode
+        if remaining < block:
+            # Clamp the final block so exactly max_episodes episodes run
+            # (a full extra block would overshoot the configured count).
+            if tail_block is None:
+                tail_block = make_train_step(
+                    cfg, policy, arrays, ratings, block=remaining
+                )
+            step_fn, step_size = tail_block, remaining
+        else:
+            step_fn, step_size = train_block, block
+        pol_state, phys, rewards, losses = step_fn(
             pol_state, jnp.asarray(episode), k_block
         )
         rewards = np.asarray(rewards)
@@ -218,7 +233,7 @@ def train_community(
             if (ep + 1) % t.save_episodes == 0 and checkpoint_cb:
                 checkpoint_cb(ep, pol_state)
 
-        episode += block
+        episode += step_size
 
     # Block until the device is done so the timing is honest.
     jax.block_until_ready(pol_state)
@@ -281,9 +296,13 @@ def evaluate_community(
     @jax.jit
     def eval_all(pol_state, keys):
         def one_day(arrays, k):
-            phys = init_physical(cfg, k)
+            # Independent keys for the initial temperatures and the episode —
+            # greedy eval consumes no episode randomness today, but correlated
+            # keys would silently bias any future stochastic-eval path.
+            k_phys, k_ep = jax.random.split(k)
+            phys = init_physical(cfg, k_phys)
             _, _, outputs = run_episode(
-                cfg, policy, pol_state, phys, arrays, ratings_j, k, training=False
+                cfg, policy, pol_state, phys, arrays, ratings_j, k_ep, training=False
             )
             return outputs
 

@@ -271,6 +271,23 @@ class TestTraining:
         res = train_community(cfg, policy, ps, day_traces, ratings, jax.random.PRNGKey(0))
         assert len(res.episode_rewards) == 4
 
+    def test_non_multiple_block_clamps_to_max_episodes(self, day_traces):
+        # 5 episodes with block 2: the final block must be clamped to 1, not
+        # run a full extra block past max_episodes (ADVICE round 1).
+        cfg = small_cfg()
+        cfg = cfg.replace(
+            train=TrainConfig(
+                max_episodes=5, min_episodes_criterion=2, episodes_per_jit_block=2
+            )
+        )
+        rng = np.random.default_rng(42)
+        ratings = make_ratings(cfg, rng)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        res = train_community(cfg, policy, ps, day_traces, ratings, jax.random.PRNGKey(0))
+        assert len(res.episode_rewards) == 5
+        assert res.env_steps == 5 * 96
+
 
 class TestEvaluation:
     def test_per_day_eval_shapes(self):

@@ -50,6 +50,48 @@ class TestTradedFraction:
         np.testing.assert_allclose(np.asarray(f), 0.0)
 
 
+class TestConservativeSettlement:
+    def test_repriced_energy_equals_matched_energy(self):
+        """The trade-priced share of grid energy must equal the matched
+        inter-community power exactly, even when some agents' grid power
+        opposes their community's residual (ADVICE round 1)."""
+        from p2pmicrogrid_tpu.envs.multi_community import (
+            make_inter_community_settlement,
+        )
+
+        cfg = default_config(sim=SimConfig(n_agents=3))
+        settle = make_inter_community_settlement(cfg)
+        # Residuals r = [+800, -500]; with C=2 each community offers its full
+        # residual to the other, so matched = [+500, -500], f = [0.625, 1.0].
+        # Community 0 also has a counter-sign agent (-200) that must settle at
+        # the plain tariff.
+        p_grid = jnp.array([[700.0, 300.0, -200.0], [-100.0, -300.0, -100.0]])
+        p_p2p = jnp.zeros_like(p_grid)
+        buy = jnp.array([0.15, 0.15])
+        inj = jnp.array([0.07, 0.07])
+        trade = jnp.array([0.11, 0.11])
+
+        cost = settle(p_grid, p_p2p, buy, inj, trade)
+        # Plain-tariff settlement for comparison.
+        tariff = jnp.where(p_grid >= 0.0, buy[:, None], inj[:, None])
+        plain = p_grid * tariff * cfg.sim.slot_hours * 1e-3
+
+        r = jnp.sum(p_grid, axis=-1)
+        f = inter_community_traded_fraction(p_grid)
+        matched = f * r
+        # Savings per community = matched * (tariff_of_residual_sign - trade):
+        # every re-priced watt belonged to a residual-sign agent.
+        res_tariff = jnp.where(r >= 0.0, buy, inj)
+        expected_delta = matched * (trade - res_tariff) * cfg.sim.slot_hours * 1e-3
+        np.testing.assert_allclose(
+            np.asarray(jnp.sum(cost - plain, axis=-1)),
+            np.asarray(expected_delta),
+            rtol=1e-5,
+        )
+        # And something actually matched in this fixture.
+        assert float(jnp.abs(matched).sum()) > 0.0
+
+
 class TestTraining:
     def setup_method(self):
         self.cfg = default_config(

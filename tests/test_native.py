@@ -70,7 +70,16 @@ class TestScenarioBackend:
         # Scenarios are independent draws.
         assert not np.allclose(tr.load[0], tr.load[1])
 
-    def test_auto_backend_small_s_uses_numpy(self):
+    def test_auto_backend_small_s_uses_numpy_and_warns(self):
         cfg = default_config(sim=SimConfig(n_scenarios=2))
-        tr = make_scenario_traces(cfg, backend="auto")
+        with pytest.warns(UserWarning, match="chose 'numpy'"):
+            tr = make_scenario_traces(cfg, backend="auto")
         assert tr.time.shape == (2, 96)
+
+    def test_default_backend_is_deterministic_numpy(self):
+        # The default must not depend on S or on g++ availability
+        # (ADVICE round 1): same seed -> same traces at any scenario count.
+        cfg = default_config(sim=SimConfig(n_scenarios=65))
+        a = make_scenario_traces(cfg, n_scenarios=2, seed=7)
+        b = make_scenario_traces(cfg, n_scenarios=65, seed=7)
+        np.testing.assert_array_equal(np.asarray(a.load), np.asarray(b.load[:2]))
