@@ -63,10 +63,10 @@ def jax_steps_per_sec() -> float:
 
     # One episode fn -> one compiled program reused by warmup and measurement.
     episode_fn = make_shared_episode_fn(cfg, policy, arrays, ratings)
-    ps, _, _, _ = train_scenarios_shared(
+    ps, _, _, _, _ = train_scenarios_shared(
         cfg, policy, ps, arrays, ratings, key, n_episodes=1, episode_fn=episode_fn
     )
-    _, _, _, secs = train_scenarios_shared(
+    _, _, _, _, secs = train_scenarios_shared(
         cfg,
         policy,
         ps,

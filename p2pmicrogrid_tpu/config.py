@@ -166,6 +166,12 @@ class DDPGConfig:
     ou_sigma: float = 0.1            # (rl_backup.py:101)
     ou_dt: float = 1e-2              # (rl_backup.py:66)
     ou_init_sd: float = 1.0          # (rl_backup.py:102)
+    # Shared-parameter scenario training only (parallel/scenarios.py): one
+    # actor-critic shared by ALL agents instead of per-agent copies — the
+    # "shared-critic MARL" of BASELINE.md config 4. Per-agent tiny MLPs run
+    # as A vmapped [S, 4] matmuls; agent-shared runs one [S*A, 4] matmul,
+    # which is what actually fills the MXU at 1000 agents.
+    share_across_agents: bool = False
 
 
 @dataclass(frozen=True)

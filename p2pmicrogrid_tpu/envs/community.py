@@ -388,6 +388,8 @@ def slot_dynamics_batched(
     ratings: AgentRatings,
     explore: bool,
     settlement_hook=None,
+    act_fn=None,
+    explore_state=None,
 ):
     """Scenario-batched slot dynamics: same semantics as ``slot_dynamics``
     but with an explicit leading scenario axis on all simulation state
@@ -403,6 +405,15 @@ def slot_dynamics_batched(
     point for inter-community trading (envs/multi_community.py), where the
     leading axis is communities and part of each community's grid residual
     settles peer-to-peer with other communities.
+
+    ``act_fn(pol_state, obs [S, A, 4], prev_frac [S, A], round_key,
+    explore_state) -> (hp_frac, aux, q, explore_state)`` optionally replaces
+    the default vmapped ``policy.act`` — used by policies whose exploration
+    carries per-scenario state that must survive across rounds/slots (the OU
+    noise of shared DDPG). ``explore_state`` is threaded through every
+    negotiation round and returned.
+
+    Returns (phys', pol_state, outputs, transition, explore_state').
     """
     time_s, t_out_s, load_w, pv_w, next_time_s, next_load_w, next_pv_w = xs
     n_scenarios = load_w.shape[0]
@@ -425,15 +436,20 @@ def slot_dynamics_batched(
         )
     norm_balance = balance_w / ratings.max_in
 
-    def act_batched(pol_state, obs, prev_frac, keys):
-        def one(o, f, k):
-            frac, aux, q, _ = policy.act(pol_state, o, f, k, explore)
-            return frac, aux, q
+    if act_fn is None:
 
-        return jax.vmap(one)(obs, prev_frac, keys)
+        def act_fn(pol_state, obs, prev_frac, round_key, ex):
+            keys = jax.random.split(round_key, n_scenarios)
+
+            def one(o, f, k):
+                frac, aux, q, _ = policy.act(pol_state, o, f, k, explore)
+                return frac, aux, q
+
+            frac, aux, q = jax.vmap(one)(obs, prev_frac, keys)
+            return frac, aux, q, ex
 
     def round_body(carry, round_key):
-        p2p, hp_frac = carry  # p2p [S, A, A]
+        p2p, hp_frac, ex = carry  # p2p [S, A, A]
         if cfg.sim.use_pallas:
             p2p_mean = prep_mean(p2p) / ratings.max_in
         else:
@@ -447,21 +463,24 @@ def slot_dynamics_batched(
             norm_balance,
             p2p_mean,
         )  # [S, A, 4]
-        keys = jax.random.split(round_key, n_scenarios)
-        hp_frac, aux, q = act_batched(pol_state, obs, hp_frac, keys)
+        hp_frac, aux, q, ex = act_fn(pol_state, obs, hp_frac, round_key, ex)
 
         out_power = balance_w + hp_frac * th.hp_max_power
         if cfg.sim.use_pallas:
             p_out = divide_power_fused(p2p, out_power)
         else:
             p_out = divide_power(out_power, powers)
-        return (p_out, hp_frac), (obs, aux, q, hp_frac * th.hp_max_power)
+        return (p_out, hp_frac, ex), (obs, aux, q, hp_frac * th.hp_max_power)
 
     if cfg.sim.trading:
         keys = jax.random.split(key, cfg.sim.rounds + 1)
-        (p2p, hp_frac), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
+        (p2p, hp_frac, explore_state), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
             round_body,
-            (jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1])), phys_s.hp_frac),
+            (
+                jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1])),
+                phys_s.hp_frac,
+                explore_state,
+            ),
             keys,
         )
         obs, aux, q = obs_r[-1], aux_r[-1], q_r[-1]
@@ -478,8 +497,9 @@ def slot_dynamics_batched(
             norm_balance,
             jnp.zeros_like(norm_balance),
         )
-        keys = jax.random.split(key, n_scenarios)
-        hp_frac, aux, q = act_batched(pol_state, obs, phys_s.hp_frac, keys)
+        hp_frac, aux, q, explore_state = act_fn(
+            pol_state, obs, phys_s.hp_frac, key, explore_state
+        )
         p_grid = balance_w + hp_frac * th.hp_max_power
         p_p2p = jnp.zeros_like(p_grid)
         hp_power_r = (hp_frac * th.hp_max_power)[None]
@@ -524,7 +544,7 @@ def slot_dynamics_batched(
         q=q,
     )
     transition = SlotTransition(obs=obs, aux=aux, reward=reward, next_obs=next_obs)
-    return phys_s, pol_state, outputs, transition
+    return phys_s, pol_state, outputs, transition, explore_state
 
 
 def community_slot(

@@ -115,10 +115,11 @@ def train_multi_community(
     key: jax.Array,
     n_episodes: int,
     replay_s=None,
-) -> Tuple[object, object, np.ndarray, float]:
+) -> Tuple[object, object, np.ndarray, np.ndarray, float]:
     """Train C communities with inter-community trading (shared parameters).
 
-    Same contract as ``train_scenarios_shared`` — communities are the leading
+    Same contract as ``train_scenarios_shared`` (returns pol_state,
+    scen_state, rewards, losses, seconds) — communities are the leading
     axis of ``arrays_c`` (build with ``stack_scenario_arrays`` over one trace
     draw per community).
     """

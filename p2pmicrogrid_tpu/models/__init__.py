@@ -28,11 +28,15 @@ from p2pmicrogrid_tpu.models.dqn import (
     dqn_initialize_target,
 )
 from p2pmicrogrid_tpu.models.ddpg import (
+    DDPGParams,
     DDPGState,
     ddpg_init,
     ddpg_act,
     ddpg_update,
     ddpg_decay,
+    ddpg_params_init,
+    ddpg_shared_act,
+    ddpg_learn_batch,
 )
 from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES
 
@@ -57,9 +61,13 @@ __all__ = [
     "dqn_update",
     "dqn_decay",
     "dqn_initialize_target",
+    "DDPGParams",
     "DDPGState",
     "ddpg_init",
     "ddpg_act",
     "ddpg_update",
     "ddpg_decay",
+    "ddpg_params_init",
+    "ddpg_shared_act",
+    "ddpg_learn_batch",
 ]

@@ -42,27 +42,25 @@ class DDPGState(NamedTuple):
     ou_state: jnp.ndarray  # [A] — current OU noise value per agent
 
 
+class DDPGParams(NamedTuple):
+    """Just the learnable bundle — what the shared-parameter scenario trainer
+    (parallel/scenarios.py) carries as its policy state. Leaves have a leading
+    agent axis in per-agent mode, none when parameters are shared across
+    agents (``DDPGConfig.share_across_agents``)."""
+
+    actor: dict
+    critic: dict
+    actor_target: dict
+    critic_target: dict
+    actor_opt: tuple
+    critic_opt: tuple
+
+
 def ddpg_init(cfg: DDPGConfig, n_agents: int, key: jax.Array) -> DDPGState:
-    actor = Actor(hidden=cfg.actor_hidden)
-    critic = Critic(hidden=cfg.critic_hidden)
-    dummy_s = jnp.zeros((1, OBS_DIM))
-    dummy_a = jnp.zeros((1, 1))
     key, k_ou = jax.random.split(key)
-
-    def init_one(k):
-        ka, kc = jax.random.split(k)
-        pa = actor.init(ka, dummy_s)["params"]
-        pc = critic.init(kc, dummy_s, dummy_a)["params"]
-        return pa, pc
-
-    pa, pc = jax.vmap(init_one)(jax.random.split(key, n_agents))
+    p = _params_init_per_agent(cfg, n_agents, key)
     return DDPGState(
-        actor=pa,
-        critic=pc,
-        actor_target=jax.tree_util.tree_map(lambda x: x, pa),
-        critic_target=jax.tree_util.tree_map(lambda x: x, pc),
-        actor_opt=jax.vmap(optax.adam(cfg.actor_lr).init)(pa),
-        critic_opt=jax.vmap(optax.adam(cfg.critic_lr).init)(pc),
+        *p,
         replay=replay_init(n_agents, cfg.buffer_size, OBS_DIM, 1),
         # OU noise starts at x0 ~ N(0, ou_init_sd) (rl_backup.py:81,102).
         ou_state=cfg.ou_init_sd * jax.random.normal(k_ou, (n_agents,)),
@@ -108,6 +106,143 @@ def ddpg_act(
     return a, q, state
 
 
+def ddpg_learn_batch(
+    cfg: DDPGConfig, pa, pc, pat, pct, oa, oc, s, a, r, ns
+) -> Tuple[dict, dict, dict, dict, tuple, tuple, jnp.ndarray]:
+    """One DDPG gradient step on a flat transition batch for ONE parameter set:
+    critic TD(0) toward the target bootstrap, actor policy gradient through the
+    fresh critic, Polyak target updates.
+
+    s/ns: [B, 4]; a: [B, 1]; r: [B]. The single source of the update
+    semantics — ``ddpg_update`` vmaps it per agent, and the shared-parameter
+    scenario trainer (parallel/scenarios.py) calls it on scenario-flattened
+    batches so the per-slot gradient is the scenario average (the
+    psum-over-ICI path when scenario-sharded).
+    """
+    actor = Actor(hidden=cfg.actor_hidden)
+    critic = Critic(hidden=cfg.critic_hidden)
+    a_opt = optax.adam(cfg.actor_lr)
+    c_opt = optax.adam(cfg.critic_lr)
+
+    # Critic: TD(0) toward target actor/critic bootstrap.
+    na = actor.apply({"params": pat}, ns)
+    q_next = critic.apply({"params": pct}, ns, na)[:, 0]
+    q_target = r + cfg.gamma * q_next
+
+    def critic_loss(p):
+        q = critic.apply({"params": p}, s, a)[:, 0]
+        return jnp.mean(jnp.square(q_target - q))
+
+    c_loss, c_grads = jax.value_and_grad(critic_loss)(pc)
+    c_updates, oc = c_opt.update(c_grads, oc, pc)
+    pc = optax.apply_updates(pc, c_updates)
+
+    # Actor: maximize Q(s, pi(s)).
+    def actor_loss(p):
+        pi = actor.apply({"params": p}, s)
+        return -jnp.mean(critic.apply({"params": pc}, s, pi)[:, 0])
+
+    a_grads = jax.grad(actor_loss)(pa)
+    a_updates, oa = a_opt.update(a_grads, oa, pa)
+    pa = optax.apply_updates(pa, a_updates)
+
+    polyak = lambda t, o: jax.tree_util.tree_map(
+        lambda x, y: (1.0 - cfg.tau) * x + cfg.tau * y, t, o
+    )
+    return pa, pc, polyak(pat, pa), polyak(pct, pc), oa, oc, c_loss
+
+
+def _params_init_per_agent(
+    cfg: DDPGConfig, n_agents: int, key: jax.Array
+) -> DDPGParams:
+    """Per-agent parameter stacks [A, ...] — the single source of the
+    actor/critic/optimizer init semantics (``ddpg_init`` layers replay/OU on
+    top; ``ddpg_params_init`` selects this or the agent-shared variant)."""
+    actor = Actor(hidden=cfg.actor_hidden)
+    critic = Critic(hidden=cfg.critic_hidden)
+    dummy_s = jnp.zeros((1, OBS_DIM))
+    dummy_a = jnp.zeros((1, 1))
+
+    def init_one(k):
+        ka, kc = jax.random.split(k)
+        return (
+            actor.init(ka, dummy_s)["params"],
+            critic.init(kc, dummy_s, dummy_a)["params"],
+        )
+
+    if n_agents is None:  # one unbatched parameter set (agent-shared mode)
+        pa, pc = init_one(key)
+        a_opt = optax.adam(cfg.actor_lr).init(pa)
+        c_opt = optax.adam(cfg.critic_lr).init(pc)
+    else:
+        pa, pc = jax.vmap(init_one)(jax.random.split(key, n_agents))
+        a_opt = jax.vmap(optax.adam(cfg.actor_lr).init)(pa)
+        c_opt = jax.vmap(optax.adam(cfg.critic_lr).init)(pc)
+    copy = lambda t: jax.tree_util.tree_map(lambda x: x, t)
+    return DDPGParams(
+        actor=pa,
+        critic=pc,
+        actor_target=copy(pa),
+        critic_target=copy(pc),
+        actor_opt=a_opt,
+        critic_opt=c_opt,
+    )
+
+
+def ddpg_params_init(
+    cfg: DDPGConfig, n_agents: int, key: jax.Array
+) -> DDPGParams:
+    """Learnable bundle for the shared-parameter scenario trainer: per-agent
+    stacks [A, ...] normally, a single unbatched set when
+    ``cfg.share_across_agents`` (one actor-critic for the whole community)."""
+    return _params_init_per_agent(
+        cfg, None if cfg.share_across_agents else n_agents, key
+    )
+
+
+def ddpg_shared_act(
+    cfg: DDPGConfig,
+    params: DDPGParams,
+    obs_s: jnp.ndarray,
+    ou_s: jnp.ndarray,
+    key: jax.Array,
+    explore: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Scenario-batched act for shared parameters: obs_s [S, A, 4],
+    ou_s [S, A] -> (action_frac [S, A], q [S, A], new ou_s).
+
+    Per-agent mode vmaps the nets over the agent axis (scenario axis rides as
+    the MLP batch); agent-shared mode runs ONE [S*A, 4] application — the
+    MXU-filling path at large A. With ``explore=False`` the deterministic
+    action is returned and the OU state is left untouched (mirrors
+    ``ddpg_act``'s greedy path).
+    """
+    actor = Actor(hidden=cfg.actor_hidden)
+    critic = Critic(hidden=cfg.critic_hidden)
+
+    if cfg.share_across_agents:
+        S, A, F = obs_s.shape
+        flat = obs_s.reshape(S * A, F)
+        a = actor.apply({"params": params.actor}, flat)[:, 0]
+        q = critic.apply({"params": params.critic}, flat, a[:, None])[:, 0]
+        a, q = a.reshape(S, A), q.reshape(S, A)
+    else:
+
+        def one_agent(pa, pc, o):  # o [S, 4]
+            a = actor.apply({"params": pa}, o)[:, 0]
+            q = critic.apply({"params": pc}, o, a[:, None])[:, 0]
+            return a, q
+
+        a, q = jax.vmap(one_agent, in_axes=(0, 0, 1), out_axes=1)(
+            params.actor, params.critic, obs_s
+        )
+
+    if not explore:
+        return a, q, ou_s
+    ou_s = _ou_step(cfg, ou_s, key)
+    return jnp.clip(a + ou_s, 0.0, 1.0), q, ou_s
+
+
 def ddpg_update(
     cfg: DDPGConfig,
     state: DDPGState,
@@ -125,38 +260,8 @@ def ddpg_update(
     replay = replay_add(state.replay, obs, action_frac[:, None], reward, next_obs)
     s, a, r, ns = replay_sample(replay, key, cfg.batch_size)
 
-    actor = Actor(hidden=cfg.actor_hidden)
-    critic = Critic(hidden=cfg.critic_hidden)
-    a_opt = optax.adam(cfg.actor_lr)
-    c_opt = optax.adam(cfg.critic_lr)
-
     def learn_one(pa, pc, pat, pct, oa, oc, s, a, r, ns):
-        # Critic: TD(0) toward target actor/critic bootstrap.
-        na = actor.apply({"params": pat}, ns)
-        q_next = critic.apply({"params": pct}, ns, na)[:, 0]
-        q_target = r + cfg.gamma * q_next
-
-        def critic_loss(p):
-            q = critic.apply({"params": p}, s, a)[:, 0]
-            return jnp.mean(jnp.square(q_target - q))
-
-        c_loss, c_grads = jax.value_and_grad(critic_loss)(pc)
-        c_updates, oc = c_opt.update(c_grads, oc, pc)
-        pc = optax.apply_updates(pc, c_updates)
-
-        # Actor: maximize Q(s, pi(s)).
-        def actor_loss(p):
-            pi = actor.apply({"params": p}, s)
-            return -jnp.mean(critic.apply({"params": pc}, s, pi)[:, 0])
-
-        a_grads = jax.grad(actor_loss)(pa)
-        a_updates, oa = a_opt.update(a_grads, oa, pa)
-        pa = optax.apply_updates(pa, a_updates)
-
-        polyak = lambda t, o: jax.tree_util.tree_map(
-            lambda x, y: (1.0 - cfg.tau) * x + cfg.tau * y, t, o
-        )
-        return pa, pc, polyak(pat, pa), polyak(pct, pc), oa, oc, c_loss
+        return ddpg_learn_batch(cfg, pa, pc, pat, pct, oa, oc, s, a, r, ns)
 
     pa, pc, pat, pct, oa, oc, loss = jax.vmap(learn_one)(
         state.actor,

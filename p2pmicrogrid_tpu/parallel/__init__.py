@@ -20,6 +20,8 @@ from p2pmicrogrid_tpu.parallel.mesh import (
     replicated_sharding,
 )
 from p2pmicrogrid_tpu.parallel.scenarios import (
+    DDPGScenState,
+    init_shared_state,
     make_scenario_traces,
     stack_scenario_arrays,
     train_scenarios_independent,
@@ -30,6 +32,8 @@ __all__ = [
     "make_mesh",
     "scenario_sharding",
     "replicated_sharding",
+    "DDPGScenState",
+    "init_shared_state",
     "make_scenario_traces",
     "stack_scenario_arrays",
     "train_scenarios_independent",

@@ -24,7 +24,7 @@ community.py:279-287).
 from __future__ import annotations
 
 import time as _time
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,14 +41,21 @@ from p2pmicrogrid_tpu.envs.community import (
     run_episode,
     slot_dynamics_batched,
 )
+from p2pmicrogrid_tpu.models.ddpg import (
+    DDPGParams,
+    ddpg_learn_batch,
+    ddpg_params_init,
+    ddpg_shared_act,
+)
 from p2pmicrogrid_tpu.models.dqn import (
     ACTION_VALUES,
+    OBS_DIM,
     DQNState,
     QNetwork,
     _td_loss,
     apply_td_update,
 )
-from p2pmicrogrid_tpu.models.replay import replay_add, replay_sample
+from p2pmicrogrid_tpu.models.replay import replay_add, replay_init, replay_sample
 from p2pmicrogrid_tpu.models.tabular import TabularState
 from p2pmicrogrid_tpu.ops.obs import discretize
 
@@ -136,18 +143,29 @@ def _run_episode_loop(
     policy: Policy,
     decay_every: Optional[int],
     episode0: int,
-) -> Tuple[object, np.ndarray, float]:
-    """Shared host loop: run episodes, decay on the reference cadence."""
-    rewards = []
+    episode_cb: Optional[Callable] = None,
+) -> Tuple[object, np.ndarray, np.ndarray, float]:
+    """Shared host loop: run episodes, decay on the reference cadence.
+
+    ``episode_fn(carry, key) -> (carry, (rewards [S], losses [S]))``.
+    ``episode_cb(episode_index, reward [S], loss [S])`` is invoked per episode
+    (progress records, checkpointing). Returns (carry, rewards
+    [episodes, S], losses [episodes, S], seconds).
+    """
+    rewards, losses = [], []
     start = _time.time()
     for e in range(n_episodes):
         key, k = jax.random.split(key)
-        carry, r = episode_fn(carry, k)
+        carry, (r, l) = episode_fn(carry, k)
         if decay_every and (episode0 + e) % decay_every == 0:
             carry = _decay_carry(policy, carry)
-        rewards.append(np.asarray(r))
+        r, l = np.asarray(r), np.asarray(l)
+        rewards.append(r)
+        losses.append(l)
+        if episode_cb:
+            episode_cb(episode0 + e, r, l)
     jax.block_until_ready(carry)
-    return carry, np.stack(rewards), _time.time() - start
+    return carry, np.stack(rewards), np.stack(losses), _time.time() - start
 
 
 def _decay_carry(policy: Policy, carry):
@@ -168,7 +186,7 @@ def make_independent_episode_fn(
 ) -> Callable:
     """Jitted: one training episode for each of S independent learners.
 
-    Signature: (pol_state_s, key) -> (pol_state_s, rewards [S]).
+    Signature: (pol_state_s, key) -> (pol_state_s, (rewards [S], losses [S])).
     """
     n_scenarios = arrays_s.time.shape[0]
 
@@ -182,7 +200,10 @@ def make_independent_episode_fn(
             _, pol_state, outputs = run_episode(
                 cfg, policy, pol_state, phys, arrays, ratings, k_ep, training=True
             )
-            return pol_state, jnp.sum(jnp.mean(outputs.reward, axis=-1))
+            return pol_state, (
+                jnp.sum(jnp.mean(outputs.reward, axis=-1)),
+                jnp.mean(outputs.loss),
+            )
 
         return jax.vmap(one, in_axes=(0, 0, 0))(pol_state_s, arrays_s, keys)
 
@@ -199,14 +220,15 @@ def train_scenarios_independent(
     n_episodes: int,
     episode_fn: Optional[Callable] = None,
     episode0: int = 0,
-) -> Tuple[object, np.ndarray, float]:
+    episode_cb: Optional[Callable] = None,
+) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """S independent learners, one device program per episode.
 
     ``pol_state_s`` must carry a leading scenario axis on every leaf (e.g.
     ``jax.vmap(lambda k: init_policy_state(cfg, k))(keys)``). Pass a prebuilt
     ``episode_fn`` (``make_independent_episode_fn``) to reuse its compiled
     program across calls. Returns (final states [S,...], rewards
-    [episodes, S], seconds).
+    [episodes, S], losses [episodes, S], seconds).
     """
     if episode_fn is None:
         episode_fn = make_independent_episode_fn(cfg, policy, arrays_s, ratings)
@@ -218,6 +240,7 @@ def train_scenarios_independent(
         policy,
         cfg.train.min_episodes_criterion,
         episode0,
+        episode_cb,
     )
 
 
@@ -276,7 +299,12 @@ def _tabular_update_shared(
     qt3 = qt.reshape(A, q.num_time_states, m)
     row = jax.lax.dynamic_index_in_dim(qt3, tbin, axis=1, keepdims=False)
     qt3 = jax.lax.dynamic_update_index_in_dim(qt3, row + delta, tbin, axis=1)
-    return state._replace(q_table=qt3.reshape(qt.shape)), jnp.zeros_like(tr.reward[0])
+    # Error metric = agent-mean squared TD error per scenario (the tabular
+    # analogue of the DQN TD loss, so training_progress.error is meaningful
+    # in shared mode — the reference's QAgent.train reports 0 forever).
+    return state._replace(q_table=qt3.reshape(qt.shape)), jnp.mean(
+        jnp.square(td), axis=1
+    )
 
 
 def _dqn_update_shared(
@@ -316,6 +344,118 @@ def _dqn_update_shared(
     return new_state, replay_s, loss
 
 
+class DDPGScenState(NamedTuple):
+    """Per-scenario exploration/replay state for shared DDPG: the learnable
+    ``DDPGParams`` are shared across scenarios, but each scenario keeps its
+    own replay ring and Ornstein-Uhlenbeck noise trajectory."""
+
+    replay: object           # ReplayState leaves stacked [S, A, ...]
+    ou: jnp.ndarray          # [S, A]
+
+
+def _ddpg_update_shared(
+    cfg: ExperimentConfig, params: DDPGParams, scen: DDPGScenState, tr, key
+) -> Tuple[DDPGParams, DDPGScenState, jnp.ndarray]:
+    """Shared DDPG params; per-scenario replay; the per-slot gradient is the
+    average over all scenarios' sampled batches (the psum-over-ICI path when
+    scenario-sharded) — the scenario-averaged actor-critic update of
+    BASELINE.md config 4 ("shared-critic MARL").
+
+    In per-agent mode each agent updates its own actor-critic on its
+    scenario-pooled batch [S*B]; with ``share_across_agents`` one actor-critic
+    updates on the fully pooled [S*A*B] batch.
+    """
+    d = cfg.ddpg
+    replay_s = jax.vmap(replay_add)(
+        scen.replay, tr.obs, tr.aux[..., None], tr.reward, tr.next_obs
+    )
+    S = tr.obs.shape[0]
+    keys = jax.random.split(key, S)
+    s, a, r, ns = jax.vmap(lambda rep, k: replay_sample(rep, k, d.batch_size))(
+        replay_s, keys
+    )  # [S, A, B, ...]
+
+    if d.share_across_agents:
+        flat = lambda x: x.reshape((-1,) + x.shape[3:])
+        pa, pc, pat, pct, oa, oc, loss = ddpg_learn_batch(
+            d,
+            params.actor,
+            params.critic,
+            params.actor_target,
+            params.critic_target,
+            params.actor_opt,
+            params.critic_opt,
+            flat(s),
+            flat(a),
+            flat(r),
+            flat(ns),
+        )
+    else:
+        # Pool scenarios into each agent's batch: [S, A, B, ...] -> [A, S*B, ...].
+        pool = lambda x: jnp.swapaxes(x, 0, 1).reshape(
+            (x.shape[1], -1) + x.shape[3:]
+        )
+        pa, pc, pat, pct, oa, oc, loss = jax.vmap(
+            lambda *args: ddpg_learn_batch(d, *args)
+        )(
+            params.actor,
+            params.critic,
+            params.actor_target,
+            params.critic_target,
+            params.actor_opt,
+            params.critic_opt,
+            pool(s),
+            pool(a),
+            pool(r),
+            pool(ns),
+        )
+        loss = jnp.mean(loss)
+
+    new_params = DDPGParams(
+        actor=pa,
+        critic=pc,
+        actor_target=pat,
+        critic_target=pct,
+        actor_opt=oa,
+        critic_opt=oc,
+    )
+    return new_params, scen._replace(replay=replay_s), loss
+
+
+def init_shared_state(
+    cfg: ExperimentConfig, key: jax.Array, n_scenarios: Optional[int] = None
+) -> Tuple[object, object]:
+    """(pol_state, scen_state) for ``train_scenarios_shared``:
+
+    * tabular -> (TabularState, None)
+    * dqn     -> (DQNState, scenario-stacked ReplayState)
+    * ddpg    -> (DDPGParams, DDPGScenState)
+    """
+    from p2pmicrogrid_tpu.train.policies import init_policy_state
+
+    S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
+    A = cfg.sim.n_agents
+    impl = cfg.train.implementation
+
+    def scen_replay(capacity):
+        return jax.vmap(lambda _: replay_init(A, capacity, OBS_DIM, 1))(
+            jnp.arange(S)
+        )
+
+    if impl == "tabular":
+        return init_policy_state(cfg, key), None
+    if impl == "dqn":
+        return init_policy_state(cfg, key), scen_replay(cfg.dqn.buffer_size)
+    if impl == "ddpg":
+        k_params, k_ou = jax.random.split(key)
+        scen = DDPGScenState(
+            replay=scen_replay(cfg.ddpg.buffer_size),
+            ou=cfg.ddpg.ou_init_sd * jax.random.normal(k_ou, (S, A)),
+        )
+        return ddpg_params_init(cfg.ddpg, A, k_params), scen
+    raise ValueError(f"unknown implementation {impl!r}")
+
+
 def make_shared_episode_fn(
     cfg: ExperimentConfig,
     policy: Policy,
@@ -325,36 +465,59 @@ def make_shared_episode_fn(
 ) -> Callable:
     """Jitted: one shared-parameter training episode over S scenarios.
 
-    Signature: ((pol_state, replay_s), key) -> ((pol_state, replay_s),
-    rewards [S]). ``replay_s`` is None for tabular. ``settlement_hook`` is
+    Signature: ((pol_state, scen_state), key) -> ((pol_state, scen_state),
+    (rewards [S], losses [S])). ``scen_state`` is None for tabular, a
+    scenario-stacked ReplayState for dqn, a ``DDPGScenState`` for ddpg
+    (build all three with ``init_shared_state``). ``settlement_hook`` is
     forwarded to ``slot_dynamics_batched`` (inter-community trading).
     """
     impl = cfg.train.implementation
-    if impl not in ("tabular", "dqn"):
-        raise ValueError(f"shared-scenario training supports tabular/dqn, got {impl!r}")
+    if impl not in ("tabular", "dqn", "ddpg"):
+        raise ValueError(
+            f"shared-scenario training supports tabular/dqn/ddpg, got {impl!r}"
+        )
     n_scenarios = arrays_s.time.shape[0]
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
 
+    if impl == "ddpg":
+        # OU noise is per-scenario state threaded through every negotiation
+        # round (each act call advances it, matching the independent path).
+        def ddpg_act_fn(params, obs_s, prev_frac_s, round_key, ou_s):
+            frac, q, ou_s = ddpg_shared_act(cfg.ddpg, params, obs_s, ou_s, round_key)
+            return frac, frac, q, ou_s
+
     def slot(carry, xs_t):
-        phys_s, pol_state, replay_s, key = carry
+        phys_s, pol_state, scen_state, key = carry
         key, k_act, k_learn = jax.random.split(key, 3)
 
-        phys_s, _, outputs_s, tr_s = slot_dynamics_batched(
+        act_fn = ddpg_act_fn if impl == "ddpg" else None
+        ex = scen_state.ou if impl == "ddpg" else None
+        phys_s, _, outputs_s, tr_s, ex = slot_dynamics_batched(
             cfg, policy, pol_state, phys_s, xs_t, k_act, ratings_j, explore=True,
-            settlement_hook=settlement_hook,
+            settlement_hook=settlement_hook, act_fn=act_fn, explore_state=ex,
         )
 
         if impl == "tabular":
-            pol_state, _ = _tabular_update_shared(cfg, pol_state, tr_s, k_learn)
-        else:
-            pol_state, replay_s, _ = _dqn_update_shared(
-                cfg, pol_state, replay_s, tr_s, k_learn
+            pol_state, loss = _tabular_update_shared(cfg, pol_state, tr_s, k_learn)
+        elif impl == "dqn":
+            pol_state, scen_state, loss = _dqn_update_shared(
+                cfg, pol_state, scen_state, tr_s, k_learn
             )
-        return (phys_s, pol_state, replay_s, key), jnp.mean(outputs_s.reward, axis=-1)
+            loss = jnp.full((n_scenarios,), jnp.mean(loss))
+        else:
+            scen_state = scen_state._replace(ou=ex)
+            pol_state, scen_state, loss = _ddpg_update_shared(
+                cfg, pol_state, scen_state, tr_s, k_learn
+            )
+            loss = jnp.full((n_scenarios,), jnp.mean(loss))
+        return (phys_s, pol_state, scen_state, key), (
+            jnp.mean(outputs_s.reward, axis=-1),
+            loss,
+        )
 
     @jax.jit
     def episode(carry, key):
-        pol_state, replay_s = carry
+        pol_state, scen_state = carry
         k_phys, k_scan = jax.random.split(key)
         phys_s = jax.vmap(lambda k: init_physical(cfg, k))(
             jax.random.split(k_phys, n_scenarios)
@@ -369,10 +532,13 @@ def make_shared_episode_fn(
             xs.next_load_w,
             xs.next_pv_w,
         )
-        (phys_s, pol_state, replay_s, _), rewards = jax.lax.scan(
-            slot, (phys_s, pol_state, replay_s, k_scan), xs
+        (phys_s, pol_state, scen_state, _), (rewards, losses) = jax.lax.scan(
+            slot, (phys_s, pol_state, scen_state, k_scan), xs
         )
-        return (pol_state, replay_s), jnp.sum(rewards, axis=0)
+        return (pol_state, scen_state), (
+            jnp.sum(rewards, axis=0),
+            jnp.mean(losses, axis=0),
+        )
 
     return episode
 
@@ -388,20 +554,22 @@ def train_scenarios_shared(
     replay_s=None,
     episode_fn: Optional[Callable] = None,
     episode0: int = 0,
-) -> Tuple[object, object, np.ndarray, float]:
+    episode_cb: Optional[Callable] = None,
+) -> Tuple[object, object, np.ndarray, np.ndarray, float]:
     """One shared learner over S scenarios: per slot, vmapped dynamics produce
     per-scenario transitions and a single averaged update is applied.
 
-    Supports ``implementation`` 'tabular' and 'dqn'. For dqn, ``replay_s``
-    must be a scenario-stacked ReplayState (``jax.vmap(replay_init)``-style).
-    Pass a prebuilt ``episode_fn`` (``make_shared_episode_fn``) to reuse its
-    compiled program across calls.
+    Supports ``implementation`` 'tabular', 'dqn' and 'ddpg'. ``replay_s`` is
+    the per-scenario state (None / stacked ReplayState / DDPGScenState —
+    build with ``init_shared_state``). Pass a prebuilt ``episode_fn``
+    (``make_shared_episode_fn``) to reuse its compiled program across calls.
 
-    Returns (pol_state, replay_s, rewards [episodes, S], seconds).
+    Returns (pol_state, scen_state, rewards [episodes, S],
+    losses [episodes, S], seconds).
     """
     if episode_fn is None:
         episode_fn = make_shared_episode_fn(cfg, policy, arrays_s, ratings)
-    carry, rewards, seconds = _run_episode_loop(
+    carry, rewards, losses, seconds = _run_episode_loop(
         episode_fn,
         (pol_state, replay_s),
         key,
@@ -409,6 +577,7 @@ def train_scenarios_shared(
         policy,
         cfg.train.min_episodes_criterion,
         episode0,
+        episode_cb,
     )
-    pol_state, replay_s = carry
-    return pol_state, replay_s, rewards, seconds
+    pol_state, scen_state = carry
+    return pol_state, scen_state, rewards, losses, seconds

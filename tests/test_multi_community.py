@@ -105,7 +105,7 @@ class TestTraining:
         self.ps = init_policy_state(self.cfg, jax.random.PRNGKey(1))
 
     def test_episode_runs_and_learns(self):
-        ps2, _, rewards, _ = train_multi_community(
+        ps2, _, rewards, _, _ = train_multi_community(
             self.cfg, self.policy, self.ps, self.arrays, self.ratings,
             jax.random.PRNGKey(0), n_episodes=1,
         )
@@ -117,11 +117,11 @@ class TestTraining:
         """With inter-community trading the blended grid price is never worse
         than the tariff, so total reward must be >= the isolated-communities
         run (same seeds, same policy draws)."""
-        _, _, r_inter, _ = train_multi_community(
+        _, _, r_inter, _, _ = train_multi_community(
             self.cfg, self.policy, self.ps, self.arrays, self.ratings,
             jax.random.PRNGKey(0), n_episodes=1,
         )
-        _, _, r_iso, _ = train_scenarios_shared(
+        _, _, r_iso, _, _ = train_scenarios_shared(
             self.cfg, self.policy, self.ps, self.arrays, self.ratings,
             jax.random.PRNGKey(0), n_episodes=1,
         )

@@ -87,7 +87,7 @@ def test_shared_episode_pallas_parity():
         ps = ps._replace(
             q_table=jax.random.normal(jax.random.PRNGKey(5), ps.q_table.shape)
         )
-        ps2, _, rewards, _ = train_scenarios_shared(
+        ps2, _, rewards, _, _ = train_scenarios_shared(
             cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(0), n_episodes=1
         )
         results[use_pallas] = (np.asarray(rewards), np.asarray(ps2.q_table))
