@@ -6,9 +6,13 @@ data_analysis.py:1633-1645). This module is the typed-config + real-CLI
 replacement mandated by SURVEY.md section 5 ("Config / flag system").
 
 Subcommands:
-  train     train a community (tabular/dqn/ddpg), checkpoint, log progress
+  train     train a community (tabular/dqn/ddpg), checkpoint, log progress;
+            --scenarios N batches Monte-Carlo scenarios (--shared for one
+            scenario-averaged learner), --resume continues from a checkpoint
+  multi     multi-community training with inter-community trading
   eval      load a checkpoint, run greedy per-day evaluation, persist results
   baseline  run the rule-based thermostat baseline over the test days
+  sweep     DDPG hyperparameter sweep
   bench     run the benchmark and print its JSON line
   analyse   render figures + run the statistics battery from a results DB
 """
@@ -81,7 +85,24 @@ def _load_traces(args):
     return train_validation_test_split(traces)
 
 
+def _profile_ctx(args):
+    """jax.profiler trace of the run (SURVEY.md section 5: the reference only
+    has wall-clock brackets, community.py:269-316)."""
+    import contextlib
+
+    if getattr(args, "profile_dir", None):
+        import jax
+
+        return jax.profiler.trace(args.profile_dir)
+    return contextlib.nullcontext()
+
+
 def cmd_train(args) -> int:
+    if getattr(args, "scenarios", 1) > 1:
+        return _cmd_train_scenarios(args)
+
+    import dataclasses
+
     import jax
 
     from p2pmicrogrid_tpu.data import ResultsStore
@@ -91,7 +112,11 @@ def cmd_train(args) -> int:
         make_policy,
         train_community,
     )
-    from p2pmicrogrid_tpu.train.checkpoint import checkpoint_dir, save_checkpoint
+    from p2pmicrogrid_tpu.train.checkpoint import (
+        checkpoint_dir,
+        restore_checkpoint,
+        save_checkpoint,
+    )
 
     cfg = _build_cfg(args)
     train_traces, _, _ = _load_traces(args)
@@ -104,6 +129,22 @@ def cmd_train(args) -> int:
     store = ResultsStore(args.results_db) if args.results_db else None
     ckpt_dir = checkpoint_dir(args.model_dir, cfg.setting, cfg.train.implementation)
 
+    if args.resume:
+        # Resume semantics of the reference's load_agents=True +
+        # starting_episodes (community.py:254-256, setup.py:29): restore the
+        # learner and continue the episode/decay schedule where it stopped.
+        pol_state, episode = restore_checkpoint(ckpt_dir, pol_state)
+        cfg = cfg.replace(
+            train=dataclasses.replace(cfg.train, starting_episodes=episode + 1)
+        )
+        print(f"resumed {ckpt_dir} at episode {episode}")
+        if cfg.train.starting_episodes >= cfg.train.max_episodes:
+            print("nothing to do: checkpoint is at or past --episodes")
+            return 0
+        # Advance the key chain past the already-trained episodes so the
+        # resumed run does not replay the original run's random stream.
+        key = jax.random.fold_in(key, cfg.train.starting_episodes)
+
     def progress(ep, r, e):
         if store:
             store.log_training_progress(cfg.setting, cfg.train.implementation, ep, r, e)
@@ -112,17 +153,7 @@ def cmd_train(args) -> int:
         save_checkpoint(ckpt_dir, ps, ep)
 
     print(f"setting: {cfg.setting} ({cfg.train.implementation})")
-    if args.profile_dir:
-        # jax.profiler trace of the training run (SURVEY.md section 5: the
-        # reference only has wall-clock brackets, community.py:269-316).
-        import contextlib
-
-        profile_ctx = jax.profiler.trace(args.profile_dir)
-    else:
-        import contextlib
-
-        profile_ctx = contextlib.nullcontext()
-    with profile_ctx:
+    with _profile_ctx(args):
         result = train_community(
             cfg, policy, pol_state, train_traces, ratings, key,
             progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
@@ -130,11 +161,251 @@ def cmd_train(args) -> int:
     save_checkpoint(ckpt_dir, result.pol_state, cfg.train.max_episodes - 1)
     if args.timing_json:
         _save_times(args.timing_json, cfg.setting, train_time=result.train_seconds)
+    n_run = cfg.train.max_episodes - cfg.train.starting_episodes
     print(
-        f"trained {cfg.train.max_episodes} episodes in {result.train_seconds:.1f}s "
+        f"trained {n_run} episodes in {result.train_seconds:.1f}s "
         f"({result.env_steps_per_sec:.0f} env-steps/s); checkpoint: {ckpt_dir}"
     )
     return 0
+
+
+def _scenario_setting(cfg, shared: bool) -> str:
+    """Experiment identity for scenario-batched runs: the community setting
+    plus the Monte-Carlo axis, e.g. ``2-multi-agent-com-rounds-1-hetero-x256-shared``."""
+    mode = "shared" if shared else "indep"
+    return f"{cfg.setting}-x{cfg.sim.n_scenarios}-{mode}"
+
+
+def _windowed_episode_cb(cfg, setting, store, ckpt_dir, carry_is_tuple):
+    """Per-episode callback shared by the scenario and multi-community
+    trainers: min_episodes_criterion-window averages into training_progress
+    (same semantics as train_community's records, so analyse treats all
+    settings alike) plus periodic checkpointing on the save_episodes cadence."""
+    import collections
+    import statistics
+
+    from p2pmicrogrid_tpu.train.checkpoint import save_checkpoint
+
+    window_r = collections.deque(maxlen=cfg.train.min_episodes_criterion)
+    window_l = collections.deque(maxlen=cfg.train.min_episodes_criterion)
+
+    def episode_cb(ep, r, l, carry):
+        window_r.append(float(np.mean(r)))
+        window_l.append(float(np.mean(l)))
+        if ep % cfg.train.min_episodes_criterion == 0:
+            avg_r, avg_l = statistics.mean(window_r), statistics.mean(window_l)
+            if store:
+                store.log_training_progress(
+                    setting, cfg.train.implementation, ep, avg_r, avg_l
+                )
+            print(f"episode {ep}: avg reward {avg_r:.3f}, avg error {avg_l:.3f}")
+        if (ep + 1) % cfg.train.save_episodes == 0:
+            ps = carry[0] if carry_is_tuple else carry
+            save_checkpoint(ckpt_dir, ps, ep)
+
+    return episode_cb
+
+
+def _cmd_train_scenarios(args) -> int:
+    """--scenarios N > 1: Monte-Carlo scenario-batched training — the
+    TPU-native scaling axis (BASELINE configs 3/4). ``--shared`` trains ONE
+    set of policy parameters with per-slot scenario-averaged updates;
+    otherwise S independent learners train in one device program."""
+    import jax
+
+    from p2pmicrogrid_tpu.data import ResultsStore
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel import (
+        init_shared_state,
+        make_scenario_traces,
+        stack_scenario_arrays,
+        train_scenarios_independent,
+        train_scenarios_shared,
+    )
+    from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+    from p2pmicrogrid_tpu.train.checkpoint import (
+        checkpoint_dir,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = _build_cfg(args)
+    S = cfg.sim.n_scenarios
+    setting = _scenario_setting(cfg, args.shared)
+    rng = np.random.default_rng(cfg.train.seed)
+    ratings = make_ratings(cfg, rng)
+    key = jax.random.PRNGKey(cfg.train.seed)
+    policy = make_policy(cfg)
+
+    traces = make_scenario_traces(cfg, seed=cfg.train.seed)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+
+    if args.shared:
+        pol_state, scen_state = init_shared_state(cfg, key)
+    else:
+        pol_state = jax.vmap(lambda k: init_policy_state(cfg, k))(
+            jax.random.split(key, S)
+        )
+        scen_state = None
+
+    store = ResultsStore(args.results_db) if args.results_db else None
+    ckpt_dir = checkpoint_dir(args.model_dir, setting, cfg.train.implementation)
+    episode0 = 0
+    if args.resume:
+        # Learnable state only: per-scenario replay/OU is transient warm-up
+        # state and is rebuilt fresh (the reference's DQN does the same via
+        # init_buffers after load, community.py:265-267).
+        pol_state, episode = restore_checkpoint(ckpt_dir, pol_state)
+        episode0 = episode + 1
+        print(f"resumed {ckpt_dir} at episode {episode}")
+        if episode0 >= cfg.train.max_episodes:
+            print("nothing to do: checkpoint is at or past --episodes")
+            return 0
+        # Advance the key chain past the trained episodes so the resumed run
+        # does not replay the original run's random stream.
+        key = jax.random.fold_in(key, episode0)
+
+    episode_cb = _windowed_episode_cb(
+        cfg, setting, store, ckpt_dir, carry_is_tuple=args.shared
+    )
+    n_episodes = cfg.train.max_episodes - episode0
+    print(f"setting: {setting} ({cfg.train.implementation}, S={S})")
+    with _profile_ctx(args):
+        if args.shared:
+            pol_state, _, rewards, _, seconds = train_scenarios_shared(
+                cfg, policy, pol_state, arrays, ratings, key, n_episodes,
+                replay_s=scen_state, episode0=episode0, episode_cb=episode_cb,
+            )
+        else:
+            pol_state, rewards, _, seconds = train_scenarios_independent(
+                cfg, policy, pol_state, arrays, ratings, key, n_episodes,
+                episode0=episode0, episode_cb=episode_cb,
+            )
+    save_checkpoint(ckpt_dir, pol_state, cfg.train.max_episodes - 1)
+    if args.timing_json:
+        _save_times(args.timing_json, setting, train_time=seconds)
+    steps = n_episodes * int(arrays.time.shape[1]) * S
+    print(
+        f"trained {n_episodes} episodes x {S} scenarios in {seconds:.1f}s "
+        f"({steps / seconds:.0f} env-steps/s); checkpoint: {ckpt_dir}"
+    )
+    return 0
+
+
+def cmd_multi(args) -> int:
+    """Multi-community training with inter-community trading (BASELINE
+    config 5): C communities ride the leading batch axis, residuals trade at
+    the P2P midpoint price (envs/multi_community.py)."""
+    import dataclasses
+
+    import jax
+
+    from p2pmicrogrid_tpu.data import ResultsStore
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.envs.multi_community import train_multi_community
+    from p2pmicrogrid_tpu.parallel import (
+        init_shared_state,
+        make_scenario_traces,
+        stack_scenario_arrays,
+    )
+    from p2pmicrogrid_tpu.train import make_policy
+    from p2pmicrogrid_tpu.train.checkpoint import (
+        checkpoint_dir,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = _build_cfg(args)
+    C = args.communities
+    cfg = cfg.replace(sim=dataclasses.replace(cfg.sim, n_scenarios=C))
+    setting = f"multi-{C}x{cfg.sim.n_agents}-rounds-{cfg.sim.rounds}"
+    rng = np.random.default_rng(cfg.train.seed)
+    ratings = make_ratings(cfg, rng)
+    key = jax.random.PRNGKey(cfg.train.seed)
+    policy = make_policy(cfg)
+
+    traces = make_scenario_traces(cfg, n_scenarios=C, seed=cfg.train.seed)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    pol_state, scen_state = init_shared_state(cfg, key, C)
+
+    store = ResultsStore(args.results_db) if args.results_db else None
+    ckpt_dir = checkpoint_dir(args.model_dir, setting, cfg.train.implementation)
+    episode0 = 0
+    if args.resume:
+        pol_state, episode = restore_checkpoint(ckpt_dir, pol_state)
+        episode0 = episode + 1
+        print(f"resumed {ckpt_dir} at episode {episode}")
+        if episode0 >= cfg.train.max_episodes:
+            print("nothing to do: checkpoint is at or past --episodes")
+            return 0
+        key = jax.random.fold_in(key, episode0)
+
+    episode_cb = _windowed_episode_cb(
+        cfg, setting, store, ckpt_dir, carry_is_tuple=True
+    )
+    n_episodes = cfg.train.max_episodes - episode0
+    print(f"setting: {setting} ({cfg.train.implementation})")
+    pol_state, _, rewards, _, seconds = train_multi_community(
+        cfg, policy, pol_state, arrays, ratings, key,
+        n_episodes=n_episodes, replay_s=scen_state,
+        episode0=episode0, episode_cb=episode_cb,
+    )
+    save_checkpoint(ckpt_dir, pol_state, cfg.train.max_episodes - 1)
+    if args.timing_json:
+        _save_times(args.timing_json, setting, train_time=seconds)
+    per_c = np.asarray(rewards)[-1]
+    print(f"final per-community episode rewards: {np.round(per_c, 1).tolist()}")
+    steps = n_episodes * int(arrays.time.shape[1]) * C
+    print(
+        f"trained {n_episodes} episodes x {C} communities in "
+        f"{seconds:.1f}s ({steps / seconds:.0f} env-steps/s); checkpoint: {ckpt_dir}"
+    )
+    return 0
+
+
+def _restore_eval_state(args, cfg, key):
+    """Locate and restore the checkpoint the requested training mode produced.
+
+    Plain runs restore the single-community learner state. ``--scenarios N``
+    runs live under the scenario setting suffix: shared-mode checkpoints hold
+    one learner (tabular/dqn states match the plain template; DDPG stores a
+    bare ``DDPGParams`` bundle that is grafted onto a fresh ``DDPGState``);
+    independent-mode checkpoints hold S stacked learners, of which
+    ``--scenario-index`` selects one for evaluation.
+    """
+    import jax
+
+    from p2pmicrogrid_tpu.train import init_policy_state
+    from p2pmicrogrid_tpu.train.checkpoint import checkpoint_dir, restore_checkpoint
+
+    impl = cfg.train.implementation
+    template = init_policy_state(cfg, key)
+    S = getattr(args, "scenarios", 1)
+    if S <= 1:
+        ckpt_dir = checkpoint_dir(args.model_dir, cfg.setting, impl)
+        pol_state, episode = restore_checkpoint(ckpt_dir, template)
+        return pol_state, episode, ckpt_dir
+
+    setting = _scenario_setting(cfg, args.shared)
+    ckpt_dir = checkpoint_dir(args.model_dir, setting, impl)
+    if args.shared:
+        if impl == "ddpg":
+            from p2pmicrogrid_tpu.models.ddpg import ddpg_params_init
+
+            params, episode = restore_checkpoint(
+                ckpt_dir, ddpg_params_init(cfg.ddpg, cfg.sim.n_agents, key)
+            )
+            return template._replace(**params._asdict()), episode, ckpt_dir
+        pol_state, episode = restore_checkpoint(ckpt_dir, template)
+        return pol_state, episode, ckpt_dir
+
+    stacked = jax.vmap(lambda k: init_policy_state(cfg, k))(
+        jax.random.split(key, S)
+    )
+    stacked, episode = restore_checkpoint(ckpt_dir, stacked)
+    idx = args.scenario_index
+    pol_state = jax.tree_util.tree_map(lambda x: x[idx], stacked)
+    return pol_state, episode, ckpt_dir
 
 
 def cmd_eval(args) -> int:
@@ -143,12 +414,7 @@ def cmd_eval(args) -> int:
     from p2pmicrogrid_tpu.analysis import analyse_community_output
     from p2pmicrogrid_tpu.data import ResultsStore, save_eval_outputs
     from p2pmicrogrid_tpu.envs import make_ratings
-    from p2pmicrogrid_tpu.train import (
-        evaluate_community,
-        init_policy_state,
-        make_policy,
-    )
-    from p2pmicrogrid_tpu.train.checkpoint import checkpoint_dir, restore_checkpoint
+    from p2pmicrogrid_tpu.train import evaluate_community, make_policy
 
     cfg = _build_cfg(args)
     _, val_traces, test_traces = _load_traces(args)
@@ -158,9 +424,7 @@ def cmd_eval(args) -> int:
     key = jax.random.PRNGKey(cfg.train.seed)
     policy = make_policy(cfg)
 
-    template = init_policy_state(cfg, key)
-    ckpt_dir = checkpoint_dir(args.model_dir, cfg.setting, cfg.train.implementation)
-    pol_state, episode = restore_checkpoint(ckpt_dir, template)
+    pol_state, episode, ckpt_dir = _restore_eval_state(args, cfg, key)
     print(f"restored {ckpt_dir} at episode {episode}")
 
     import time as _time
@@ -268,9 +532,13 @@ def _maybe_pv_drop(args, arrays):
 def _persist_setting(args, cfg) -> str:
     """Setting string used as the results-store identity. PV-drop runs get
     their own name (the reference's '2-agent-1-pv-drop-{com,no-com}' keys,
-    data_analysis.py:1104) so they never clobber the clean run's rows."""
+    data_analysis.py:1104) so they never clobber the clean run's rows;
+    evaluations of scenario-trained policies keep the scenario suffix so they
+    never clobber plain-trained results."""
     spec = getattr(args, "pv_drop", None)
     if not spec:
+        if getattr(args, "scenarios", 1) > 1:
+            return _scenario_setting(cfg, getattr(args, "shared", False))
         return cfg.setting
     agent = spec.split(":")[0]
     com = "com" if cfg.sim.trading else "no-com"
@@ -406,14 +674,37 @@ def main(argv=None) -> int:
     p = sub.add_parser("train", help="train a community")
     _add_common(p)
     p.add_argument("--jit-block", type=int, default=1, dest="jit_block")
-    p.add_argument("--scenarios", type=int, default=1)
+    p.add_argument("--scenarios", type=int, default=1,
+                   help="N>1: Monte-Carlo scenario-batched training")
+    p.add_argument("--shared", action="store_true",
+                   help="with --scenarios: one shared learner with per-slot "
+                        "scenario-averaged updates (default: S independent)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore the latest checkpoint for this setting and "
+                        "continue the episode/decay schedule from there")
     p.add_argument("--profile-dir", dest="profile_dir",
                    help="write a jax.profiler trace of the training run here")
     p.set_defaults(fn=cmd_train)
 
+    p = sub.add_parser("multi", help="multi-community training with "
+                                     "inter-community trading")
+    _add_common(p)
+    p.add_argument("--communities", type=int, default=8)
+    p.add_argument("--resume", action="store_true",
+                   help="restore the latest checkpoint for this setting and "
+                        "continue from there")
+    p.set_defaults(fn=cmd_multi)
+
     p = sub.add_parser("eval", help="evaluate a trained community per day")
     _add_common(p)
     p.add_argument("--test", action="store_true", help="test days (default: validation)")
+    p.add_argument("--scenarios", type=int, default=1,
+                   help="locate the checkpoint of a --scenarios N training run")
+    p.add_argument("--shared", action="store_true",
+                   help="the checkpoint came from --shared training")
+    p.add_argument("--scenario-index", type=int, default=0, dest="scenario_index",
+                   help="which learner to evaluate from an independent-mode "
+                        "(non --shared) scenario checkpoint")
     p.add_argument("--figures-dir")
     p.add_argument("--pv-drop", dest="pv_drop", metavar="AGENT[:START[:FACTOR]]",
                    help="fault-inject one agent's PV production")

@@ -115,6 +115,8 @@ def train_multi_community(
     key: jax.Array,
     n_episodes: int,
     replay_s=None,
+    episode0: int = 0,
+    episode_cb: Optional[Callable] = None,
 ) -> Tuple[object, object, np.ndarray, np.ndarray, float]:
     """Train C communities with inter-community trading (shared parameters).
 
@@ -134,4 +136,6 @@ def train_multi_community(
         n_episodes,
         replay_s=replay_s,
         episode_fn=episode_fn,
+        episode0=episode0,
+        episode_cb=episode_cb,
     )

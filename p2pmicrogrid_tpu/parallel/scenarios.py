@@ -148,9 +148,10 @@ def _run_episode_loop(
     """Shared host loop: run episodes, decay on the reference cadence.
 
     ``episode_fn(carry, key) -> (carry, (rewards [S], losses [S]))``.
-    ``episode_cb(episode_index, reward [S], loss [S])`` is invoked per episode
-    (progress records, checkpointing). Returns (carry, rewards
-    [episodes, S], losses [episodes, S], seconds).
+    ``episode_cb(episode_index, reward [S], loss [S], carry)`` is invoked per
+    episode (progress records, checkpointing — the carry is the live learner
+    state). Returns (carry, rewards [episodes, S], losses [episodes, S],
+    seconds).
     """
     rewards, losses = [], []
     start = _time.time()
@@ -163,7 +164,7 @@ def _run_episode_loop(
         rewards.append(r)
         losses.append(l)
         if episode_cb:
-            episode_cb(episode0 + e, r, l)
+            episode_cb(episode0 + e, r, l, carry)
     jax.block_until_ready(carry)
     return carry, np.stack(rewards), np.stack(losses), _time.time() - start
 

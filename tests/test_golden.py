@@ -84,3 +84,51 @@ def test_scripted_episode_matches_golden():
     np.testing.assert_allclose(
         np.asarray(out.reward)[:4], -np.asarray(GOLDEN["cost"]), rtol=2e-4, atol=1e-5
     )
+
+
+# Pinned on CPU at the round-2 state of the learning dynamics: epsilon-greedy
+# action draws, per-slot Bellman updates inside the scan, reward assembly.
+GOLDEN_TRAIN = {
+    "reward_first4": [
+        -0.044529, -0.087167, -0.082664, -0.041386,
+        -10.071978, -0.076942, -0.002471, -11.820530,
+    ],
+    "q_delta_abs_sum": 0.0242695,
+    "q_cells_changed": 175,
+}
+
+
+def test_training_episode_matches_golden():
+    """Training-path golden (round-1 VERDICT weak #8): one tabular training
+    episode with fixed keys must reproduce the pinned per-slot rewards and
+    Q-table update statistics — any change to the epsilon-greedy draw order,
+    TD target, learning rate application, or scatter semantics fails here."""
+    cfg = default_config(
+        sim=SimConfig(n_agents=2, rounds=1),
+        train=TrainConfig(implementation="tabular"),
+    )
+    traces = synthetic_traces(n_days=1, start_day=11).normalized()
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    arrays = build_episode_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    ps = tabular_init(cfg.qlearning, 2)
+    ps = ps._replace(
+        q_table=jax.random.normal(jax.random.PRNGKey(5), ps.q_table.shape)
+    )
+    phys = init_physical(cfg, jax.random.PRNGKey(0))
+
+    _, ps2, out = run_episode(
+        cfg, policy, ps, phys, arrays, ratings, jax.random.PRNGKey(7), training=True
+    )
+
+    delta = np.asarray(ps2.q_table - ps.q_table)
+    np.testing.assert_allclose(
+        np.asarray(out.reward)[:4].reshape(-1),
+        GOLDEN_TRAIN["reward_first4"],
+        rtol=2e-4,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.abs(delta).sum(), GOLDEN_TRAIN["q_delta_abs_sum"], rtol=1e-3
+    )
+    assert int((delta != 0).sum()) == GOLDEN_TRAIN["q_cells_changed"]
