@@ -79,6 +79,104 @@ def replay_add(
     )
 
 
+class LockstepReplay(NamedTuple):
+    """Time-major ring buffers for the scenario-batched shared trainer.
+
+    All scenarios/agents write in lockstep (one transition per slot), so the
+    ring index is a single scalar and the ring axis leads:
+
+    obs:      [cap, S, A, obs_dim]
+    action:   [cap, S, A, act_dim]
+    reward:   [cap, S, A]
+    next_obs: [cap, S, A, obs_dim]
+    cursor/count: [] int32
+
+    Why this layout: with per-(scenario, agent) rings ([S, A, cap, ...]) the
+    per-slot add is a batched scatter and the sample a batched gather over
+    64k tiny rings — profiled at A=1000, those lowered to ~115 ms/slot
+    (>80% of the episode). Time-major, the add is ONE contiguous
+    dynamic-update-slice and a sample of B shared indices gathers B
+    contiguous [S, A, ...] slabs at full HBM bandwidth.
+
+    Deviation from the reference's per-agent ``random.sample`` (rl.py:234-237),
+    by design: one index set per learn step is shared by every (scenario,
+    agent) pair. Indices are content-independent, so the TD estimator is
+    unbiased either way; each (s, a) still trains on ITS OWN transitions at
+    those time slots.
+    """
+
+    obs: jnp.ndarray
+    action: jnp.ndarray
+    reward: jnp.ndarray
+    next_obs: jnp.ndarray
+    cursor: jnp.ndarray
+    count: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return self.obs.shape[0]
+
+
+def lockstep_replay_init(
+    n_scenarios: int,
+    n_agents: int,
+    capacity: int,
+    obs_dim: int = 4,
+    act_dim: int = 1,
+) -> LockstepReplay:
+    return LockstepReplay(
+        obs=jnp.zeros((capacity, n_scenarios, n_agents, obs_dim), jnp.float32),
+        action=jnp.zeros((capacity, n_scenarios, n_agents, act_dim), jnp.float32),
+        reward=jnp.zeros((capacity, n_scenarios, n_agents), jnp.float32),
+        next_obs=jnp.zeros((capacity, n_scenarios, n_agents, obs_dim), jnp.float32),
+        cursor=jnp.zeros((), dtype=jnp.int32),
+        count=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def lockstep_replay_add(
+    state: LockstepReplay,
+    obs: jnp.ndarray,
+    action: jnp.ndarray,
+    reward: jnp.ndarray,
+    next_obs: jnp.ndarray,
+) -> LockstepReplay:
+    """One contiguous slab write at the shared cursor.
+
+    obs/next_obs: [S, A, obs_dim]; action: [S, A, act_dim]; reward: [S, A].
+    """
+    c = state.cursor
+    cap = state.capacity
+    return state._replace(
+        obs=state.obs.at[c].set(obs),
+        action=state.action.at[c].set(action),
+        reward=state.reward.at[c].set(reward),
+        next_obs=state.next_obs.at[c].set(next_obs),
+        cursor=(c + 1) % cap,
+        count=jnp.minimum(state.count + 1, cap),
+    )
+
+
+def lockstep_replay_sample(
+    state: LockstepReplay, key: jax.Array, batch_size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """B shared uniform indices over the filled region; each index gathers a
+    contiguous [S, A, ...] slab.
+
+    Returns (obs [B,S,A,obs_dim], action [B,S,A,act_dim], reward [B,S,A],
+    next_obs [B,S,A,obs_dim]).
+    """
+    hi = jnp.maximum(state.count, 1)
+    idx = jax.random.randint(key, (batch_size,), 0, hi)
+    take = lambda buf: jnp.take(buf, idx, axis=0)
+    return (
+        take(state.obs),
+        take(state.action),
+        take(state.reward),
+        take(state.next_obs),
+    )
+
+
 def replay_sample(
     state: ReplayState, key: jax.Array, batch_size: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:

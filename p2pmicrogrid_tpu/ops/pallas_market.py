@@ -4,9 +4,11 @@ The per-slot negotiation at scenario scale streams [S, A, A] proposal
 matrices through several separate elementwise/transpose/reduce passes
 (ops/market.py): diag-zeroing, ``powers = -p2p^T``, the mean-p2p observation,
 ``divide_power``'s sign-filtered proportional split, and ``clear_market``'s
-pairwise matching. Each pass is HBM-bound; XLA cannot fuse across the
-transposes. These kernels fuse each stage into a single VMEM pass over a
-block of scenarios, with the diagonal mask folded in:
+pairwise matching. Each pass is HBM-bound and XLA's fusions around the
+transposes degrade badly at large A (profiled at A=1000: ~26-31 ms/slot per
+fusion vs a ~2 ms/slot bandwidth bound). These kernels fuse each stage into a
+single VMEM pass over a block of scenarios, with the transpose done in VMEM
+and the diagonal mask folded in:
 
 * ``prep_mean(p2p)``       — [S,A,A] -> [S,A]: mean over counterparties of
   ``-p2p[:, i]`` with the diagonal zeroed (agent.py:203, community.py:76).
@@ -14,6 +16,12 @@ block of scenarios, with the diagonal mask folded in:
   split (agent.py:186-195) against diag-zeroed powers.
 * ``clear_market_fused``   — [S,A,A] -> ([S,A], [S,A]): sign-opposition
   matching + grid/p2p totals (community.py:45-54).
+
+Blocking: the [A, A] matrix is always a full-dimension block (legal at any A
+under Mosaic's (8, 128) rule), and the scenario axis is tiled so the handful
+of [SB, A, A] VMEM temporaries stay within budget — SB=8 for A<=128, SB=1 at
+A=1000. Per-agent [S, A] operands ride as [S, 1, A] so their blocks stay
+legal for any SB (the middle dim is full-size 1).
 
 On non-TPU backends the kernels run in interpreter mode (slow but exact), so
 the same code path is testable on the CPU mesh; ``ops/market.py`` remains the
@@ -29,13 +37,26 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Scenarios per kernel block: [SB, A, A] f32 must fit VMEM (~16 MB) with
-# headroom; A<=128 pads to 128 lanes -> SB*128*128*4B = 0.5 MB at SB=8.
-_BLOCK_S = 8
+# The kernels hold roughly this many [SB, A, A] f32 temporaries in VMEM at
+# once; SB is chosen so their total stays within the raised scoped-VMEM limit
+# (v5e has 128 MB of VMEM; the default scoped limit of 16 MB is far smaller
+# than what one A=1000 scenario needs).
+_SLABS = 8
+_VMEM_BUDGET = 96 * 1024 * 1024
+_VMEM_LIMIT = 110 * 1024 * 1024
+_MAX_BLOCK_S = 8
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _block(s: int, a: int) -> int:
+    slab = max(a * a * 4, 1)
+    b = max(1, min(_MAX_BLOCK_S, s, _VMEM_BUDGET // (_SLABS * slab)))
+    while s % b:
+        b -= 1
+    return b
 
 
 def _diag_mask(a: int, dtype=jnp.float32) -> jnp.ndarray:
@@ -45,18 +66,21 @@ def _diag_mask(a: int, dtype=jnp.float32) -> jnp.ndarray:
 
 
 def _prep_mean_kernel(p2p_ref, out_ref):
-    """out[s, i] = mean_j of (-p2p[s, j, i]) with diag zeroed."""
+    """out[s, 0, i] = mean_j of (-p2p[s, j, i]) with diag zeroed.
+
+    mean_j(-p2p[s, j, i]) over the diag-zeroed matrix = -(column sum)/A, a
+    contiguous reduce over rows — no transpose needed.
+    """
     p2p = p2p_ref[:]  # [SB, A, A]
     a = p2p.shape[-1]
     p2p = p2p * _diag_mask(a)[None, :, :]
-    powers = -jnp.swapaxes(p2p, -1, -2)
-    out_ref[:] = jnp.mean(powers, axis=-1)
+    out_ref[:] = -jnp.sum(p2p, axis=1, keepdims=True) / a
 
 
 def _divide_kernel(p2p_ref, out_power_ref, new_ref):
     """Row i of new = divide_power(out_power[i], -diagzero(p2p)[:, i])."""
     p2p = p2p_ref[:]  # [SB, A, A]
-    out = out_power_ref[:]  # [SB, A]
+    out = out_power_ref[:][:, 0, :]  # [SB, A]
     a = p2p.shape[-1]
     p2p = p2p * _diag_mask(a)[None, :, :]
     powers = -jnp.swapaxes(p2p, -1, -2)  # powers[s, i, j]
@@ -68,78 +92,91 @@ def _divide_kernel(p2p_ref, out_power_ref, new_ref):
     safe_total = jnp.where(total > 0.0, total, 1.0)
     proportional = out[..., None] * jnp.abs(filtered) / safe_total
     equal = out[..., None] / a
-    new_ref[:] = jnp.where(total > 0.0, proportional, jnp.broadcast_to(equal, powers.shape))
+    new_ref[:] = jnp.where(
+        total > 0.0, proportional, jnp.broadcast_to(equal, powers.shape)
+    )
 
 
 def _clear_kernel(p2p_ref, grid_ref, peer_ref):
-    """Pairwise sign-opposition matching totals (community.py:45-54)."""
+    """Pairwise sign-opposition matching totals (community.py:45-54).
+
+    The sign-opposition mask is symmetric, so ``|p_match|^T`` equals the
+    mask applied to ``p2p^T`` — one VMEM transpose serves both operands.
+    """
     p2p = p2p_ref[:]  # [SB, A, A]
     p2p_t = jnp.swapaxes(p2p, -1, -2)
-    p_match = jnp.where(jnp.sign(p2p) != jnp.sign(p2p_t), p2p, 0.0)
-    abs_match = jnp.abs(p_match)
+    opp = jnp.sign(p2p) != jnp.sign(p2p_t)
+    p_match = jnp.where(opp, p2p, 0.0)
+    p_match_t = jnp.where(opp, p2p_t, 0.0)
     exchange = jnp.sign(p_match) * jnp.minimum(
-        abs_match, jnp.swapaxes(abs_match, -1, -2)
+        jnp.abs(p_match), jnp.abs(p_match_t)
     )
-    grid_ref[:] = jnp.sum(p2p - exchange, axis=-1)
-    peer_ref[:] = jnp.sum(exchange, axis=-1)
+    grid_ref[:] = jnp.sum(p2p - exchange, axis=-1, keepdims=True).swapaxes(1, 2)
+    peer_ref[:] = jnp.sum(exchange, axis=-1, keepdims=True).swapaxes(1, 2)
 
 
-def _block(s: int) -> int:
-    b = min(_BLOCK_S, s)
-    while s % b:
-        b -= 1
-    return b
+def _compiler_params():
+    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
+
+def _mat_spec(sb: int, a: int) -> pl.BlockSpec:
+    return pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+
+
+def _vec_spec(sb: int, a: int) -> pl.BlockSpec:
+    # Per-agent vectors ride as [S, 1, A]: the middle dim is full-size 1, so
+    # the (8, 128) block rule is satisfied for any SB.
+    return pl.BlockSpec((sb, 1, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
 
 
 @jax.jit
 def prep_mean(p2p: jnp.ndarray) -> jnp.ndarray:
     """[S, A, A] -> [S, A] fused diag-zero + negate-transpose + mean."""
     s, a, _ = p2p.shape
-    sb = _block(s)
-    return pl.pallas_call(
+    sb = _block(s, a)
+    out = pl.pallas_call(
         _prep_mean_kernel,
-        out_shape=jax.ShapeDtypeStruct((s, a), p2p.dtype),
+        out_shape=jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
         grid=(s // sb,),
-        in_specs=[pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        in_specs=[_mat_spec(sb, a)],
+        out_specs=_vec_spec(sb, a),
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(p2p)
+    return out[:, 0, :]
 
 
 @jax.jit
 def divide_power_fused(p2p: jnp.ndarray, out_power: jnp.ndarray) -> jnp.ndarray:
     """[S, A, A], [S, A] -> [S, A, A] fused proposal split."""
     s, a, _ = p2p.shape
-    sb = _block(s)
+    sb = _block(s, a)
     return pl.pallas_call(
         _divide_kernel,
         out_shape=jax.ShapeDtypeStruct((s, a, a), p2p.dtype),
         grid=(s // sb,),
-        in_specs=[
-            pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        in_specs=[_mat_spec(sb, a), _vec_spec(sb, a)],
+        out_specs=_mat_spec(sb, a),
         interpret=_interpret(),
-    )(p2p, out_power)
+        compiler_params=_compiler_params(),
+    )(p2p, out_power[:, None, :])
 
 
 @jax.jit
 def clear_market_fused(p2p: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """[S, A, A] -> (p_grid [S, A], p_p2p [S, A]) fused matching."""
     s, a, _ = p2p.shape
-    sb = _block(s)
-    return pl.pallas_call(
+    sb = _block(s, a)
+    grid_o, peer_o = pl.pallas_call(
         _clear_kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((s, a), p2p.dtype),
-            jax.ShapeDtypeStruct((s, a), p2p.dtype),
+            jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
+            jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
         ),
         grid=(s // sb,),
-        in_specs=[pl.BlockSpec((sb, a, a), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
-        out_specs=(
-            pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((sb, a), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ),
+        in_specs=[_mat_spec(sb, a)],
+        out_specs=(_vec_spec(sb, a), _vec_spec(sb, a)),
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(p2p)
+    return grid_o[:, 0, :], peer_o[:, 0, :]

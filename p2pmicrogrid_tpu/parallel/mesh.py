@@ -53,3 +53,35 @@ def replicate(tree, mesh: Mesh):
     """Device-put every leaf fully replicated over the mesh."""
     sh = replicated_sharding(mesh)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def shard_scen_state(scen_state, mesh: Mesh, axis_name: str = "data"):
+    """Shard a shared-trainer per-scenario state over the mesh.
+
+    The DQN/DDPG ``LockstepReplay`` is time-major ([cap, S, A, ...]; scalar
+    cursor/count), so its scenario axis is axis 1; the DDPG OU state is
+    [S, A] with the scenario axis leading. Scalars replicate.
+    """
+    from p2pmicrogrid_tpu.models.replay import LockstepReplay
+    from p2pmicrogrid_tpu.parallel.scenarios import DDPGScenState
+
+    if scen_state is None:
+        return None
+    if isinstance(scen_state, DDPGScenState):
+        return scen_state._replace(
+            replay=shard_scen_state(scen_state.replay, mesh, axis_name),
+            ou=jax.device_put(
+                scen_state.ou, NamedSharding(mesh, P(axis_name))
+            ),
+        )
+    if isinstance(scen_state, LockstepReplay):
+
+        def put(x):
+            spec = P() if x.ndim == 0 else P(None, axis_name)
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(put, scen_state)
+    raise TypeError(
+        f"unsupported scen_state type {type(scen_state).__name__}; expected "
+        "None, LockstepReplay, or DDPGScenState"
+    )

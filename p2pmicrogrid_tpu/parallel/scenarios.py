@@ -55,7 +55,11 @@ from p2pmicrogrid_tpu.models.dqn import (
     _td_loss,
     apply_td_update,
 )
-from p2pmicrogrid_tpu.models.replay import replay_add, replay_init, replay_sample
+from p2pmicrogrid_tpu.models.replay import (
+    lockstep_replay_add,
+    lockstep_replay_init,
+    lockstep_replay_sample,
+)
 from p2pmicrogrid_tpu.models.tabular import TabularState
 from p2pmicrogrid_tpu.ops.obs import discretize
 
@@ -297,15 +301,19 @@ def _tabular_update_shared(
         axis=0,
     )                                                 # [A, m]
 
-    qt3 = qt.reshape(A, q.num_time_states, m)
-    row = jax.lax.dynamic_index_in_dim(qt3, tbin, axis=1, keepdims=False)
-    qt3 = jax.lax.dynamic_update_index_in_dim(qt3, row + delta, tbin, axis=1)
+    # In-place row update: scatter-add at the (single, in-bounds) time bin,
+    # directly on the 6-D table. Flattening to [A, T, m] first made XLA pick
+    # a different tiled layout for the scatter view than for the scan-carried
+    # table, inserting a full-table relayout copy every slot (copy + DUS =
+    # ~50% of the episode in the config-3 profile).
+    delta6 = delta.reshape(
+        A, q.num_temp_states, q.num_balance_states, q.num_p2p_states, q.num_actions
+    )
+    qt = qt.at[:, tbin].add(delta6, unique_indices=True, indices_are_sorted=True)
     # Error metric = agent-mean squared TD error per scenario (the tabular
     # analogue of the DQN TD loss, so training_progress.error is meaningful
     # in shared mode — the reference's QAgent.train reports 0 forever).
-    return state._replace(q_table=qt3.reshape(qt.shape)), jnp.mean(
-        jnp.square(td), axis=1
-    )
+    return state._replace(q_table=qt), jnp.mean(jnp.square(td), axis=1)
 
 
 def _dqn_update_shared(
@@ -316,30 +324,29 @@ def _dqn_update_shared(
     """
     d = cfg.dqn
     act_frac = ACTION_VALUES[tr.aux.astype(jnp.int32)][..., None]  # [S, A, 1]
-    replay_s = jax.vmap(replay_add)(replay_s, tr.obs, act_frac, tr.reward, tr.next_obs)
+    replay_s = lockstep_replay_add(replay_s, tr.obs, act_frac, tr.reward, tr.next_obs)
 
-    S = tr.obs.shape[0]
-    keys = jax.random.split(key, S)
-    s, a, r, ns = jax.vmap(lambda rep, k: replay_sample(rep, k, d.batch_size))(
-        replay_s, keys
-    )  # [S, A, B, ...]
+    s, a, r, ns = lockstep_replay_sample(replay_s, key, d.batch_size)  # [B, S, A, ...]
+    # Pool the scenario axis into each agent's batch: [B, S, A, ...] ->
+    # [A, B*S, ...]. The pooled-mean TD loss equals the scenario-mean of
+    # per-scenario losses (equal batch sizes).
+    pool = lambda x: jnp.moveaxis(x, 2, 0).reshape((x.shape[2], -1) + x.shape[3:])
 
     net = QNetwork(hidden=d.hidden)
 
     def learn_one(params, target_params, opt_state, s, a, r, ns):
-        def loss_fn(p):
-            # Mean TD loss over the scenario axis for one agent.
-            losses = jax.vmap(
-                lambda s_, a_, r_, ns_: _td_loss(d, net, p, target_params, s_, a_, r_, ns_)
-            )(s, a, r, ns)
-            return jnp.mean(losses)
+        return apply_td_update(
+            d,
+            lambda p: _td_loss(d, net, p, target_params, s, a, r, ns),
+            params,
+            target_params,
+            opt_state,
+        )
 
-        return apply_td_update(d, loss_fn, params, target_params, opt_state)
-
-    # vmap over the agent axis; scenario axis is reduced inside the loss.
-    online, target, opt_state, loss = jax.vmap(
-        learn_one, in_axes=(0, 0, 0, 1, 1, 1, 1)
-    )(state.online, state.target, state.opt_state, s, a, r, ns)
+    online, target, opt_state, loss = jax.vmap(learn_one)(
+        state.online, state.target, state.opt_state,
+        pool(s), pool(a), pool(r), pool(ns),
+    )
 
     new_state = state._replace(online=online, target=target, opt_state=opt_state)
     return new_state, replay_s, loss
@@ -348,9 +355,9 @@ def _dqn_update_shared(
 class DDPGScenState(NamedTuple):
     """Per-scenario exploration/replay state for shared DDPG: the learnable
     ``DDPGParams`` are shared across scenarios, but each scenario keeps its
-    own replay ring and Ornstein-Uhlenbeck noise trajectory."""
+    own replay history and Ornstein-Uhlenbeck noise trajectory."""
 
-    replay: object           # ReplayState leaves stacked [S, A, ...]
+    replay: object           # LockstepReplay (time-major, [cap, S, A, ...])
     ou: jnp.ndarray          # [S, A]
 
 
@@ -367,14 +374,10 @@ def _ddpg_update_shared(
     updates on the fully pooled [S*A*B] batch.
     """
     d = cfg.ddpg
-    replay_s = jax.vmap(replay_add)(
+    replay_s = lockstep_replay_add(
         scen.replay, tr.obs, tr.aux[..., None], tr.reward, tr.next_obs
     )
-    S = tr.obs.shape[0]
-    keys = jax.random.split(key, S)
-    s, a, r, ns = jax.vmap(lambda rep, k: replay_sample(rep, k, d.batch_size))(
-        replay_s, keys
-    )  # [S, A, B, ...]
+    s, a, r, ns = lockstep_replay_sample(replay_s, key, d.batch_size)  # [B, S, A, ...]
 
     if d.share_across_agents:
         flat = lambda x: x.reshape((-1,) + x.shape[3:])
@@ -392,9 +395,10 @@ def _ddpg_update_shared(
             flat(ns),
         )
     else:
-        # Pool scenarios into each agent's batch: [S, A, B, ...] -> [A, S*B, ...].
-        pool = lambda x: jnp.swapaxes(x, 0, 1).reshape(
-            (x.shape[1], -1) + x.shape[3:]
+        # Pool batch and scenarios into each agent's batch:
+        # [B, S, A, ...] -> [A, B*S, ...].
+        pool = lambda x: jnp.moveaxis(x, 2, 0).reshape(
+            (x.shape[2], -1) + x.shape[3:]
         )
         pa, pc, pat, pct, oa, oc, loss = jax.vmap(
             lambda *args: ddpg_learn_batch(d, *args)
@@ -438,19 +442,16 @@ def init_shared_state(
     A = cfg.sim.n_agents
     impl = cfg.train.implementation
 
-    def scen_replay(capacity):
-        return jax.vmap(lambda _: replay_init(A, capacity, OBS_DIM, 1))(
-            jnp.arange(S)
-        )
-
     if impl == "tabular":
         return init_policy_state(cfg, key), None
     if impl == "dqn":
-        return init_policy_state(cfg, key), scen_replay(cfg.dqn.buffer_size)
+        return init_policy_state(cfg, key), lockstep_replay_init(
+            S, A, cfg.dqn.buffer_size, OBS_DIM, 1
+        )
     if impl == "ddpg":
         k_params, k_ou = jax.random.split(key)
         scen = DDPGScenState(
-            replay=scen_replay(cfg.ddpg.buffer_size),
+            replay=lockstep_replay_init(S, A, cfg.ddpg.buffer_size, OBS_DIM, 1),
             ou=cfg.ddpg.ou_init_sd * jax.random.normal(k_ou, (S, A)),
         )
         return ddpg_params_init(cfg.ddpg, A, k_params), scen
@@ -534,7 +535,8 @@ def make_shared_episode_fn(
             xs.next_pv_w,
         )
         (phys_s, pol_state, scen_state, _), (rewards, losses) = jax.lax.scan(
-            slot, (phys_s, pol_state, scen_state, k_scan), xs
+            slot, (phys_s, pol_state, scen_state, k_scan), xs,
+            unroll=cfg.sim.slot_unroll,
         )
         return (pol_state, scen_state), (
             jnp.sum(rewards, axis=0),

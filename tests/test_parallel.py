@@ -11,7 +11,6 @@ import pytest
 
 from p2pmicrogrid_tpu.config import DQNConfig, SimConfig, TrainConfig, default_config
 from p2pmicrogrid_tpu.envs import make_ratings
-from p2pmicrogrid_tpu.models.replay import replay_init
 from p2pmicrogrid_tpu.parallel import (
     make_mesh,
     make_scenario_traces,
@@ -19,7 +18,7 @@ from p2pmicrogrid_tpu.parallel import (
     train_scenarios_independent,
     train_scenarios_shared,
 )
-from p2pmicrogrid_tpu.parallel.mesh import replicate, shard_leading_axis
+from p2pmicrogrid_tpu.parallel.mesh import replicate, shard_leading_axis, shard_scen_state
 from p2pmicrogrid_tpu.train import init_policy_state, make_policy
 
 S = 8
@@ -110,19 +109,18 @@ def test_shared_tabular_single_table(setup):
 def test_shared_dqn_runs(setup):
     cfg, ratings, arrays = setup
     cfg = cfg.replace(train=TrainConfig(implementation="dqn"))
+    from p2pmicrogrid_tpu.parallel import init_shared_state
+
     key = jax.random.PRNGKey(0)
     policy = make_policy(cfg)
-    ps = init_policy_state(cfg, key)
-    repl = jax.vmap(lambda _: replay_init(2, cfg.dqn.buffer_size, 4, 1))(
-        jnp.arange(S)
-    )
+    ps, repl = init_shared_state(cfg, key)
     ps2, repl2, rewards, _, _ = train_scenarios_shared(
         cfg, policy, ps, arrays, ratings, key, n_episodes=1, replay_s=repl
     )
     assert rewards.shape == (1, S)
-    # Scenario replay keeps its [S, A, cap, ...] shape, separate from pol_state.
-    assert repl2.obs.shape[0] == S
-    assert int(np.asarray(repl2.count).reshape(-1)[0]) == 96
+    # Time-major lockstep replay: [cap, S, A, ...], separate from pol_state.
+    assert repl2.obs.shape[1] == S
+    assert int(np.asarray(repl2.count)) == 96
     d = np.abs(
         np.asarray(ps2.online["Dense_0"]["kernel"])
         - np.asarray(ps.online["Dense_0"]["kernel"])
@@ -183,7 +181,7 @@ class TestSharedDDPG:
         ps, scen = init_shared_state(cfg, jax.random.PRNGKey(1))
 
         mesh = make_mesh()
-        scen_sh = shard_leading_axis(scen, mesh)
+        scen_sh = shard_scen_state(scen, mesh)
         arrays_sh = shard_leading_axis(arrays, mesh)
 
         ps_sh, _, r_sh, l_sh, _ = train_scenarios_shared(
