@@ -16,7 +16,11 @@ from p2pmicrogrid_tpu.analysis.stats import (
     statistical_tests,
 )
 from p2pmicrogrid_tpu.analysis.plots import (
+    plot_cost_vs_community_size,
+    plot_forecast,
     plot_learning_curves,
+    plot_pv_drop_comparison,
+    plot_scaling,
     plot_cost_comparison,
     plot_day_traces,
     plot_rounds_decisions,
@@ -31,7 +35,11 @@ __all__ = [
     "statistics_community_scale",
     "statistics_nr_rounds",
     "statistical_tests",
+    "plot_cost_vs_community_size",
+    "plot_forecast",
     "plot_learning_curves",
+    "plot_pv_drop_comparison",
+    "plot_scaling",
     "plot_cost_comparison",
     "plot_day_traces",
     "plot_rounds_decisions",

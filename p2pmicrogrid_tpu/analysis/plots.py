@@ -23,6 +23,160 @@ def _plt():
     return plt
 
 
+def _parse_setting(setting: str):
+    """(n_agents, rounds) from a community setting string
+    ('{n}-multi-agent-com-rounds-{r}-...', community.py:423); rounds is None
+    for no-com / unparsable settings."""
+    import re
+
+    m = re.match(r"^(\d+)-multi-agent-com-rounds-(\d+)", setting)
+    if m:
+        return int(m.group(1)), int(m.group(2))
+    m = re.match(r"^(\d+)-multi-agent-no-com", setting)
+    if m:
+        return int(m.group(1)), None
+    return None, None
+
+
+def plot_scaling(timing: dict, phase: str = "train"):
+    """Computation-time scaling figures (data_analysis.py:775-845): wall-clock
+    vs community size (one line per negotiation-round count) and vs rounds
+    (one line per community size), from the per-setting timing JSON the CLI
+    writes (--timing-json; the reference's save_times, community.py:324-338).
+    """
+    plt = _plt()
+    points = []  # (n, rounds, seconds)
+    for setting, phases in timing.items():
+        if phase not in phases:
+            continue
+        n, r = _parse_setting(setting)
+        if n is None or r is None:
+            continue
+        points.append((n, r, float(phases[phase])))
+    fig, axes = plt.subplots(1, 2, figsize=(12, 4))
+    by_rounds = {}
+    by_n = {}
+    for n, r, s in sorted(points):
+        by_rounds.setdefault(r, []).append((n, s))
+        by_n.setdefault(n, []).append((r, s))
+    for r, xs in sorted(by_rounds.items()):
+        axes[0].plot(*zip(*sorted(xs)), marker="o", label=f"{r} round(s)")
+    for n, xs in sorted(by_n.items()):
+        axes[1].plot(*zip(*sorted(xs)), marker="o", label=f"{n} agents")
+    axes[0].set_xlabel("Community size [agents]")
+    axes[1].set_xlabel("Negotiation rounds")
+    for ax in axes:
+        ax.set_ylabel(f"{phase} wall-clock [s]")
+        if ax.lines:
+            ax.legend()
+    fig.tight_layout()
+    return fig
+
+
+def plot_cost_vs_community_size(results_df):
+    """Average daily cost per agent vs community size
+    (data_analysis.py:775-806's cost-scaling companion).
+
+    Built on ``stats.daily_cost_table`` so runs keep their (setting,
+    implementation) identity, and split into one line per experiment
+    condition (rounds-r / no-com, per implementation) — com and no-com
+    communities of the same size are different experiments and must not
+    average into one point.
+    """
+    import re
+
+    from p2pmicrogrid_tpu.analysis.stats import daily_cost_table
+
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(7, 4))
+    daily = daily_cost_table(results_df)  # [day x run-label]
+    lines = {}  # condition -> [(n, mean cost)]
+    for label in daily.columns:
+        setting = label.split("[")[0]
+        impl = re.search(r"\[([^\]]+)\]$", label)
+        n, r = _parse_setting(setting)
+        if n is None:
+            continue
+        cond = f"rounds-{r}" if r is not None else "no-com"
+        if impl:
+            cond += f" [{impl.group(1)}]"
+        lines.setdefault(cond, []).append((n, float(daily[label].mean())))
+    for cond, xs in sorted(lines.items()):
+        ax.plot(*zip(*sorted(xs)), marker="o", label=cond)
+    ax.set_xlabel("Community size [agents]")
+    ax.set_ylabel("Avg daily cost per agent [EUR]")
+    if ax.lines:
+        ax.legend()
+    fig.tight_layout()
+    return fig
+
+
+def plot_pv_drop_comparison(results_df, com_setting: str, nocom_setting: str):
+    """The PV-drop fault comparison (data_analysis.py:1099-1211): for the
+    affected runs ('{n}-agent-{i}-pv-drop-{com,no-com}' settings), the
+    communicating community absorbs the lost production through P2P trades
+    while the isolated one buys at the tariff — visible in per-slot PV,
+    cumulative cost, and indoor temperature of the dropped agent.
+
+    ``results_df``: validation or test results table; the dropped agent index
+    is parsed from the setting name.
+    """
+    import re
+
+    plt = _plt()
+    m = re.match(r"^\d+-agent-(\d+)-pv-drop", com_setting)
+    agent = int(m.group(1)) if m else 0
+
+    fig, axes = plt.subplots(3, 1, figsize=(12, 8), sharex=True)
+    for setting, label in ((com_setting, "com"), (nocom_setting, "no-com")):
+        g = results_df[
+            (results_df["setting"] == setting) & (results_df["agent"] == agent)
+        ]
+        if g.empty:
+            continue
+        # One run only: a second implementation stored under the same setting
+        # would interleave rows and double-count the cumulative cost.
+        impl = sorted(g["implementation"].unique())[0]
+        g = g[g["implementation"] == impl]
+        day = g["day"].min()
+        g = g[g["day"] == day].sort_values("time")
+        hours = g["time"].to_numpy() * 24
+        axes[0].plot(hours, g["pv"].to_numpy() / 1e3, label=label)
+        axes[1].plot(hours, g["cost"].cumsum().to_numpy(), label=label)
+        axes[2].plot(hours, g["temperature"].to_numpy(), label=label)
+    axes[0].set_ylabel("PV [kW]")
+    axes[1].set_ylabel("Cumulative cost [EUR]")
+    axes[2].set_ylabel("Indoor T [degC]")
+    axes[2].set_xlabel("Hour")
+    axes[2].axhspan(20, 22, alpha=0.15, color="green")
+    for ax in axes:
+        if ax.lines:
+            ax.legend()
+    fig.suptitle(f"PV drop on agent {agent}: communicating vs isolated")
+    fig.tight_layout()
+    return fig
+
+
+def plot_forecast(slot_hours, pred_load, pred_pv, target_load, target_pv):
+    """Predicted vs actual normalized load/PV over the validation timeline —
+    the reference's forecaster result plot (ml.py:287-308)."""
+    plt = _plt()
+    fig, axes = plt.subplots(2, 1, figsize=(12, 6), sharex=True)
+    for ax, pred, target, name in (
+        (axes[0], pred_load, target_load, "load"),
+        (axes[1], pred_pv, target_pv, "PV"),
+    ):
+        ax.plot(slot_hours, np.asarray(target), label=f"actual {name}", lw=1.2)
+        ax.plot(
+            slot_hours, np.asarray(pred), label=f"predicted {name}", lw=1.2, ls="--"
+        )
+        ax.set_ylabel(f"normalized {name}")
+        ax.legend()
+    axes[1].set_xlabel("Hour")
+    fig.tight_layout()
+    return fig
+
+
 def plot_learning_curves(progress_df, settings: Optional[Sequence[str]] = None):
     """Reward / TD-error training curves (data_analysis.py:697-772).
 

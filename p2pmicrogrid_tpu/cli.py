@@ -614,6 +614,86 @@ def cmd_sweep(args) -> int:
     return 0
 
 
+def cmd_forecast(args) -> int:
+    """Train the windowed LSTM load/PV forecaster end-to-end and persist
+    predictions — the counterpart of the reference's ``ml.main()``
+    (ml.py:265-314): train on the training days, evaluate on the validation
+    day, write predicted-vs-target rows to ``single_day_best_results``
+    (database.py:176-193) and render the forecast figure."""
+    import dataclasses
+
+    import jax
+
+    from p2pmicrogrid_tpu.data import ResultsStore
+    from p2pmicrogrid_tpu.models.forecast import (
+        forecast_predict,
+        make_windows,
+        train_forecaster,
+    )
+
+    cfg = _build_cfg(args)
+    fc = dataclasses.replace(cfg.forecast, epochs=args.epochs)
+    train_traces, val_traces, _ = _load_traces(args)
+
+    def features(tr):
+        # [time, outdoor temp (scaled), load, pv] — the reference's windowed
+        # feature set with the (load, pv) pair as the forecast targets
+        # (ml.py:30-48,253); profile 0 = the reference's single home.
+        return np.stack(
+            [
+                np.asarray(tr.time),
+                np.asarray(tr.t_out) / 20.0,
+                np.asarray(tr.load)[:, 0],
+                np.asarray(tr.pv)[:, 0],
+            ],
+            axis=1,
+        )
+
+    x_tr, y_tr = make_windows(
+        features(train_traces), fc.input_width, fc.label_width, fc.shift
+    )
+    x_val, y_val = make_windows(
+        features(val_traces), fc.input_width, fc.label_width, fc.shift
+    )
+    key = jax.random.PRNGKey(cfg.train.seed)
+    state, history = train_forecaster(
+        fc, x_tr, y_tr, key, val_inputs=x_val, val_labels=y_val, verbose=True
+    )
+    pred = np.asarray(forecast_predict(fc, state, x_val))  # [N, W, 2]
+    # The t+shift forecast = last window step (ml.py label alignment).
+    p_load, p_pv = pred[:, -1, 0], pred[:, -1, 1]
+    t_load, t_pv = y_val[:, -1, 0], y_val[:, -1, 1]
+    mse = float(np.mean((pred - y_val) ** 2))
+    train_mse = f"{history[-1][0]:.5f}" if history else "n/a"
+    print(f"validation mse {mse:.5f} over {len(p_load)} windows "
+          f"({fc.epochs} epochs; final train mse {train_mse})")
+
+    # Forecast timestamps: each prediction lands input_width+shift-1 slots
+    # after its window start.
+    offset = fc.input_width + fc.shift - 1
+    days = np.asarray(val_traces.day)[offset : offset + len(p_load)]
+    times = np.asarray(val_traces.time)[offset : offset + len(p_load)]
+    dates = [f"2021-10-{int(d):02d}" for d in days]
+    hhmm = [f"{int(t * 24):02d}:{int((t * 24 % 1) * 60):02d}" for t in times]
+
+    setting = f"forecast-lstm-w{fc.input_width}s{fc.shift}"
+    if args.results_db:
+        store = ResultsStore(args.results_db)
+        store.log_predictions(setting, dates, hhmm, p_load, p_pv, t_load, t_pv)
+        print(f"predictions -> {args.results_db} (single_day_best_results)")
+    if args.figures_dir:
+        import os
+
+        from p2pmicrogrid_tpu.analysis import plot_forecast
+
+        os.makedirs(args.figures_dir, exist_ok=True)
+        hours = times * 24 + (days - days.min()) * 24
+        fig = plot_forecast(hours, p_load, p_pv, t_load, t_pv)
+        fig.savefig(f"{args.figures_dir}/forecast.png", dpi=120)
+        print(f"figure -> {args.figures_dir}/forecast.png")
+    return 0
+
+
 def cmd_bench(args) -> int:
     from p2pmicrogrid_tpu.benchmarks import main as bench_main
 
@@ -624,7 +704,10 @@ def cmd_bench(args) -> int:
 def cmd_analyse(args) -> int:
     from p2pmicrogrid_tpu.analysis import (
         plot_cost_comparison,
+        plot_cost_vs_community_size,
         plot_learning_curves,
+        plot_pv_drop_comparison,
+        plot_scaling,
         statistical_tests,
     )
     from p2pmicrogrid_tpu.data import ResultsStore
@@ -636,17 +719,50 @@ def cmd_analyse(args) -> int:
         import os
 
         os.makedirs(args.figures_dir, exist_ok=True)
+        written = []
+
+        def save(fig, name):
+            fig.savefig(f"{args.figures_dir}/{name}", dpi=120)
+            written.append(name)
+
         progress = store.get_training_progress()
         if not progress.empty:
-            plot_learning_curves(progress).savefig(
-                f"{args.figures_dir}/learning_curves.png", dpi=120
-            )
-        tests = store.get_test_results()
-        if not tests.empty:
-            plot_cost_comparison(tests).savefig(
-                f"{args.figures_dir}/cost_comparison.png", dpi=120
-            )
-        print(f"figures -> {args.figures_dir}")
+            save(plot_learning_curves(progress), "learning_curves.png")
+        results = store.get_test_results()
+        if results.empty:
+            results = store.get_validation_results()
+        if not results.empty:
+            save(plot_cost_comparison(results), "cost_comparison.png")
+            save(plot_cost_vs_community_size(results), "cost_vs_size.png")
+            # PV-drop fault comparison (data_analysis.py:1099-1211): render
+            # when a com/no-com pv-drop setting pair exists in the results.
+            settings = set(results["setting"].unique())
+            for s in sorted(settings):
+                if s.endswith("-pv-drop-com"):
+                    twin = s[: -len("com")] + "no-com"
+                    if twin in settings:
+                        # Per-pair filename: several fault experiments may
+                        # coexist in one DB.
+                        stem = s[: -len("-com")]
+                        save(
+                            plot_pv_drop_comparison(results, s, twin),
+                            f"{stem}.png",
+                        )
+        if args.timing_json:
+            import os.path
+
+            if os.path.exists(args.timing_json):
+                with open(args.timing_json) as f:
+                    timing = json.load(f)
+                # Scaling figures (data_analysis.py:775-845) from the
+                # wall-clock records the train/eval commands append.
+                for phase in ("train", "run"):
+                    if any(phase in v for v in timing.values()):
+                        save(
+                            plot_scaling(timing, phase=phase),
+                            f"scaling_{phase}.png",
+                        )
+        print(f"figures -> {args.figures_dir}: {', '.join(written) or '(none)'}")
     return 0
 
 
@@ -725,12 +841,22 @@ def main(argv=None) -> int:
     p.add_argument("--ou-sigmas", default="0.1", dest="ou_sigmas")
     p.set_defaults(fn=cmd_sweep)
 
+    p = sub.add_parser("forecast", help="train + evaluate the load/PV forecaster")
+    _add_common(p)
+    p.add_argument("--epochs", type=int, default=200,
+                   help="training epochs (reference: 200, ml.py:275)")
+    p.add_argument("--figures-dir")
+    p.set_defaults(fn=cmd_forecast)
+
     p = sub.add_parser("bench", help="run the benchmark")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser("analyse", help="statistics + figures from a results DB")
     p.add_argument("--results-db", required=True)
     p.add_argument("--figures-dir")
+    p.add_argument("--timing-json", dest="timing_json",
+                   help="per-setting wall-clock JSON (written by train/eval) "
+                        "for the scaling figures")
     p.set_defaults(fn=cmd_analyse)
 
     args = parser.parse_args(argv)

@@ -85,6 +85,31 @@ class TestTrainResume:
         assert data["2-multi-agent-com-rounds-1-hetero"]["train"] > 0
 
 
+class TestForecast:
+    def test_forecast_persists_predictions_and_figure(self, tmp_path):
+        """End-to-end forecaster driver (reference ml.main(), ml.py:265-314):
+        trains, evaluates on the validation day, fills
+        single_day_best_results, renders the figure."""
+        db = str(tmp_path / "f.db")
+        figs = tmp_path / "figs"
+        assert (
+            main(
+                [
+                    "forecast", "--epochs", "2", "--results-db", db,
+                    "--figures-dir", str(figs),
+                ]
+            )
+            == 0
+        )
+        with sqlite3.connect(db) as conn:
+            n, settings = conn.execute(
+                "SELECT COUNT(*), MIN(settings) FROM single_day_best_results"
+            ).fetchone()
+        assert n > 0
+        assert settings.startswith("forecast-lstm")
+        assert (figs / "forecast.png").is_file()
+
+
 class TestMulti:
     def test_multi_community_runs_and_checkpoints(self, tmp_path):
         db = str(tmp_path / "r.db")

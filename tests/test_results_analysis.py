@@ -13,10 +13,13 @@ from p2pmicrogrid_tpu.analysis import (
     community_summary,
     paired_cost_ttest,
     plot_cost_comparison,
+    plot_cost_vs_community_size,
     plot_day_traces,
     plot_learning_curves,
+    plot_pv_drop_comparison,
     plot_qtable_heatmap,
     plot_rounds_decisions,
+    plot_scaling,
     statistical_tests,
 )
 from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
@@ -156,3 +159,38 @@ class TestPlots:
             is not None
         )
         assert plot_qtable_heatmap(np.asarray(ps.q_table)[0]) is not None
+
+    def test_scaling_figures(self):
+        """Scaling figures from the timing JSON (data_analysis.py:775-845)."""
+        timing = {
+            "2-multi-agent-com-rounds-1-hetero": {"train": 10.0, "run": 1.0},
+            "5-multi-agent-com-rounds-1-hetero": {"train": 22.0},
+            "10-multi-agent-com-rounds-1-hetero": {"train": 41.0},
+            "5-multi-agent-com-rounds-2-hetero": {"train": 33.0},
+            "5-multi-agent-no-com-hetero": {"train": 9.0},  # skipped (no rounds)
+        }
+        fig = plot_scaling(timing)
+        assert fig is not None
+        ax_n, ax_r = fig.axes
+        # One line per rounds value on the size panel; per size on the rounds.
+        assert len(ax_n.lines) == 2 and len(ax_r.lines) == 3
+
+    def test_cost_vs_community_size(self, eval_run):
+        _, store, _, _, _, _ = eval_run
+        assert plot_cost_vs_community_size(store.get_test_results()) is not None
+
+    def test_pv_drop_comparison(self, eval_run):
+        """PV-drop com-vs-no-com comparison (data_analysis.py:1099-1211)."""
+        _, store, days, outputs, day_arrays, _ = eval_run
+        from p2pmicrogrid_tpu.data import save_eval_outputs
+
+        for s in ("2-agent-0-pv-drop-com", "2-agent-0-pv-drop-no-com"):
+            save_eval_outputs(store, s, "tabular", True, days, outputs, day_arrays)
+        fig = plot_pv_drop_comparison(
+            store.get_test_results(),
+            "2-agent-0-pv-drop-com",
+            "2-agent-0-pv-drop-no-com",
+        )
+        assert fig is not None
+        # Both settings plotted on each panel.
+        assert all(len(ax.lines) == 2 for ax in fig.axes)
