@@ -427,8 +427,7 @@ def slot_dynamics_batched(
     if use_pallas:
         from p2pmicrogrid_tpu.ops.pallas_market import (
             clear_market_fused,
-            divide_power_fused,
-            prep_mean,
+            divide_power_fused_with_mean,
         )
 
     buy, inj = grid_prices(cfg.tariff, time_s)  # [S]
@@ -454,15 +453,7 @@ def slot_dynamics_batched(
             frac, aux, q = jax.vmap(one)(obs, prev_frac, keys)
             return frac, aux, q, ex
 
-    def round_body(carry, round_key):
-        p2p, hp_frac, ex = carry  # p2p [S, A, A]
-        if use_pallas:
-            p2p_mean = prep_mean(p2p) / ratings.max_in
-        else:
-            p2p_zd = zero_diagonal(p2p)
-            powers = -jnp.swapaxes(p2p_zd, -1, -2)
-            p2p_mean = jnp.mean(powers, axis=-1) / ratings.max_in
-
+    def _round_obs_act(p2p_mean, hp_frac, round_key, ex):
         obs = make_observation(
             time_s[:, None],
             normalized_temperature(th, phys_s.t_in),
@@ -470,23 +461,49 @@ def slot_dynamics_batched(
             p2p_mean,
         )  # [S, A, 4]
         hp_frac, aux, q, ex = act_fn(pol_state, obs, hp_frac, round_key, ex)
-
         out_power = balance_w + hp_frac * th.hp_max_power
-        if use_pallas:
-            p_out = divide_power_fused(p2p, out_power)
-        else:
+        return obs, hp_frac, aux, q, ex, out_power
+
+    if use_pallas:
+        # The fused divide kernel emits its output's prep_mean for free while
+        # the matrix is still in VMEM; the round loop carries it instead of
+        # re-reading [S, A, A] from HBM every round.
+        def round_body(carry, round_key):
+            p2p, mean_raw, hp_frac, ex = carry
+            obs, hp_frac, aux, q, ex, out_power = _round_obs_act(
+                mean_raw / ratings.max_in, hp_frac, round_key, ex
+            )
+            p_out, mean_raw = divide_power_fused_with_mean(p2p, out_power)
+            return (p_out, mean_raw, hp_frac, ex), (
+                obs, aux, q, hp_frac * th.hp_max_power,
+            )
+    else:
+
+        def round_body(carry, round_key):
+            p2p, mean_raw, hp_frac, ex = carry  # p2p [S, A, A]
+            del mean_raw  # jnp path recomputes from the carried matrix
+            p2p_zd = zero_diagonal(p2p)
+            powers = -jnp.swapaxes(p2p_zd, -1, -2)
+            p2p_mean = jnp.mean(powers, axis=-1) / ratings.max_in
+            obs, hp_frac, aux, q, ex, out_power = _round_obs_act(
+                p2p_mean, hp_frac, round_key, ex
+            )
             p_out = divide_power(out_power, powers)
-        return (p_out, hp_frac, ex), (obs, aux, q, hp_frac * th.hp_max_power)
+            return (p_out, jnp.zeros_like(out_power), hp_frac, ex), (
+                obs, aux, q, hp_frac * th.hp_max_power,
+            )
 
     if cfg.sim.trading:
         keys = jax.random.split(key, cfg.sim.rounds + 1)
-        (p2p, hp_frac, explore_state), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
+        init = (
+            jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1])),
+            jnp.zeros_like(balance_w),  # zero matrix -> zero mean
+            phys_s.hp_frac,
+            explore_state,
+        )
+        (p2p, _, hp_frac, explore_state), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
             round_body,
-            (
-                jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1])),
-                phys_s.hp_frac,
-                explore_state,
-            ),
+            init,
             keys,
             unroll=cfg.sim.rounds + 1,
         )

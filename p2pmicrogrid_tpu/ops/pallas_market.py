@@ -77,12 +77,13 @@ def _prep_mean_kernel(p2p_ref, out_ref):
     out_ref[:] = -jnp.sum(p2p, axis=1, keepdims=True) / a
 
 
-def _divide_kernel(p2p_ref, out_power_ref, new_ref):
-    """Row i of new = divide_power(out_power[i], -diagzero(p2p)[:, i])."""
-    p2p = p2p_ref[:]  # [SB, A, A]
-    out = out_power_ref[:][:, 0, :]  # [SB, A]
+def _divide_core(p2p, out):
+    """The proposal split (agent.py:186-195) on VMEM-resident blocks:
+    p2p [SB, A, A], out [SB, A] -> (new proposals [SB, A, A], diag mask).
+    Single source of the divide semantics for both divide kernels."""
     a = p2p.shape[-1]
-    p2p = p2p * _diag_mask(a)[None, :, :]
+    mask = _diag_mask(a)[None, :, :]
+    p2p = p2p * mask
     powers = -jnp.swapaxes(p2p, -1, -2)  # powers[s, i, j]
 
     filtered = jnp.where(
@@ -92,9 +93,28 @@ def _divide_kernel(p2p_ref, out_power_ref, new_ref):
     safe_total = jnp.where(total > 0.0, total, 1.0)
     proportional = out[..., None] * jnp.abs(filtered) / safe_total
     equal = out[..., None] / a
-    new_ref[:] = jnp.where(
+    new = jnp.where(
         total > 0.0, proportional, jnp.broadcast_to(equal, powers.shape)
     )
+    return new, mask
+
+
+def _divide_kernel(p2p_ref, out_power_ref, new_ref):
+    """Row i of new = divide_power(out_power[i], -diagzero(p2p)[:, i])."""
+    new, _ = _divide_core(p2p_ref[:], out_power_ref[:][:, 0, :])
+    new_ref[:] = new
+
+
+def _divide_mean_kernel(p2p_ref, out_power_ref, new_ref, mean_ref):
+    """``_divide_kernel`` fused with the NEXT round's ``prep_mean`` of its own
+    output: the new proposal matrix is still in VMEM, so emitting its
+    diag-masked column mean here saves re-reading [S, A, A] from HBM at the
+    start of the following round (~20% of the per-slot market traffic at
+    A=1000)."""
+    p2p = p2p_ref[:]  # [SB, A, A]
+    new, mask = _divide_core(p2p, out_power_ref[:][:, 0, :])
+    new_ref[:] = new
+    mean_ref[:] = -jnp.sum(new * mask, axis=1, keepdims=True) / p2p.shape[-1]
 
 
 def _clear_kernel(p2p_ref, grid_ref, peer_ref):
@@ -160,6 +180,33 @@ def divide_power_fused(p2p: jnp.ndarray, out_power: jnp.ndarray) -> jnp.ndarray:
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )(p2p, out_power[:, None, :])
+
+
+@jax.jit
+def divide_power_fused_with_mean(
+    p2p: jnp.ndarray, out_power: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[S, A, A], [S, A] -> (new p2p [S, A, A], its prep_mean [S, A]).
+
+    Equals ``(divide_power_fused(p2p, out), prep_mean(divide_power_fused(
+    p2p, out)))`` in one pass — the negotiation round loop carries the mean
+    to the next round instead of re-reading the matrix.
+    """
+    s, a, _ = p2p.shape
+    sb = _block(s, a)
+    new, mean = pl.pallas_call(
+        _divide_mean_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((s, a, a), p2p.dtype),
+            jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
+        ),
+        grid=(s // sb,),
+        in_specs=[_mat_spec(sb, a), _vec_spec(sb, a)],
+        out_specs=(_mat_spec(sb, a), _vec_spec(sb, a)),
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )(p2p, out_power[:, None, :])
+    return new, mean[:, 0, :]
 
 
 @jax.jit

@@ -17,6 +17,7 @@ from p2pmicrogrid_tpu.ops.market import clear_market, divide_power, zero_diagona
 from p2pmicrogrid_tpu.ops.pallas_market import (
     clear_market_fused,
     divide_power_fused,
+    divide_power_fused_with_mean,
     prep_mean,
 )
 from p2pmicrogrid_tpu.parallel import (
@@ -62,6 +63,15 @@ def test_divide_power_matches_reference(p2p, out_power):
     ref = jax.vmap(divide_power)(out_power, powers)
     got = divide_power_fused(p2p, out_power)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-3)
+
+
+def test_divide_with_mean_matches_composition(p2p, out_power):
+    """divide_power_fused_with_mean == (divide_power_fused, prep_mean of it)."""
+    new_ref = divide_power_fused(p2p, out_power)
+    mean_ref = prep_mean(new_ref)
+    new, mean = divide_power_fused_with_mean(p2p, out_power)
+    np.testing.assert_allclose(np.asarray(new), np.asarray(new_ref), rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_ref), rtol=1e-5, atol=1e-3)
 
 
 def test_clear_market_matches_reference(p2p):
