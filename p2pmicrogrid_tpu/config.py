@@ -226,6 +226,12 @@ class SimConfig:
     # training) and a zero p2p signal. True = replicate; False = use the
     # advanced temperature.
     stale_next_temp: bool = True
+    # Storage dtype for the [S, A, A] negotiation/market proposal matrices in
+    # the scenario-batched Pallas path. The matrices dominate HBM traffic at
+    # large A; "bfloat16" halves it (~0.4% relative precision on Watt-scale
+    # proposals — compute stays f32 in VMEM, only the carried matrix is
+    # compressed). Default keeps full precision.
+    market_dtype: str = "float32"
     # lax.scan unroll factor for the 96-slot episode scan. Small communities
     # are bound by per-scan-iteration kernel overheads (~0.1-0.4 ms/slot on
     # TPU), which unrolling amortizes; large batched configs are

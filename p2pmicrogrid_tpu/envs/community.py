@@ -495,8 +495,20 @@ def slot_dynamics_batched(
 
     if cfg.sim.trading:
         keys = jax.random.split(key, cfg.sim.rounds + 1)
+        # The carried proposal matrix may be stored compressed (bf16) in the
+        # Pallas path — compute stays f32 inside the kernels.
+        if cfg.sim.market_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"market_dtype must be 'float32' or 'bfloat16', "
+                f"got {cfg.sim.market_dtype!r}"
+            )
+        mdt = (
+            jnp.bfloat16
+            if (use_pallas and cfg.sim.market_dtype == "bfloat16")
+            else jnp.float32
+        )
         init = (
-            jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1])),
+            jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1]), dtype=mdt),
             jnp.zeros_like(balance_w),  # zero matrix -> zero mean
             phys_s.hp_frac,
             explore_state,

@@ -52,6 +52,9 @@ def _interpret() -> bool:
 
 
 def _block(s: int, a: int) -> int:
+    # Slab accounting stays at 4 bytes regardless of the stored dtype: the
+    # kernels cast to f32 on entry, so VMEM temporaries are f32 even for a
+    # bf16-carried matrix.
     slab = max(a * a * 4, 1)
     b = max(1, min(_MAX_BLOCK_S, s, _VMEM_BUDGET // (_SLABS * slab)))
     while s % b:
@@ -71,17 +74,20 @@ def _prep_mean_kernel(p2p_ref, out_ref):
     mean_j(-p2p[s, j, i]) over the diag-zeroed matrix = -(column sum)/A, a
     contiguous reduce over rows — no transpose needed.
     """
-    p2p = p2p_ref[:]  # [SB, A, A]
+    p2p = p2p_ref[:].astype(jnp.float32)  # [SB, A, A]
     a = p2p.shape[-1]
     p2p = p2p * _diag_mask(a)[None, :, :]
-    out_ref[:] = -jnp.sum(p2p, axis=1, keepdims=True) / a
+    out_ref[:] = (-jnp.sum(p2p, axis=1, keepdims=True) / a).astype(out_ref.dtype)
 
 
 def _divide_core(p2p, out):
     """The proposal split (agent.py:186-195) on VMEM-resident blocks:
-    p2p [SB, A, A], out [SB, A] -> (new proposals [SB, A, A], diag mask).
-    Single source of the divide semantics for both divide kernels."""
+    p2p [SB, A, A], out [SB, A] -> (new proposals [SB, A, A] f32, diag mask).
+    Single source of the divide semantics for both divide kernels. Compute is
+    always f32 in VMEM even when the carried matrix is bf16
+    (SimConfig.market_dtype)."""
     a = p2p.shape[-1]
+    p2p = p2p.astype(jnp.float32)
     mask = _diag_mask(a)[None, :, :]
     p2p = p2p * mask
     powers = -jnp.swapaxes(p2p, -1, -2)  # powers[s, i, j]
@@ -102,7 +108,7 @@ def _divide_core(p2p, out):
 def _divide_kernel(p2p_ref, out_power_ref, new_ref):
     """Row i of new = divide_power(out_power[i], -diagzero(p2p)[:, i])."""
     new, _ = _divide_core(p2p_ref[:], out_power_ref[:][:, 0, :])
-    new_ref[:] = new
+    new_ref[:] = new.astype(new_ref.dtype)
 
 
 def _divide_mean_kernel(p2p_ref, out_power_ref, new_ref, mean_ref):
@@ -113,8 +119,8 @@ def _divide_mean_kernel(p2p_ref, out_power_ref, new_ref, mean_ref):
     A=1000)."""
     p2p = p2p_ref[:]  # [SB, A, A]
     new, mask = _divide_core(p2p, out_power_ref[:][:, 0, :])
-    new_ref[:] = new
-    mean_ref[:] = -jnp.sum(new * mask, axis=1, keepdims=True) / p2p.shape[-1]
+    new_ref[:] = new.astype(new_ref.dtype)
+    mean_ref[:] = (-jnp.sum(new * mask, axis=1, keepdims=True) / p2p.shape[-1]).astype(mean_ref.dtype)
 
 
 def _clear_kernel(p2p_ref, grid_ref, peer_ref):
@@ -123,7 +129,7 @@ def _clear_kernel(p2p_ref, grid_ref, peer_ref):
     The sign-opposition mask is symmetric, so ``|p_match|^T`` equals the
     mask applied to ``p2p^T`` — one VMEM transpose serves both operands.
     """
-    p2p = p2p_ref[:]  # [SB, A, A]
+    p2p = p2p_ref[:].astype(jnp.float32)  # [SB, A, A]
     p2p_t = jnp.swapaxes(p2p, -1, -2)
     opp = jnp.sign(p2p) != jnp.sign(p2p_t)
     p_match = jnp.where(opp, p2p, 0.0)
@@ -131,8 +137,8 @@ def _clear_kernel(p2p_ref, grid_ref, peer_ref):
     exchange = jnp.sign(p_match) * jnp.minimum(
         jnp.abs(p_match), jnp.abs(p_match_t)
     )
-    grid_ref[:] = jnp.sum(p2p - exchange, axis=-1, keepdims=True).swapaxes(1, 2)
-    peer_ref[:] = jnp.sum(exchange, axis=-1, keepdims=True).swapaxes(1, 2)
+    grid_ref[:] = jnp.sum(p2p - exchange, axis=-1, keepdims=True).swapaxes(1, 2).astype(grid_ref.dtype)
+    peer_ref[:] = jnp.sum(exchange, axis=-1, keepdims=True).swapaxes(1, 2).astype(peer_ref.dtype)
 
 
 def _compiler_params():
@@ -156,7 +162,7 @@ def prep_mean(p2p: jnp.ndarray) -> jnp.ndarray:
     sb = _block(s, a)
     out = pl.pallas_call(
         _prep_mean_kernel,
-        out_shape=jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
+        out_shape=jax.ShapeDtypeStruct((s, 1, a), jnp.float32),
         grid=(s // sb,),
         in_specs=[_mat_spec(sb, a)],
         out_specs=_vec_spec(sb, a),
@@ -198,7 +204,7 @@ def divide_power_fused_with_mean(
         _divide_mean_kernel,
         out_shape=(
             jax.ShapeDtypeStruct((s, a, a), p2p.dtype),
-            jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
+            jax.ShapeDtypeStruct((s, 1, a), jnp.float32),
         ),
         grid=(s // sb,),
         in_specs=[_mat_spec(sb, a), _vec_spec(sb, a)],
@@ -217,8 +223,8 @@ def clear_market_fused(p2p: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     grid_o, peer_o = pl.pallas_call(
         _clear_kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
-            jax.ShapeDtypeStruct((s, 1, a), p2p.dtype),
+            jax.ShapeDtypeStruct((s, 1, a), jnp.float32),
+            jax.ShapeDtypeStruct((s, 1, a), jnp.float32),
         ),
         grid=(s // sb,),
         in_specs=[_mat_spec(sb, a)],

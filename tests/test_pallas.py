@@ -104,3 +104,29 @@ def test_shared_episode_pallas_parity():
 
     np.testing.assert_allclose(results[True][0], results[False][0], rtol=1e-4)
     np.testing.assert_allclose(results[True][1], results[False][1], rtol=1e-4, atol=1e-7)
+
+
+def test_bf16_market_storage_close_to_f32():
+    """market_dtype='bfloat16' compresses only the carried proposal matrix
+    (compute stays f32 in VMEM): episode rewards must track the f32 path to
+    bf16 precision (~0.5% on Watt-scale proposals)."""
+    rewards = {}
+    for mdt in ("float32", "bfloat16"):
+        cfg = default_config(
+            sim=SimConfig(
+                n_agents=3, n_scenarios=S, use_pallas=True, market_dtype=mdt
+            ),
+            train=TrainConfig(implementation="tabular"),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        traces = make_scenario_traces(cfg)
+        arrays = stack_scenario_arrays(cfg, traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(1))
+        _, _, r, _, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(0), n_episodes=1
+        )
+        rewards[mdt] = np.asarray(r)
+    np.testing.assert_allclose(
+        rewards["bfloat16"], rewards["float32"], rtol=0.02, atol=0.5
+    )
