@@ -384,6 +384,29 @@ def bench_scale() -> dict:
     }
 
 
+def converged_episode(
+    prices: np.ndarray, window: int, band_abs: float = 0.002, band_rel: float = 0.02
+) -> int:
+    """First episode whose ``window``-smoothed price is within the tolerance
+    band of the FINAL smoothed price and stays there for the rest of the run.
+
+    Band = max(band_abs EUR/kWh, band_rel * |final|). Returns the episode
+    index (the right edge of the window); ``len(prices)`` when the series
+    never settles.
+    """
+    prices = np.asarray(prices, dtype=float)
+    if window < 1 or window > prices.shape[0]:
+        raise ValueError(
+            f"window {window} out of range for {prices.shape[0]} episodes"
+        )
+    ma = np.convolve(prices, np.ones(window) / window, mode="valid")
+    final = float(ma[-1])
+    band = max(band_abs, band_rel * abs(final))
+    ok = np.abs(ma - final) <= band
+    converged_ma = next((i for i in range(len(ma)) if ok[i:].all()), len(ma))
+    return converged_ma + window - 1
+
+
 def bench_convergence() -> dict:
     """Episodes until the trade-weighted mean P2P price converges (the second
     BASELINE metric). Price formation: midpoint of buy/injection
@@ -446,12 +469,7 @@ def bench_convergence() -> dict:
         ps, p = price_block(ps, b, k)
         prices[b:b + block] = np.asarray(p)
 
-    ma = np.convolve(prices, np.ones(criterion) / criterion, mode="valid")
-    final = float(ma[-1])
-    band = max(0.002, 0.02 * abs(final))  # EUR/kWh
-    ok = np.abs(ma - final) <= band
-    converged_ma = next((i for i in range(len(ma)) if ok[i:].all()), len(ma))
-    converged_ep = converged_ma + criterion - 1
+    converged_ep = converged_episode(prices, criterion)
     return {
         "metric": "episodes_to_converged_mean_price_2agent_tabular",
         "value": int(converged_ep),

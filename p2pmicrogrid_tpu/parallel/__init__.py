@@ -15,9 +15,12 @@ axes demanded by BASELINE.md are first-class:
 """
 
 from p2pmicrogrid_tpu.parallel.mesh import (
+    hybrid_scenario_sharding,
+    make_hybrid_mesh,
     make_mesh,
     scenario_sharding,
     replicated_sharding,
+    shard_scen_state,
 )
 from p2pmicrogrid_tpu.parallel.scenarios import (
     DDPGScenState,
@@ -29,7 +32,10 @@ from p2pmicrogrid_tpu.parallel.scenarios import (
 )
 
 __all__ = [
+    "hybrid_scenario_sharding",
+    "make_hybrid_mesh",
     "make_mesh",
+    "shard_scen_state",
     "scenario_sharding",
     "replicated_sharding",
     "DDPGScenState",

@@ -1,0 +1,43 @@
+"""Unit tests for the benchmark suite's pure logic (the measured benches
+themselves run on real hardware via bench.py, not under pytest)."""
+
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.benchmarks import BENCHES, converged_episode
+
+
+class TestConvergedEpisode:
+    def test_constant_series_converges_at_window_edge(self):
+        prices = np.full(200, 0.10)
+        assert converged_episode(prices, window=50) == 49
+
+    def test_step_series_converges_after_step_washes_out(self):
+        # 0.08 for 100 episodes, then 0.10: the 50-window mean re-enters the
+        # band once the window no longer straddles the step.
+        prices = np.concatenate([np.full(100, 0.08), np.full(100, 0.10)])
+        ep = converged_episode(prices, window=50)
+        assert 100 < ep < 160
+
+    def test_ramping_series_converges_only_at_the_end(self):
+        # A steady drift keeps the windowed price outside the (tiny) band of
+        # the final value until the last stretch of the run.
+        prices = np.linspace(0.05, 0.30, 200)
+        ep = converged_episode(prices, window=10, band_abs=1e-4, band_rel=1e-4)
+        assert ep > 190
+
+    def test_band_scales_with_final_price(self):
+        # A 1.5% drift around a large final price sits inside the 2% relative
+        # band even though it exceeds the absolute one.
+        prices = np.concatenate([np.full(100, 1.0 * 0.985), np.full(100, 1.0)])
+        assert converged_episode(prices, window=10, band_abs=1e-6) == 9
+
+
+def test_bench_registry_has_all_configs_and_headline_last():
+    names = list(BENCHES)
+    assert {"cfg1", "cfg2", "cfg3", "cfg4", "cfg5", "convergence", "scale"} <= set(
+        names
+    )
+    # The driver parses the LAST printed JSON line: the north star must print
+    # last.
+    assert names[-1] == "cfg4"

@@ -201,6 +201,50 @@ class TestSharedDDPG:
         )
 
 
+class TestHybridMesh:
+    """Multi-host (DCN x ICI) mesh shape, exercised as 2 virtual hosts x 4
+    chips on the CPU mesh — the same sharded program a pod would run."""
+
+    def test_hybrid_mesh_shape_and_sharding(self, setup):
+        from p2pmicrogrid_tpu.parallel.mesh import (
+            hybrid_scenario_sharding,
+            make_hybrid_mesh,
+        )
+
+        mesh = make_hybrid_mesh(dcn_size=2)
+        assert mesh.devices.shape == (2, 4)
+        sh = hybrid_scenario_sharding(mesh)
+        x = jax.device_put(jnp.arange(16.0).reshape(8, 2), sh)
+        # The leading axis splits over all 8 devices (hosts x chips).
+        assert len(x.sharding.device_set) == 8
+
+    def test_shared_training_on_hybrid_mesh_matches_1d(self, setup):
+        from p2pmicrogrid_tpu.parallel.mesh import (
+            hybrid_scenario_sharding,
+            make_hybrid_mesh,
+        )
+
+        cfg, ratings, arrays = setup
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+
+        mesh = make_hybrid_mesh(dcn_size=2)
+        sh = hybrid_scenario_sharding(mesh)
+        arrays_h = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), arrays
+        )
+        ps_h, _, r_h, _, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays_h, ratings, jax.random.PRNGKey(0), n_episodes=1
+        )
+        ps_1, _, r_1, _, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(0), n_episodes=1
+        )
+        np.testing.assert_allclose(r_h, r_1, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ps_h.q_table), np.asarray(ps_1.q_table), rtol=1e-5
+        )
+
+
 def test_shared_tabular_reports_real_td_error(setup):
     # The shared-tabular update must report the agent-mean squared TD error
     # per scenario, not zeros (round-1 VERDICT weak #5).
