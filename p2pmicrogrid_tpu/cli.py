@@ -29,6 +29,7 @@ import numpy as np
 def _build_cfg(args) -> "ExperimentConfig":
     from p2pmicrogrid_tpu.config import (
         BatteryConfig,
+        DDPGConfig,
         SimConfig,
         TrainConfig,
         default_config,
@@ -41,8 +42,12 @@ def _build_cfg(args) -> "ExperimentConfig":
             homogeneous=args.homogeneous,
             n_scenarios=getattr(args, "scenarios", 1),
             trading=not getattr(args, "no_trading", False),
+            market_dtype=getattr(args, "market_dtype", "float32"),
         ),
         battery=BatteryConfig(enabled=args.battery),
+        ddpg=DDPGConfig(
+            share_across_agents=getattr(args, "share_agents", False)
+        ),
         train=TrainConfig(
             max_episodes=args.episodes,
             implementation=args.implementation,
@@ -390,11 +395,31 @@ def _restore_eval_state(args, cfg, key):
     ckpt_dir = checkpoint_dir(args.model_dir, setting, impl)
     if args.shared:
         if impl == "ddpg":
+            import jax.numpy as jnp
+
             from p2pmicrogrid_tpu.models.ddpg import ddpg_params_init
 
             params, episode = restore_checkpoint(
                 ckpt_dir, ddpg_params_init(cfg.ddpg, cfg.sim.n_agents, key)
             )
+            if cfg.ddpg.share_across_agents:
+                # One community-shared actor-critic: broadcast it onto the
+                # per-agent axis the evaluation policy vmaps over. Optimizer
+                # states stay the template's (unused at eval).
+                A = cfg.sim.n_agents
+                bc = lambda t: jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x, (A,) + x.shape), t
+                )
+                return (
+                    template._replace(
+                        actor=bc(params.actor),
+                        critic=bc(params.critic),
+                        actor_target=bc(params.actor_target),
+                        critic_target=bc(params.critic_target),
+                    ),
+                    episode,
+                    ckpt_dir,
+                )
             return template._replace(**params._asdict()), episode, ckpt_dir
         pol_state, episode = restore_checkpoint(ckpt_dir, template)
         return pol_state, episode, ckpt_dir
@@ -795,6 +820,14 @@ def main(argv=None) -> int:
     p.add_argument("--shared", action="store_true",
                    help="with --scenarios: one shared learner with per-slot "
                         "scenario-averaged updates (default: S independent)")
+    p.add_argument("--share-agents", action="store_true", dest="share_agents",
+                   help="ddpg + --shared: ONE actor-critic for the whole "
+                        "community (shared-critic MARL) instead of per-agent "
+                        "copies")
+    p.add_argument("--market-dtype", choices=["float32", "bfloat16"],
+                   default="float32", dest="market_dtype",
+                   help="storage dtype of the batched negotiation matrices "
+                        "(bfloat16 halves their HBM traffic; compute stays f32)")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint for this setting and "
                         "continue the episode/decay schedule from there")
@@ -818,6 +851,11 @@ def main(argv=None) -> int:
                    help="locate the checkpoint of a --scenarios N training run")
     p.add_argument("--shared", action="store_true",
                    help="the checkpoint came from --shared training")
+    p.add_argument("--share-agents", action="store_true", dest="share_agents",
+                   help="the checkpoint came from --share-agents training")
+    p.add_argument("--market-dtype", choices=["float32", "bfloat16"],
+                   default="float32", dest="market_dtype",
+                   help=argparse.SUPPRESS)
     p.add_argument("--scenario-index", type=int, default=0, dest="scenario_index",
                    help="which learner to evaluate from an independent-mode "
                         "(non --shared) scenario checkpoint")

@@ -68,6 +68,16 @@ class TestTrainResume:
         assert main(["train", *common, "--episodes", "2"]) == 0
         assert main(["eval", *common]) == 0
 
+    def test_share_agents_ddpg_eval_round_trip(self, tmp_path):
+        """One community-shared actor-critic (--share-agents): the eval path
+        must broadcast the single parameter set onto the per-agent axis."""
+        common = [
+            "--agents", "3", "--scenarios", "2", "--shared", "--share-agents",
+            "--implementation", "ddpg", "--model-dir", str(tmp_path / "m"),
+        ]
+        assert main(["train", *common, "--episodes", "2"]) == 0
+        assert main(["eval", *common]) == 0
+
     def test_timing_json_written(self, tmp_path):
         timing = tmp_path / "t.json"
         assert (
