@@ -275,6 +275,15 @@ def _cmd_train_scenarios(args) -> int:
     )
     n_episodes = cfg.train.max_episodes - episode0
     print(f"setting: {setting} ({cfg.train.implementation}, S={S})")
+    if args.shared and cfg.train.implementation == "dqn":
+        # Replay warmup before gradient steps (the reference's init_buffers,
+        # community.py:125-147 — it runs after load_agents too, :265-267).
+        from p2pmicrogrid_tpu.parallel import warmup_shared_dqn
+
+        key, k_warm = jax.random.split(key)
+        pol_state, scen_state = warmup_shared_dqn(
+            cfg, policy, pol_state, scen_state, arrays, ratings, k_warm
+        )
     with _profile_ctx(args):
         if args.shared:
             pol_state, _, rewards, _, seconds = train_scenarios_shared(

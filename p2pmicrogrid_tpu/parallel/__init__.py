@@ -29,6 +29,7 @@ from p2pmicrogrid_tpu.parallel.scenarios import (
     stack_scenario_arrays,
     train_scenarios_independent,
     train_scenarios_shared,
+    warmup_shared_dqn,
 )
 
 __all__ = [
@@ -44,4 +45,5 @@ __all__ = [
     "stack_scenario_arrays",
     "train_scenarios_independent",
     "train_scenarios_shared",
+    "warmup_shared_dqn",
 ]

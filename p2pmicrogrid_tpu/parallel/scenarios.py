@@ -501,20 +501,27 @@ def make_shared_episode_fn(
     arrays_s: EpisodeArrays,
     ratings: AgentRatings,
     settlement_hook=None,
+    record_only: bool = False,
 ) -> Callable:
     """Jitted: one shared-parameter training episode over S scenarios.
 
     Signature: ((pol_state, scen_state), key) -> ((pol_state, scen_state),
     (rewards [S], losses [S])). ``scen_state`` is None for tabular, a
-    scenario-stacked ReplayState for dqn, a ``DDPGScenState`` for ddpg
-    (build all three with ``init_shared_state``). ``settlement_hook`` is
-    forwarded to ``slot_dynamics_batched`` (inter-community trading).
+    ``LockstepReplay`` for dqn, a ``DDPGScenState`` for ddpg (build all three
+    with ``init_shared_state``). ``settlement_hook`` is forwarded to
+    ``slot_dynamics_batched`` (inter-community trading).
+
+    ``record_only=True`` (dqn only) builds the replay-warmup episode: act +
+    record transitions, no parameter updates — the scenario-batched
+    counterpart of the reference's ``init_buffers`` (community.py:125-147).
     """
     impl = cfg.train.implementation
     if impl not in ("tabular", "dqn", "ddpg"):
         raise ValueError(
             f"shared-scenario training supports tabular/dqn/ddpg, got {impl!r}"
         )
+    if record_only and impl != "dqn":
+        raise ValueError("record_only warmup applies to dqn only")
     n_scenarios = arrays_s.time.shape[0]
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
 
@@ -539,10 +546,17 @@ def make_shared_episode_fn(
         if impl == "tabular":
             pol_state, loss = _tabular_update_shared(cfg, pol_state, tr_s, k_learn)
         elif impl == "dqn":
-            pol_state, scen_state, loss = _dqn_update_shared(
-                cfg, pol_state, scen_state, tr_s, k_learn
-            )
-            loss = jnp.full((n_scenarios,), jnp.mean(loss))
+            if record_only:
+                act_frac = ACTION_VALUES[tr_s.aux.astype(jnp.int32)][..., None]
+                scen_state = lockstep_replay_add(
+                    scen_state, tr_s.obs, act_frac, tr_s.reward, tr_s.next_obs
+                )
+                loss = jnp.zeros((n_scenarios,))
+            else:
+                pol_state, scen_state, loss = _dqn_update_shared(
+                    cfg, pol_state, scen_state, tr_s, k_learn
+                )
+                loss = jnp.full((n_scenarios,), jnp.mean(loss))
         else:
             scen_state = scen_state._replace(ou=ex)
             pol_state, scen_state, loss = _ddpg_update_shared(
@@ -581,6 +595,30 @@ def make_shared_episode_fn(
         )
 
     return episode
+
+
+def warmup_shared_dqn(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state: DQNState,
+    scen_state,
+    arrays_s: EpisodeArrays,
+    ratings: AgentRatings,
+    key: jax.Array,
+) -> Tuple[DQNState, object]:
+    """Scenario-batched DQN replay warmup (the reference's ``init_buffers``,
+    community.py:125-147): ``warmup_passes`` record-only epsilon-greedy
+    episodes, then a hard online -> target copy."""
+    from p2pmicrogrid_tpu.models.dqn import dqn_initialize_target
+
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, arrays_s, ratings, record_only=True
+    )
+    carry = (pol_state, scen_state)
+    for k in jax.random.split(key, cfg.dqn.warmup_passes):
+        carry, _ = episode_fn(carry, k)
+    pol_state, scen_state = carry
+    return dqn_initialize_target(pol_state), scen_state
 
 
 def train_scenarios_shared(

@@ -245,6 +245,35 @@ class TestHybridMesh:
         )
 
 
+def test_shared_dqn_warmup_records_without_learning(setup):
+    """warmup_shared_dqn (the reference's init_buffers, community.py:125-147):
+    fills the lockstep replay, leaves online params untouched, hard-copies
+    online -> target."""
+    from p2pmicrogrid_tpu.parallel import init_shared_state, warmup_shared_dqn
+
+    cfg, ratings, arrays = setup
+    cfg = cfg.replace(
+        train=TrainConfig(implementation="dqn"),
+        dqn=DQNConfig(buffer_size=128, batch_size=8, warmup_passes=2),
+    )
+    policy = make_policy(cfg)
+    ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+    ps2, scen2 = warmup_shared_dqn(
+        cfg, policy, ps, scen, arrays, ratings, jax.random.PRNGKey(1)
+    )
+    # Two record-only passes over the 96-slot day.
+    assert int(np.asarray(scen2.count)) == 128  # capped at buffer size
+    np.testing.assert_array_equal(
+        np.asarray(ps2.online["Dense_0"]["kernel"]),
+        np.asarray(ps.online["Dense_0"]["kernel"]),
+    )
+    # Hard target copy.
+    np.testing.assert_array_equal(
+        np.asarray(ps2.target["Dense_0"]["kernel"]),
+        np.asarray(ps2.online["Dense_0"]["kernel"]),
+    )
+
+
 def test_shared_tabular_reports_real_td_error(setup):
     # The shared-tabular update must report the agent-mean squared TD error
     # per scenario, not zeros (round-1 VERDICT weak #5).
