@@ -470,7 +470,7 @@ def init_shared_state(
     """(pol_state, scen_state) for ``train_scenarios_shared``:
 
     * tabular -> (TabularState, None)
-    * dqn     -> (DQNState, scenario-stacked ReplayState)
+    * dqn     -> (DQNState, LockstepReplay)
     * ddpg    -> (DDPGParams, DDPGScenState)
     """
     from p2pmicrogrid_tpu.train.policies import init_policy_state

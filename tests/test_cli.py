@@ -95,6 +95,29 @@ class TestTrainResume:
         assert data["2-multi-agent-com-rounds-1-hetero"]["train"] > 0
 
 
+class TestSweep:
+    def test_ddpg_sweep_logs_trials(self, tmp_path):
+        """DDPG hyperparameter sweep (the reference's commented-out harness,
+        rl.py:553-652): one-point grid, results into hyperparameters_single_day."""
+        db = str(tmp_path / "s.db")
+        assert (
+            main(
+                [
+                    "sweep", "--agents", "1", "--episodes", "2",
+                    "--actor-lrs", "1e-4", "--taus", "0.005",
+                    "--ou-sigmas", "0.1", "--results-db", db,
+                    "--model-dir", str(tmp_path / "m"),
+                ]
+            )
+            == 0
+        )
+        with sqlite3.connect(db) as conn:
+            n = conn.execute(
+                "SELECT COUNT(*) FROM hyperparameters_single_day"
+            ).fetchone()[0]
+        assert n > 0
+
+
 class TestForecast:
     def test_forecast_persists_predictions_and_figure(self, tmp_path):
         """End-to-end forecaster driver (reference ml.main(), ml.py:265-314):
