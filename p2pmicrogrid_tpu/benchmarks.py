@@ -321,7 +321,7 @@ def bench_cfg3() -> dict:
 
     A, S = 50, 256
     cfg = default_config(
-        sim=SimConfig(n_agents=A, n_scenarios=S),
+        sim=SimConfig(n_agents=A, n_scenarios=S, slot_unroll=4),
         battery=BatteryConfig(enabled=True),
         train=TrainConfig(implementation="tabular"),
     )
@@ -388,7 +388,9 @@ def bench_cfg5() -> dict:
 
     C, A = 8, 128
     cfg = default_config(
-        sim=SimConfig(n_agents=A, n_scenarios=C),
+        # 8 communities of [128, 128] matrices leave the chip per-op-overhead
+        # bound; unrolling the slot scan recovers ~23% (measured round 2).
+        sim=SimConfig(n_agents=A, n_scenarios=C, slot_unroll=8),
         train=TrainConfig(implementation="tabular"),
     )
     value = scenario_steps_per_sec(cfg, A, C, multi_community=True)
