@@ -239,6 +239,13 @@ class SimConfig:
     # negotiation scan (rounds+1 <= 3 iterations) is always fully unrolled.
     slot_unroll: int = 1
 
+    def __post_init__(self):
+        if self.market_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"market_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.market_dtype!r}"
+            )
+
     @property
     def slots_per_day(self) -> int:
         return HOURS_PER_DAY * MINUTES_PER_HOUR // self.time_slot_minutes

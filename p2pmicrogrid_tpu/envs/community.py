@@ -428,6 +428,7 @@ def slot_dynamics_batched(
         from p2pmicrogrid_tpu.ops.pallas_market import (
             clear_market_fused,
             divide_power_fused_with_mean,
+            divide_rank1_fused,
         )
 
     buy, inj = grid_prices(cfg.tariff, time_s)  # [S]
@@ -464,24 +465,54 @@ def slot_dynamics_batched(
         out_power = balance_w + hp_frac * th.hp_max_power
         return obs, hp_frac, aux, q, ex, out_power
 
-    if use_pallas:
-        # The fused divide kernel emits its output's prep_mean for free while
-        # the matrix is still in VMEM; the round loop carries it instead of
-        # re-reading [S, A, A] from HBM every round.
-        def round_body(carry, round_key):
-            p2p, mean_raw, hp_frac, ex = carry
+    if cfg.sim.trading and use_pallas:
+        # Pallas path: a Python loop over the (static) round count so the
+        # first rounds specialize. Round 0 always splits against a zero
+        # matrix, making its output exactly rank-1 (out_0/A per row, the
+        # equal-split branch) — so no matrix is materialized for it and its
+        # prep_mean has a closed form; round 1 rebuilds that rank-1 matrix
+        # in VMEM from the [S, A] vector (divide_rank1_fused); later rounds
+        # run the full fused kernel, which emits the next round's mean while
+        # its output is still in VMEM.
+        # market_dtype is validated at config construction (SimConfig).
+        mdt = jnp.bfloat16 if cfg.sim.market_dtype == "bfloat16" else jnp.float32
+        n_rounds = cfg.sim.rounds + 1
+        keys = jax.random.split(key, n_rounds)
+        A = load_w.shape[1]
+        mean_raw = jnp.zeros_like(balance_w)
+        hp_frac, ex = phys_s.hp_frac, explore_state
+        prev_vec, p2p = None, None
+        obs = aux = q = None
+        hp_power_l = []
+        for r in range(n_rounds):
             obs, hp_frac, aux, q, ex, out_power = _round_obs_act(
-                mean_raw / ratings.max_in, hp_frac, round_key, ex
+                mean_raw / ratings.max_in, hp_frac, keys[r], ex
             )
-            p_out, mean_raw = divide_power_fused_with_mean(p2p, out_power)
-            return (p_out, mean_raw, hp_frac, ex), (
-                obs, aux, q, hp_frac * th.hp_max_power,
-            )
-    else:
+            hp_power_l.append(hp_frac * th.hp_max_power)
+            if r == 0:
+                prev_vec = out_power
+                tot = jnp.sum(out_power, axis=-1, keepdims=True)
+                mean_raw = -(tot - out_power) / (A * A)
+            elif prev_vec is not None:
+                p2p, mean_raw = divide_rank1_fused(
+                    prev_vec, out_power, out_dtype=mdt
+                )
+                prev_vec = None
+            else:
+                p2p, mean_raw = divide_power_fused_with_mean(p2p, out_power)
+        explore_state = ex
+        if p2p is None:
+            # rounds == 0: single decision pass; materialize the rank-1 final
+            # matrix for clearing (rare path, not bandwidth-critical).
+            p2p = jnp.broadcast_to(
+                (prev_vec / A)[:, :, None], (n_scenarios, A, A)
+            ).astype(mdt)
+        p_grid, p_p2p = clear_market_fused(p2p)
+        hp_power_r = jnp.stack(hp_power_l)  # [rounds+1, S, A]
+    elif cfg.sim.trading:
 
         def round_body(carry, round_key):
-            p2p, mean_raw, hp_frac, ex = carry  # p2p [S, A, A]
-            del mean_raw  # jnp path recomputes from the carried matrix
+            p2p, hp_frac, ex = carry  # p2p [S, A, A]
             p2p_zd = zero_diagonal(p2p)
             powers = -jnp.swapaxes(p2p_zd, -1, -2)
             p2p_mean = jnp.mean(powers, axis=-1) / ratings.max_in
@@ -489,41 +520,24 @@ def slot_dynamics_batched(
                 p2p_mean, hp_frac, round_key, ex
             )
             p_out = divide_power(out_power, powers)
-            return (p_out, jnp.zeros_like(out_power), hp_frac, ex), (
+            return (p_out, hp_frac, ex), (
                 obs, aux, q, hp_frac * th.hp_max_power,
             )
 
-    if cfg.sim.trading:
         keys = jax.random.split(key, cfg.sim.rounds + 1)
-        # The carried proposal matrix may be stored compressed (bf16) in the
-        # Pallas path — compute stays f32 inside the kernels.
-        if cfg.sim.market_dtype not in ("float32", "bfloat16"):
-            raise ValueError(
-                f"market_dtype must be 'float32' or 'bfloat16', "
-                f"got {cfg.sim.market_dtype!r}"
-            )
-        mdt = (
-            jnp.bfloat16
-            if (use_pallas and cfg.sim.market_dtype == "bfloat16")
-            else jnp.float32
-        )
         init = (
-            jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1]), dtype=mdt),
-            jnp.zeros_like(balance_w),  # zero matrix -> zero mean
+            jnp.zeros((n_scenarios, load_w.shape[1], load_w.shape[1])),
             phys_s.hp_frac,
             explore_state,
         )
-        (p2p, _, hp_frac, explore_state), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
+        (p2p, hp_frac, explore_state), (obs_r, aux_r, q_r, hp_power_r) = jax.lax.scan(
             round_body,
             init,
             keys,
             unroll=cfg.sim.rounds + 1,
         )
         obs, aux, q = obs_r[-1], aux_r[-1], q_r[-1]
-        if use_pallas:
-            p_grid, p_p2p = clear_market_fused(p2p)
-        else:
-            p_grid, p_p2p = clear_market(p2p)
+        p_grid, p_p2p = clear_market(p2p)
     else:
         # No-com community: one decision pass, zero p2p signal, grid-only
         # settlement (mirrors the trading=False branch of slot_dynamics).

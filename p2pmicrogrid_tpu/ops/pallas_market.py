@@ -30,6 +30,7 @@ reference implementation and the default for single-scenario shapes.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Tuple
 
 import jax
@@ -80,18 +81,9 @@ def _prep_mean_kernel(p2p_ref, out_ref):
     out_ref[:] = (-jnp.sum(p2p, axis=1, keepdims=True) / a).astype(out_ref.dtype)
 
 
-def _divide_core(p2p, out):
-    """The proposal split (agent.py:186-195) on VMEM-resident blocks:
-    p2p [SB, A, A], out [SB, A] -> (new proposals [SB, A, A] f32, diag mask).
-    Single source of the divide semantics for both divide kernels. Compute is
-    always f32 in VMEM even when the carried matrix is bf16
-    (SimConfig.market_dtype)."""
-    a = p2p.shape[-1]
-    p2p = p2p.astype(jnp.float32)
-    mask = _diag_mask(a)[None, :, :]
-    p2p = p2p * mask
-    powers = -jnp.swapaxes(p2p, -1, -2)  # powers[s, i, j]
-
+def _split_from_powers(powers, out, a):
+    """divide_power's sign-filtered proportional split (agent.py:186-195)
+    given the already-built ``powers`` [SB, A, A] (f32, diag zeroed)."""
     filtered = jnp.where(
         jnp.sign(out)[..., None] != jnp.sign(powers), powers, 0.0
     )
@@ -99,10 +91,22 @@ def _divide_core(p2p, out):
     safe_total = jnp.where(total > 0.0, total, 1.0)
     proportional = out[..., None] * jnp.abs(filtered) / safe_total
     equal = out[..., None] / a
-    new = jnp.where(
+    return jnp.where(
         total > 0.0, proportional, jnp.broadcast_to(equal, powers.shape)
     )
-    return new, mask
+
+
+def _divide_core(p2p, out):
+    """The proposal split on VMEM-resident blocks: p2p [SB, A, A],
+    out [SB, A] -> (new proposals [SB, A, A] f32, diag mask). Single source of
+    the divide semantics for the divide kernels. Compute is always f32 in
+    VMEM even when the carried matrix is bf16 (SimConfig.market_dtype)."""
+    a = p2p.shape[-1]
+    p2p = p2p.astype(jnp.float32)
+    mask = _diag_mask(a)[None, :, :]
+    p2p = p2p * mask
+    powers = -jnp.swapaxes(p2p, -1, -2)  # powers[s, i, j]
+    return _split_from_powers(powers, out, a), mask
 
 
 def _divide_kernel(p2p_ref, out_power_ref, new_ref):
@@ -121,6 +125,28 @@ def _divide_mean_kernel(p2p_ref, out_power_ref, new_ref, mean_ref):
     new, mask = _divide_core(p2p, out_power_ref[:][:, 0, :])
     new_ref[:] = new.astype(new_ref.dtype)
     mean_ref[:] = (-jnp.sum(new * mask, axis=1, keepdims=True) / p2p.shape[-1]).astype(mean_ref.dtype)
+
+
+def _divide_rank1_kernel(prev_ref, out_power_ref, new_ref, mean_ref):
+    """``_divide_mean_kernel`` specialized to a rank-1 previous matrix.
+
+    The FIRST negotiation round always splits against a zero matrix, so its
+    output is exactly ``p2p_1[s, i, j] = out_0[s, i] / A`` (the equal-split
+    branch, diagonal included). The second round can therefore rebuild
+    ``powers`` in VMEM from the [S, A] vector alone — no [S, A, A] read from
+    HBM, and round 1 itself needs no kernel at all (closed-form mean in the
+    caller)."""
+    prev = prev_ref[:][:, 0, :].astype(jnp.float32)  # [SB, A] = out_0
+    out = out_power_ref[:][:, 0, :]
+    a = prev.shape[-1]
+    mask = _diag_mask(a)[None, :, :]
+    # powers[s, i, j] = -maskdiag(p2p_1)[s, j, i] = -(prev[s, j] / a), j != i
+    powers = (-prev[:, None, :] / a) * mask
+    new = _split_from_powers(powers, out, a)
+    new_ref[:] = new.astype(new_ref.dtype)
+    mean_ref[:] = (-jnp.sum(new * mask, axis=1, keepdims=True) / a).astype(
+        mean_ref.dtype
+    )
 
 
 def _clear_kernel(p2p_ref, grid_ref, peer_ref):
@@ -212,6 +238,34 @@ def divide_power_fused_with_mean(
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )(p2p, out_power[:, None, :])
+    return new, mean[:, 0, :]
+
+
+@partial(jax.jit, static_argnames=("out_dtype",))
+def divide_rank1_fused(
+    prev_out: jnp.ndarray, out_power: jnp.ndarray, out_dtype=jnp.float32
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """First-round shortcut: [S, A] (round-0 powers vector), [S, A] ->
+    (new p2p [S, A, A] in ``out_dtype``, its prep_mean [S, A] f32).
+
+    Equals ``divide_power_fused_with_mean(rank1(prev_out), out_power)`` where
+    ``rank1(v)[s, i, j] = v[s, i] / A`` — without ever materializing the
+    rank-1 matrix in HBM.
+    """
+    s, a = prev_out.shape
+    sb = _block(s, a)
+    new, mean = pl.pallas_call(
+        _divide_rank1_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((s, a, a), out_dtype),
+            jax.ShapeDtypeStruct((s, 1, a), jnp.float32),
+        ),
+        grid=(s // sb,),
+        in_specs=[_vec_spec(sb, a), _vec_spec(sb, a)],
+        out_specs=(_mat_spec(sb, a), _vec_spec(sb, a)),
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )(prev_out[:, None, :], out_power[:, None, :])
     return new, mean[:, 0, :]
 
 

@@ -65,6 +65,20 @@ def test_divide_power_matches_reference(p2p, out_power):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-3)
 
 
+def test_divide_rank1_matches_materialized(out_power):
+    """divide_rank1_fused(v, out) == divide_power_fused_with_mean(rank1(v), out)
+    where rank1(v)[s, i, j] = v[s, i] / A (the first round's exact output)."""
+    from p2pmicrogrid_tpu.ops.pallas_market import divide_rank1_fused
+
+    rng = np.random.default_rng(3)
+    prev = jnp.asarray(rng.standard_normal((S, A)).astype(np.float32) * 1e3)
+    rank1 = jnp.broadcast_to((prev / A)[:, :, None], (S, A, A))
+    new_ref, mean_ref = divide_power_fused_with_mean(rank1, out_power)
+    new, mean = divide_rank1_fused(prev, out_power)
+    np.testing.assert_allclose(np.asarray(new), np.asarray(new_ref), rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_ref), rtol=1e-5, atol=1e-3)
+
+
 def test_divide_with_mean_matches_composition(p2p, out_power):
     """divide_power_fused_with_mean == (divide_power_fused, prep_mean of it)."""
     new_ref = divide_power_fused(p2p, out_power)
@@ -81,12 +95,18 @@ def test_clear_market_matches_reference(p2p):
     np.testing.assert_allclose(np.asarray(got_peer), np.asarray(ref_peer), rtol=1e-5, atol=1e-2)
 
 
-def test_shared_episode_pallas_parity():
-    """Full shared-tabular episode: use_pallas=True == use_pallas=False."""
+@pytest.mark.parametrize("rounds", [0, 1, 2])
+def test_shared_episode_pallas_parity(rounds):
+    """Full shared-tabular episode: use_pallas=True == use_pallas=False, for
+    every structurally distinct round count of the specialized Pallas loop
+    (0 = rank-1 broadcast fallback, 1 = rank-1 kernel, 2 = full fused kernel
+    on the later round)."""
     results = {}
     for use_pallas in (False, True):
         cfg = default_config(
-            sim=SimConfig(n_agents=3, n_scenarios=S, use_pallas=use_pallas),
+            sim=SimConfig(
+                n_agents=3, n_scenarios=S, use_pallas=use_pallas, rounds=rounds
+            ),
             train=TrainConfig(implementation="tabular"),
         )
         ratings = make_ratings(cfg, np.random.default_rng(42))
