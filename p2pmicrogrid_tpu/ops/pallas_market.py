@@ -14,8 +14,17 @@ and the diagonal mask folded in:
   ``-p2p[:, i]`` with the diagonal zeroed (agent.py:203, community.py:76).
 * ``divide_power_fused``   — [S,A,A], [S,A] -> [S,A,A]: the full proposal
   split (agent.py:186-195) against diag-zeroed powers.
+* ``divide_power_fused_with_mean`` — the same, also emitting the NEXT
+  round's ``prep_mean`` while the output matrix is still in VMEM.
+* ``divide_rank1_fused``   — [S,A], [S,A] -> ([S,A,A], [S,A]): the
+  second-round specialization; round 0 always splits against zeros, so its
+  output is the rank-1 matrix ``out_0/A`` and never touches HBM.
 * ``clear_market_fused``   — [S,A,A] -> ([S,A], [S,A]): sign-opposition
   matching + grid/p2p totals (community.py:45-54).
+
+With the default ``rounds=1``, the per-slot HBM matrix traffic is exactly one
+[S, A, A] write (rank-1 divide) + one read (clear); ``SimConfig.market_dtype
+= "bfloat16"`` halves it again (compute stays f32 in VMEM).
 
 Blocking: the [A, A] matrix is always a full-dimension block (legal at any A
 under Mosaic's (8, 128) rule), and the scenario axis is tiled so the handful
