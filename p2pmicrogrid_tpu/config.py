@@ -166,6 +166,12 @@ class DDPGConfig:
     ou_sigma: float = 0.1            # (rl_backup.py:101)
     ou_dt: float = 1e-2              # (rl_backup.py:66)
     ou_init_sd: float = 1.0          # (rl_backup.py:102)
+    # Multiplicative decay of the OU exploration noise applied on the
+    # reference's decay cadence (every min_episodes_criterion episodes, like
+    # the epsilon schedules). OU noise has a nonzero stationary variance, so
+    # without annealing exploration never stops; 1.0 (default) keeps the
+    # original always-on behaviour.
+    noise_decay: float = 1.0
     # Shared-parameter scenario training only (parallel/scenarios.py): one
     # actor-critic shared by ALL agents instead of per-agent copies — the
     # "shared-critic MARL" of BASELINE.md config 4. Per-agent tiny MLPs run

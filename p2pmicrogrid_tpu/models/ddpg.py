@@ -40,6 +40,7 @@ class DDPGState(NamedTuple):
     critic_opt: tuple
     replay: ReplayState
     ou_state: jnp.ndarray  # [A] — current OU noise value per agent
+    noise_scale: jnp.ndarray  # [] — exploration annealing factor
 
 
 class DDPGParams(NamedTuple):
@@ -54,16 +55,23 @@ class DDPGParams(NamedTuple):
     critic_target: dict
     actor_opt: tuple
     critic_opt: tuple
+    noise_scale: jnp.ndarray  # [] — exploration annealing factor
 
 
 def ddpg_init(cfg: DDPGConfig, n_agents: int, key: jax.Array) -> DDPGState:
     key, k_ou = jax.random.split(key)
     p = _params_init_per_agent(cfg, n_agents, key)
     return DDPGState(
-        *p,
+        actor=p.actor,
+        critic=p.critic,
+        actor_target=p.actor_target,
+        critic_target=p.critic_target,
+        actor_opt=p.actor_opt,
+        critic_opt=p.critic_opt,
         replay=replay_init(n_agents, cfg.buffer_size, OBS_DIM, 1),
         # OU noise starts at x0 ~ N(0, ou_init_sd) (rl_backup.py:81,102).
         ou_state=cfg.ou_init_sd * jax.random.normal(k_ou, (n_agents,)),
+        noise_scale=p.noise_scale,
     )
 
 
@@ -101,7 +109,7 @@ def ddpg_act(
 
     if explore:
         ou = _ou_step(cfg, state.ou_state, key)
-        a = jnp.clip(a + ou, 0.0, 1.0)
+        a = jnp.clip(a + state.noise_scale * ou, 0.0, 1.0)
         state = state._replace(ou_state=ou)
     return a, q, state
 
@@ -186,6 +194,7 @@ def _params_init_per_agent(
         critic_target=copy(pc),
         actor_opt=a_opt,
         critic_opt=c_opt,
+        noise_scale=jnp.asarray(1.0, dtype=jnp.float32),
     )
 
 
@@ -240,7 +249,7 @@ def ddpg_shared_act(
     if not explore:
         return a, q, ou_s
     ou_s = _ou_step(cfg, ou_s, key)
-    return jnp.clip(a + ou_s, 0.0, 1.0), q, ou_s
+    return jnp.clip(a + params.noise_scale * ou_s, 0.0, 1.0), q, ou_s
 
 
 def ddpg_update(
@@ -289,6 +298,9 @@ def ddpg_update(
     )
 
 
-def ddpg_decay(cfg: DDPGConfig, state: DDPGState) -> DDPGState:
-    """OU noise has its own decay-free schedule; kept for interface parity."""
-    return state
+def ddpg_decay(cfg: DDPGConfig, state) -> "DDPGState":
+    """Anneal the OU exploration noise on the reference's decay cadence
+    (community.py:279-287). With the default ``noise_decay=1.0`` this is a
+    no-op (the OU process alone never stops exploring — nonzero stationary
+    variance). Accepts both DDPGState and the shared trainer's DDPGParams."""
+    return state._replace(noise_scale=state.noise_scale * cfg.noise_decay)

@@ -453,7 +453,7 @@ def _ddpg_update_shared(
         )
         loss = jnp.mean(loss)
 
-    new_params = DDPGParams(
+    new_params = params._replace(
         actor=pa,
         critic=pc,
         actor_target=pat,

@@ -79,7 +79,15 @@ def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
         "pol_state": jax.tree_util.tree_map(np.asarray, template_pol_state),
         "episode": 0,
     }
-    restored = ckptr.restore(step_path, item=template)
+    try:
+        restored = ckptr.restore(step_path, item=template)
+    except Exception as e:  # orbax raises various types on tree mismatch
+        raise RuntimeError(
+            f"checkpoint {step_path} does not match the current learner state "
+            f"structure (e.g. it was written by an older framework version "
+            f"whose state had different fields); delete it and retrain, or "
+            f"restore with the matching version. Original error: {e}"
+        ) from e
     # Rebuild the original NamedTuple/PyTree structure with restored leaves.
     _, treedef = jax.tree_util.tree_flatten(template_pol_state)
     restored_leaves = jax.tree_util.tree_leaves(restored["pol_state"])

@@ -17,6 +17,7 @@ from p2pmicrogrid_tpu.config import ExperimentConfig
 from p2pmicrogrid_tpu.envs.community import Policy
 from p2pmicrogrid_tpu.models import (
     ddpg_act,
+    ddpg_decay,
     ddpg_init,
     ddpg_update,
     dqn_act,
@@ -75,7 +76,7 @@ def make_ddpg_policy(cfg: ExperimentConfig) -> Policy:
     def learn(pol_state, obs, aux, reward, next_obs, key):
         return ddpg_update(d, pol_state, obs, aux, reward, next_obs, key)
 
-    return Policy(act=act, learn=learn, decay=lambda s: s)
+    return Policy(act=act, learn=learn, decay=lambda s: ddpg_decay(d, s))
 
 
 _FACTORIES = {
