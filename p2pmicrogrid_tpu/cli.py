@@ -103,6 +103,23 @@ def _profile_ctx(args):
 
 
 def cmd_train(args) -> int:
+    if getattr(args, "share_agents", False):
+        # DDPGConfig.share_across_agents only reaches the shared-scenario
+        # trainer's ddpg_params_init; in any other mode the flag would be
+        # silently ignored (per-agent training) — refuse instead.
+        problems = []
+        if args.implementation != "ddpg":
+            problems.append("--implementation ddpg")
+        if getattr(args, "scenarios", 1) <= 1:
+            problems.append("--scenarios N (N > 1)")
+        if not getattr(args, "shared", False):
+            problems.append("--shared")
+        if problems:
+            raise SystemExit(
+                "--share-agents (one community-shared actor-critic) only "
+                "applies to shared-scenario DDPG training; also pass: "
+                + ", ".join(problems)
+            )
     if getattr(args, "scenarios", 1) > 1:
         return _cmd_train_scenarios(args)
 

@@ -379,6 +379,30 @@ def slot_dynamics(
     return phys, pol_state, outputs, transition
 
 
+def resolve_use_pallas(cfg: ExperimentConfig) -> bool:
+    """Resolve ``SimConfig.use_pallas``'s None auto-default.
+
+    Auto: the fused kernels win on TPU (+39% at A=1000, measured) but would
+    run in the slow interpreter on other backends. A bfloat16 market-matrix
+    request only takes effect on the Pallas path (the jnp fallback always
+    carries float32 matrices), so that combination warns instead of silently
+    delivering no HBM saving.
+    """
+    use_pallas = cfg.sim.use_pallas
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if cfg.sim.market_dtype == "bfloat16" and not use_pallas:
+        import warnings
+
+        warnings.warn(
+            "market_dtype='bfloat16' has no effect: the jnp (non-Pallas) "
+            "market path stores float32 matrices. It only applies when "
+            "use_pallas resolves True (TPU backend, or use_pallas=True).",
+            stacklevel=2,
+        )
+    return use_pallas
+
+
 def slot_dynamics_batched(
     cfg: ExperimentConfig,
     policy: Policy,
@@ -419,11 +443,7 @@ def slot_dynamics_batched(
     time_s, t_out_s, load_w, pv_w, next_time_s, next_load_w, next_pv_w = xs
     n_scenarios = load_w.shape[0]
     th = cfg.thermal
-    use_pallas = cfg.sim.use_pallas
-    if use_pallas is None:
-        # Auto: the fused kernels win on TPU (+39% at A=1000, measured) but
-        # would run in the slow interpreter on other backends.
-        use_pallas = jax.default_backend() == "tpu"
+    use_pallas = resolve_use_pallas(cfg)
     if use_pallas:
         from p2pmicrogrid_tpu.ops.pallas_market import (
             clear_market_fused,

@@ -52,6 +52,19 @@ def make_hybrid_mesh(
 
     devices = jax.devices()
     n_hosts = dcn_size if dcn_size is not None else jax.process_count()
+    grid = _hybrid_grid(devices, n_hosts)
+    if grid.ndim != len(axis_names):
+        raise ValueError(
+            f"hybrid mesh grid shape {grid.shape} does not match "
+            f"axis names {axis_names}"
+        )
+    return Mesh(grid, axis_names)
+
+
+def _hybrid_grid(devices: Sequence, n_hosts: int):
+    """The (n_hosts, per_host) device grid behind ``make_hybrid_mesh``."""
+    import numpy as np
+
     if len(devices) % n_hosts:
         raise ValueError(
             f"{len(devices)} devices do not split evenly over {n_hosts} hosts"
@@ -60,17 +73,23 @@ def make_hybrid_mesh(
     try:
         # Topology-aware construction: groups each slice's chips on a
         # physically contiguous ICI axis (jax.devices() ordering alone does
-        # not guarantee that on twisted/multi-slice topologies).
+        # not guarantee that on twisted/multi-slice topologies). The two
+        # shape tuples are multiplied ELEMENTWISE, so both must already be
+        # 2-D: ici (1, per_host) x dcn (n_hosts, 1) -> grid (n_hosts,
+        # per_host) matching axis_names. (A 1-D request here returned a 1-D
+        # grid that Mesh() rejected on every real sliced topology.)
         from jax.experimental import mesh_utils
 
-        grid = mesh_utils.create_hybrid_device_mesh(
-            (per_host,), (n_hosts,), devices=devices
+        grid = np.asarray(
+            mesh_utils.create_hybrid_device_mesh(
+                (1, per_host), (n_hosts, 1), devices=devices
+            )
         )
     except Exception:
         # Single-process virtual meshes (CPU tests) have no slice topology to
         # consult; process-major order makes the plain reshape correct there.
         grid = np.asarray(devices).reshape(n_hosts, per_host)
-    return Mesh(grid, axis_names)
+    return grid
 
 
 def hybrid_scenario_sharding(mesh: Mesh) -> NamedSharding:

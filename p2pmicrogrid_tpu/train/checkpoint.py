@@ -65,11 +65,74 @@ def latest_checkpoint(path: str) -> Optional[str]:
     return os.path.join(path, max(steps, key=lambda d: int(d.split("_")[1])))
 
 
+def _graft_old_checkpoint(template, raw):
+    """Rebuild ``template``'s structure from a raw orbax tree, filling leaves
+    the checkpoint lacks with the template's init defaults.
+
+    Forward-compatibility path for 0.x field additions (e.g. pre-0.2.0 DDPG
+    checkpoints have no ``noise_scale``): a checkpoint whose tree is a strict
+    SUBSET of the current state restores with the missing leaves at their
+    init values. Returns ``(tree, grafted_paths, extra_keys)`` — any
+    ``extra_keys`` (checkpoint fields the current state doesn't know) mean
+    the file is from a *newer/different* version and must not be grafted.
+    """
+    grafted: list = []
+    extra: list = []
+
+    def walk(tpl, node, path):
+        if node is None:
+            grafted.append(path or "<root>")
+            return tpl
+        fields = getattr(tpl, "_fields", None)
+        if fields is not None:  # NamedTuple: raw form is a field-keyed dict
+            if not isinstance(node, dict):
+                # A leaf where the template has a container is a structural
+                # difference, not an older subset: refuse, don't reset.
+                extra.append(f"{path} is {type(node).__name__}, expected mapping")
+                return tpl
+            extra.extend(f"{path}/{k}" for k in node if k not in fields)
+            return type(tpl)(
+                *(walk(getattr(tpl, f), node.get(f), f"{path}/{f}") for f in fields)
+            )
+        if isinstance(tpl, dict):
+            if not isinstance(node, dict):
+                extra.append(f"{path} is {type(node).__name__}, expected mapping")
+                return tpl
+            extra.extend(f"{path}/{k}" for k in node if k not in tpl)
+            return {k: walk(v, node.get(k), f"{path}/{k}") for k, v in tpl.items()}
+        if isinstance(tpl, (list, tuple)):
+            if not isinstance(node, (list, tuple)):
+                extra.append(f"{path} is {type(node).__name__}, expected sequence")
+                return tpl
+            seq = list(node)
+            if len(seq) > len(tpl):
+                extra.append(f"{path}[{len(tpl)}:{len(seq)}]")
+                seq = seq[: len(tpl)]
+            seq += [None] * (len(tpl) - len(seq))
+            return type(tpl)(
+                walk(t, n, f"{path}[{i}]") for i, (t, n) in enumerate(zip(tpl, seq))
+            )
+        # Leaf: dtype preserved from the template (orbax may widen scalars).
+        if isinstance(node, (dict, list, tuple)):
+            extra.append(f"{path} is a container, expected array leaf")
+            return tpl
+        tpl_arr = np.asarray(tpl)
+        arr = np.asarray(node, dtype=tpl_arr.dtype)
+        if arr.shape != tpl_arr.shape:
+            extra.append(f"{path} shape {arr.shape} != {tpl_arr.shape}")
+        return arr
+
+    return walk(template, raw, ""), grafted, extra
+
+
 def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
     """Restore (pol_state, episode) from the newest step under ``path``.
 
     ``template_pol_state`` provides the PyTree structure/dtypes (e.g. a fresh
-    ``init_policy_state`` result).
+    ``init_policy_state`` result). Checkpoints written by an older framework
+    version whose state is a strict subset of the current one (fields added
+    since, e.g. DDPG ``noise_scale`` in 0.2.0) restore with the missing
+    leaves grafted at their template (init) values, with a warning.
     """
     step_path = latest_checkpoint(path)
     if step_path is None:
@@ -82,12 +145,33 @@ def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
     try:
         restored = ckptr.restore(step_path, item=template)
     except Exception as e:  # orbax raises various types on tree mismatch
-        raise RuntimeError(
-            f"checkpoint {step_path} does not match the current learner state "
-            f"structure (e.g. it was written by an older framework version "
-            f"whose state had different fields); delete it and retrain, or "
-            f"restore with the matching version. Original error: {e}"
-        ) from e
+        try:
+            raw = ckptr.restore(step_path)  # structure-free read
+        except Exception:
+            # Corrupted/partial checkpoint: not even readable without a
+            # template — keep the actionable message.
+            raise RuntimeError(
+                f"checkpoint {step_path} cannot be read (corrupted or "
+                f"partial save?); delete it and retrain. Original error: {e}"
+            ) from e
+        pol_state, grafted, extra = _graft_old_checkpoint(
+            template["pol_state"], raw.get("pol_state")
+        )
+        if extra or not grafted:
+            raise RuntimeError(
+                f"checkpoint {step_path} does not match the current learner "
+                f"state structure and is not an older-version subset "
+                f"(unknown fields: {extra[:5]}); delete it and retrain, or "
+                f"restore with the matching version. Original error: {e}"
+            ) from e
+        import warnings
+
+        warnings.warn(
+            f"checkpoint {step_path} predates fields {grafted}; restored "
+            f"with their init defaults",
+            stacklevel=2,
+        )
+        restored = {"pol_state": pol_state, "episode": raw.get("episode", 0)}
     # Rebuild the original NamedTuple/PyTree structure with restored leaves.
     _, treedef = jax.tree_util.tree_flatten(template_pol_state)
     restored_leaves = jax.tree_util.tree_leaves(restored["pol_state"])

@@ -1,10 +1,22 @@
 """Unit tests for the benchmark suite's pure logic (the measured benches
-themselves run on real hardware via bench.py, not under pytest)."""
+themselves run on real hardware via bench.py, not under pytest) and for its
+resilience to accelerator-backend outages (the round-2 failure mode)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
 
 import numpy as np
 import pytest
 
-from p2pmicrogrid_tpu.benchmarks import BENCHES, converged_episode
+from p2pmicrogrid_tpu.benchmarks import (
+    BENCHES,
+    converged_episode,
+    numpy_reference_steps_per_sec,
+    probe_backend,
+)
 
 
 class TestConvergedEpisode:
@@ -41,3 +53,53 @@ def test_bench_registry_has_all_configs_and_headline_last():
     # The driver parses the LAST printed JSON line: the north star must print
     # last.
     assert names[-1] == "cfg4"
+
+
+def test_numpy_baseline_is_jax_free(monkeypatch):
+    """The baseline must stay measurable with the backend down: it may not
+    dispatch a single JAX op (round-2 BENCH died inside its jnp.asarray)."""
+    import jax
+
+    def boom(*a, **k):
+        raise AssertionError("numpy baseline dispatched a JAX computation")
+
+    monkeypatch.setattr(jax._src.dispatch, "apply_primitive", boom)
+    rate = numpy_reference_steps_per_sec(2, max_slots=4)
+    assert rate > 0
+
+
+def test_probe_backend_kill_switch(monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_BACKEND_FAIL", "1")
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "1")
+    assert probe_backend() is None
+
+
+def test_bench_survives_simulated_backend_outage():
+    """End-to-end rc=0 + parseable final line under a dead accelerator backend
+    (the exact failure that zeroed out BENCH_r02.json)."""
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env.update(
+        BENCH_FORCE_BACKEND_FAIL="1",
+        BENCH_PROBE_ATTEMPTS="1",
+        BENCH_CONFIGS="cfg1",
+    )
+    out = subprocess.run(
+        [sys.executable, str(repo / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert lines, out.stderr[-2000:]
+    rows = [json.loads(l) for l in lines]
+    for row in rows:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(row)
+    final = rows[-1]
+    assert final["value"] > 0
+    # CPU fallback must label honestly: host, not chip, throughput.
+    assert final["unit"] == "env-steps/sec/host"
+    assert final["device"] == "cpu"

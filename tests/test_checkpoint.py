@@ -48,3 +48,50 @@ def test_missing_checkpoint_raises(tmp_path):
     template = init_policy_state(cfg, jax.random.PRNGKey(0))
     with pytest.raises(FileNotFoundError):
         restore_checkpoint(str(tmp_path / "nope"), template)
+
+
+def _save_raw(path, payload):
+    import orbax.checkpoint as ocp
+
+    ocp.PyTreeCheckpointer().save(str(path), payload, force=True)
+
+
+def test_older_subset_checkpoint_grafts_missing_fields(tmp_path):
+    """A pre-0.2.0 DDPG checkpoint (no ``noise_scale``) restores with the
+    missing leaf at its init default instead of refusing outright."""
+    cfg = default_config(
+        sim=SimConfig(n_agents=2), train=TrainConfig(implementation="ddpg")
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    old_form = {f: getattr(ps, f) for f in ps._fields if f != "noise_scale"}
+    old_form = jax.tree_util.tree_map(np.asarray, old_form)
+    _save_raw(tmp_path / "ep_12", {"pol_state": old_form, "episode": 12})
+
+    template = init_policy_state(cfg, jax.random.PRNGKey(99))
+    with pytest.warns(UserWarning, match="noise_scale"):
+        restored, episode = restore_checkpoint(str(tmp_path), template)
+    assert episode == 12
+    # Grafted leaf carries the template's init value...
+    np.testing.assert_array_equal(
+        np.asarray(restored.noise_scale), np.asarray(template.noise_scale)
+    )
+    # ...while every field the old file DID have restores from the file.
+    np.testing.assert_array_equal(
+        np.asarray(restored.ou_state), np.asarray(ps.ou_state)
+    )
+
+
+def test_newer_or_alien_checkpoint_still_raises(tmp_path):
+    """Unknown fields mean a newer/different version: no silent graft."""
+    cfg = default_config(
+        sim=SimConfig(n_agents=2), train=TrainConfig(implementation="ddpg")
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    alien = {
+        f: jax.tree_util.tree_map(np.asarray, v) for f, v in zip(ps._fields, ps)
+    }
+    alien["from_the_future"] = np.ones(3)
+    del alien["noise_scale"]  # force the item-restore mismatch
+    _save_raw(tmp_path / "ep_3", {"pol_state": alien, "episode": 3})
+    with pytest.raises(RuntimeError, match="from_the_future"):
+        restore_checkpoint(str(tmp_path), init_policy_state(cfg, jax.random.PRNGKey(1)))

@@ -160,3 +160,39 @@ class TestMulti:
         assert "multi-3x2-rounds-1" in settings
         ckpt = tmp_path / "m" / "models_tabular" / "multi_3x2_rounds_1"
         assert ckpt.is_dir() and any(ckpt.iterdir())
+
+
+class TestFlagValidation:
+    def test_share_agents_without_shared_ddpg_errors(self, tmp_path):
+        """--share-agents outside shared-scenario DDPG was silently ignored
+        (round-2 ADVICE): it must refuse with an actionable message."""
+        with pytest.raises(SystemExit, match="--shared"):
+            main(
+                [
+                    "train", "--agents", "2", "--episodes", "1",
+                    "--share-agents",
+                    "--implementation", "ddpg",
+                    "--scenarios", "2",
+                    "--model-dir", str(tmp_path / "m"),
+                ]
+            )
+        with pytest.raises(SystemExit, match="--implementation ddpg"):
+            main(
+                [
+                    "train", "--agents", "2", "--episodes", "1",
+                    "--share-agents", "--scenarios", "2", "--shared",
+                    "--model-dir", str(tmp_path / "m"),
+                ]
+            )
+
+    def test_bfloat16_market_without_pallas_warns(self):
+        """market_dtype='bfloat16' off the Pallas path is a silent no-op
+        (round-2 ADVICE): resolving the kernel choice must warn."""
+        from p2pmicrogrid_tpu.config import SimConfig, default_config
+        from p2pmicrogrid_tpu.envs.community import resolve_use_pallas
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=2, market_dtype="bfloat16", use_pallas=False)
+        )
+        with pytest.warns(UserWarning, match="bfloat16"):
+            assert resolve_use_pallas(cfg) is False

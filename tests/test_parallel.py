@@ -218,6 +218,32 @@ class TestHybridMesh:
         # The leading axis splits over all 8 devices (hosts x chips).
         assert len(x.sharding.device_set) == 8
 
+    def test_hybrid_grid_2d_on_sliced_topology(self):
+        """Regression (round-2 ADVICE): on real sliced TPU topologies the
+        topology-aware branch returned a 1-D grid (elementwise product of the
+        1-D shape tuples), so Mesh() raised on exactly the pod path this mesh
+        exists for. The grid request must be 2-D on both axes."""
+        from p2pmicrogrid_tpu.parallel.mesh import _hybrid_grid
+
+        class FakeDev:
+            # The attribute set mesh_utils consults for sliced TPU topologies.
+            platform = "tpu"
+            device_kind = "fake"
+            core_on_chip = 0
+
+            def __init__(self, i, slice_i):
+                self.id = i
+                self.process_index = slice_i
+                self.slice_index = slice_i
+                self.coords = (i % 4, 0, 0)
+
+        devs = [FakeDev(i, i // 4) for i in range(8)]
+        grid = _hybrid_grid(devs, n_hosts=2)
+        assert grid.shape == (2, 4)
+        # Each row = one slice/host: collectives inside a row ride ICI.
+        for row in range(2):
+            assert {d.slice_index for d in grid[row]} == {row}
+
     def test_shared_training_on_hybrid_mesh_matches_1d(self, setup):
         from p2pmicrogrid_tpu.parallel.mesh import (
             hybrid_scenario_sharding,
