@@ -394,6 +394,68 @@ def cmd_multi(args) -> int:
     return 0
 
 
+def _cmd_eval_multi(args) -> int:
+    """Greedy per-day evaluation of a ``multi``-trained checkpoint
+    (inter-community trading, BASELINE config 5): restores the shared
+    learner, runs every (day, community) episode in one device call, and
+    persists per-community rows under ``{setting}-c{c}`` so the analysis
+    layer sees each community as its own setting (the reference's
+    load_and_run applies to every trained setting, community.py:364-412)."""
+    import dataclasses
+
+    import jax
+
+    from p2pmicrogrid_tpu.data import ResultsStore, save_eval_outputs
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.envs.multi_community import evaluate_multi_community
+    from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+    from p2pmicrogrid_tpu.train.checkpoint import checkpoint_dir, restore_checkpoint
+
+    cfg = _build_cfg(args)
+    C = args.communities
+    cfg = cfg.replace(sim=dataclasses.replace(cfg.sim, n_scenarios=C))
+    setting = f"multi-{C}x{cfg.sim.n_agents}-rounds-{cfg.sim.rounds}"
+    impl = cfg.train.implementation
+
+    _, val_traces, test_traces = _load_traces(args)
+    traces = test_traces if args.test else val_traces
+    rng = np.random.default_rng(cfg.train.seed)
+    ratings = make_ratings(cfg, rng)
+    key = jax.random.PRNGKey(cfg.train.seed)
+    policy = make_policy(cfg)
+
+    # The multi checkpoint holds the SHARED learner (init_shared_state's
+    # pol_state): plain Tabular/DQN state, or a bare DDPGParams bundle.
+    if impl == "ddpg":
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_params_init
+
+        template = ddpg_params_init(cfg.ddpg, cfg.sim.n_agents, key)
+    else:
+        template = init_policy_state(cfg, key)
+    ckpt_dir = checkpoint_dir(args.model_dir, setting, impl)
+    pol_state, episode = restore_checkpoint(ckpt_dir, template)
+    print(f"restored {ckpt_dir} at episode {episode}")
+
+    days, outputs, day_arrays = evaluate_multi_community(
+        cfg, policy, pol_state, traces, ratings, key, rng=rng
+    )
+    costs = np.asarray(outputs.cost).sum(axis=(1, 3))  # [D, C]
+    for i, d in enumerate(days.tolist()):
+        per_c = ", ".join(f"c{c}: {v:+.3f}" for c, v in enumerate(costs[i]))
+        print(f"day {d}: community costs {per_c} €")
+
+    if args.results_db:
+        store = ResultsStore(args.results_db)
+        for c in range(C):
+            out_c = jax.tree_util.tree_map(lambda x: x[:, :, c], outputs)
+            arrays_c = jax.tree_util.tree_map(lambda x: x[:, c], day_arrays)
+            save_eval_outputs(
+                store, f"{setting}-c{c}", impl, args.test, days, out_c, arrays_c
+            )
+        print(f"results ({C} communities) -> {args.results_db}")
+    return 0
+
+
 def _restore_eval_state(args, cfg, key):
     """Locate and restore the checkpoint the requested training mode produced.
 
@@ -460,6 +522,9 @@ def _restore_eval_state(args, cfg, key):
 
 
 def cmd_eval(args) -> int:
+    if getattr(args, "communities", 0) > 1:
+        return _cmd_eval_multi(args)
+
     import jax
 
     from p2pmicrogrid_tpu.analysis import analyse_community_output
@@ -756,9 +821,13 @@ def cmd_analyse(args) -> int:
     from p2pmicrogrid_tpu.analysis import (
         plot_cost_comparison,
         plot_cost_vs_community_size,
+        plot_day_traces,
         plot_learning_curves,
         plot_pv_drop_comparison,
+        plot_qtable_heatmap,
+        plot_rounds_decisions,
         plot_scaling,
+        plot_sweep_curves,
         statistical_tests,
     )
     from p2pmicrogrid_tpu.data import ResultsStore
@@ -799,6 +868,59 @@ def cmd_analyse(args) -> int:
                             plot_pv_drop_comparison(results, s, twin),
                             f"{stem}.png",
                         )
+        if not results.empty:
+            # Per-day state/decision traces (data_analysis.py:420-694): one
+            # figure per setting on its first recorded day (all days carry
+            # the same columns; one keeps the figure count bounded).
+            for s in sorted(results["setting"].unique()):
+                day = int(results[results["setting"] == s]["day"].min())
+                save(
+                    plot_day_traces(results, s, day),
+                    f"day_{s}_{day}.png".replace("/", "_"),
+                )
+        rounds = store.get_rounds_decisions()
+        if not rounds.empty:
+            # Round-by-round decision comparison (data_analysis.py:997-1096).
+            for s in sorted(rounds["setting"].unique()):
+                day = int(rounds[rounds["setting"] == s]["day"].min())
+                save(
+                    plot_rounds_decisions(rounds, s, day),
+                    f"rounds_{s}_{day}.png".replace("/", "_"),
+                )
+        sweep = store.get_sweep_data()
+        if not sweep.empty:
+            # Sweep curves (data_analysis.py:1460-1629).
+            save(plot_sweep_curves(sweep), "sweep_curves.png")
+        if getattr(args, "model_dir", None):
+            # Q-table heatmaps (data_analysis.py:1214-1297) for every tabular
+            # checkpoint under --model-dir. Raw (template-free) restore: only
+            # the q_table leaf is needed, so no setting-string parsing.
+            import glob
+            import os.path
+
+            from p2pmicrogrid_tpu.train.checkpoint import latest_checkpoint
+
+            for d in sorted(
+                glob.glob(os.path.join(args.model_dir, "models_tabular", "*"))
+            ):
+                # orbax requires absolute paths (a relative --model-dir would
+                # crash the whole analyse run).
+                step = latest_checkpoint(os.path.abspath(d))
+                if step is None:
+                    continue
+                import orbax.checkpoint as ocp
+
+                raw = ocp.PyTreeCheckpointer().restore(step)
+                qt = raw.get("pol_state", {}).get("q_table")
+                if qt is None:
+                    continue
+                qt = np.asarray(qt)
+                if qt.ndim == 7:  # independent-scenario checkpoint [S, A, ...]
+                    qt = qt[0]
+                save(
+                    plot_qtable_heatmap(qt[0]),
+                    f"qtable_{os.path.basename(d)}.png",
+                )
         if args.timing_json:
             import os.path
 
@@ -885,6 +1007,10 @@ def main(argv=None) -> int:
     p.add_argument("--scenario-index", type=int, default=0, dest="scenario_index",
                    help="which learner to evaluate from an independent-mode "
                         "(non --shared) scenario checkpoint")
+    p.add_argument("--communities", type=int, default=0,
+                   help="evaluate a `multi`-trained checkpoint of this many "
+                        "communities (inter-community trading); persists "
+                        "per-community rows under {setting}-c{i}")
     p.add_argument("--figures-dir")
     p.add_argument("--pv-drop", dest="pv_drop", metavar="AGENT[:START[:FACTOR]]",
                    help="fault-inject one agent's PV production")
@@ -921,6 +1047,9 @@ def main(argv=None) -> int:
     p.add_argument("--timing-json", dest="timing_json",
                    help="per-setting wall-clock JSON (written by train/eval) "
                         "for the scaling figures")
+    p.add_argument("--model-dir",
+                   help="render Q-table heatmaps for every tabular checkpoint "
+                        "found under this directory")
     p.set_defaults(fn=cmd_analyse)
 
     args = parser.parse_args(argv)

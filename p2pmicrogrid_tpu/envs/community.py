@@ -390,7 +390,17 @@ def resolve_use_pallas(cfg: ExperimentConfig) -> bool:
     """
     use_pallas = cfg.sim.use_pallas
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        import os
+
+        # P2P_DISABLE_PALLAS pins the auto choice off. The benchmark suite's
+        # host-CPU retry needs it: ``jax.default_device(cpu)`` places arrays
+        # on the host but ``jax.default_backend()`` still reports "tpu", so
+        # without the override the retry would compile Mosaic TPU kernels for
+        # a CPU-placed program and fail again.
+        if os.environ.get("P2P_DISABLE_PALLAS", "") not in ("", "0"):
+            use_pallas = False
+        else:
+            use_pallas = jax.default_backend() == "tpu"
     if cfg.sim.market_dtype == "bfloat16" and not use_pallas:
         import warnings
 

@@ -28,7 +28,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from p2pmicrogrid_tpu.config import ExperimentConfig
-from p2pmicrogrid_tpu.envs.community import AgentRatings, EpisodeArrays
+from p2pmicrogrid_tpu.envs.community import (
+    AgentRatings,
+    EpisodeArrays,
+    SlotOutputs,
+    build_episode_arrays,
+    draw_rating_scales,
+    init_physical,
+    slot_dynamics_batched,
+)
 from p2pmicrogrid_tpu.ops.market import clear_market
 from p2pmicrogrid_tpu.parallel.scenarios import (
     make_shared_episode_fn,
@@ -139,3 +147,110 @@ def train_multi_community(
         episode0=episode0,
         episode_cb=episode_cb,
     )
+
+
+def evaluate_multi_community(
+    cfg: ExperimentConfig,
+    policy,
+    pol_state,
+    traces,
+    ratings: AgentRatings,
+    key: jax.Array,
+    redraw_profile_scales: bool = True,
+    rng: Optional[np.random.Generator] = None,
+) -> Tuple[np.ndarray, SlotOutputs, EpisodeArrays]:
+    """Greedy per-day evaluation of C communities with inter-community
+    trading — the reference's ``load_and_run`` (community.py:364-412) lifted
+    to BASELINE config 5.
+
+    ``pol_state`` is the shared learner a ``multi`` training run checkpoints
+    (TabularState / DQNState / DDPGParams — see ``init_shared_state``). Each
+    (day, community) redraws its per-agent load/PV profile scales
+    (community.py:386-391; the shared ``max_in``/``max_out`` ratings stay the
+    training ones), which differentiates the communities so residuals
+    actually trade. All D x C episodes run in ONE device call.
+
+    Returns (days, outputs, day_arrays): SlotOutputs leaves are
+    [D, T, C, ...]; day_arrays leaves are [D, C, T, ...].
+    """
+    C = cfg.sim.n_scenarios
+    by_day = traces.split_by_day()
+    days = np.array(sorted(by_day), dtype=np.int32)
+    gen = rng if rng is not None else np.random.default_rng(0)
+
+    day_arrays = []
+    for d in days:
+        day_traces = by_day[int(d)]
+        per_community = []
+        for _ in range(C):
+            r = ratings
+            if redraw_profile_scales:
+                load_r, pv_r = draw_rating_scales(cfg, gen)
+                r = AgentRatings(
+                    load_rating_w=(load_r * 1e3).astype(np.float32),
+                    pv_rating_w=(pv_r * 1e3).astype(np.float32),
+                    max_in=ratings.max_in,
+                    max_out=ratings.max_out,
+                )
+            per_community.append(build_episode_arrays(cfg, day_traces, r))
+        day_arrays.append(
+            jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_community)
+        )
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *day_arrays)
+
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    settle = make_inter_community_settlement(cfg)
+
+    act_fn = None
+    if cfg.train.implementation == "ddpg":
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+
+        def act_fn(params, obs_s, prev_frac_s, round_key, ex):
+            # Greedy: deterministic actor, OU state untouched.
+            frac, q, _ = ddpg_shared_act(
+                cfg.ddpg, params, obs_s, jnp.zeros(obs_s.shape[:2]),
+                round_key, explore=False,
+            )
+            return frac, frac, q, ex
+
+    @jax.jit
+    def eval_all(pol_state, stacked, keys):
+        def one_day(arrays_c, k):
+            k_phys, k_scan = jax.random.split(k)
+            phys_c = jax.vmap(lambda kk: init_physical(cfg, kk))(
+                jax.random.split(k_phys, C)
+            )
+
+            def slot(carry, xs_t):
+                phys_s, kk = carry
+                kk, k_act = jax.random.split(kk)
+                phys_s, _, outputs_s, _, _ = slot_dynamics_batched(
+                    cfg, policy, pol_state, phys_s, xs_t, k_act, ratings_j,
+                    explore=False, settlement_hook=settle, act_fn=act_fn,
+                )
+                return (phys_s, kk), outputs_s
+
+            xs = jax.tree_util.tree_map(
+                lambda x: jnp.swapaxes(x, 0, 1), arrays_c
+            )
+            xs = (
+                xs.time,
+                xs.t_out,
+                xs.load_w,
+                xs.pv_w,
+                xs.next_time,
+                xs.next_load_w,
+                xs.next_pv_w,
+            )
+            (_, _), outputs = jax.lax.scan(
+                slot, (phys_c, k_scan), xs, unroll=cfg.sim.slot_unroll
+            )
+            return outputs  # leaves [T, C, ...]
+
+        return jax.vmap(one_day)(stacked, keys)
+
+    keys = jax.random.split(key, len(days))
+    # stacked rides as an argument — a closure capture would constant-fold
+    # the whole D x C episode-array stack into the compiled program.
+    outputs = eval_all(pol_state, stacked, keys)
+    return days, outputs, stacked

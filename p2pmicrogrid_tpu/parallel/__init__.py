@@ -24,12 +24,18 @@ from p2pmicrogrid_tpu.parallel.mesh import (
 )
 from p2pmicrogrid_tpu.parallel.scenarios import (
     DDPGScenState,
+    init_scen_state_only,
     init_shared_state,
     make_scenario_traces,
     stack_scenario_arrays,
+    train_scenarios_chunked,
     train_scenarios_independent,
     train_scenarios_shared,
     warmup_shared_dqn,
+)
+from p2pmicrogrid_tpu.parallel.device_gen import (
+    device_episode_arrays,
+    device_scenario_traces,
 )
 
 __all__ = [
@@ -40,9 +46,13 @@ __all__ = [
     "scenario_sharding",
     "replicated_sharding",
     "DDPGScenState",
+    "device_episode_arrays",
+    "device_scenario_traces",
+    "init_scen_state_only",
     "init_shared_state",
     "make_scenario_traces",
     "stack_scenario_arrays",
+    "train_scenarios_chunked",
     "train_scenarios_independent",
     "train_scenarios_shared",
     "warmup_shared_dqn",

@@ -1,0 +1,111 @@
+"""On-device Monte-Carlo scenario synthesis.
+
+The host generator (data/traces.py:synthetic_traces) draws one scenario at a
+time in NumPy and ships ~250 MB of episode arrays per 128-scenario chunk
+through the device tunnel. At the 10k-scenario north star that transfer —
+not compute — would dominate the episode, so the chunked trainer
+(scenarios.py:train_scenarios_chunked) synthesizes each chunk's traces
+*inside* the compiled program from a PRNG key: zero host↔device traffic,
+arbitrary aggregate scenario counts, and fresh draws every episode (true
+Monte-Carlo, where the host path reuses one fixed scenario set).
+
+The profile family matches data/traces.py:_daily_profile — October-like
+morning/evening load peaks, a weather-scaled PV bell with cloud flicker, a
+sinusoidal outdoor temperature — with per-scenario max-normalization
+(reference dataset.py:47-49) and the np.roll (state, next_state) pairing
+(dataset.py:98-103). Values are the same family, not bit-identical draws
+(different RNG), which is the point: scenarios are independent draws, not a
+fixed dataset.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.envs.community import AgentRatings, EpisodeArrays
+
+SLOTS_PER_DAY = 96
+
+
+def device_scenario_traces(
+    key: jax.Array, n_scenarios: int, n_profiles: int = 5
+):
+    """One day of synthetic traces for S scenarios, entirely on device.
+
+    Returns (time [T], t_out [S, T], load [S, T, P], pv [S, T]) with load/pv
+    already per-scenario max-normalized to [0, 1] (dataset.py:47-49). The
+    slot grid is shared across scenarios (the invariant
+    stack_scenario_arrays asserts for the host path).
+    """
+    S, P, T = n_scenarios, n_profiles, SLOTS_PER_DAY
+    t = jnp.arange(T, dtype=jnp.float32) / T  # day fraction, shared grid
+
+    k_base, k_lnoise, k_weather, k_phase, k_tmean, k_tswing, k_tnoise = (
+        jax.random.split(key, 7)
+    )
+
+    # Load: base + morning/evening gaussian peaks + noise (traces.py:81-86).
+    base = 0.15 + 0.05 * jax.random.uniform(k_base, (S, 1, P))
+    morning = 0.5 * jnp.exp(-((t - 7.5 / 24) ** 2) / (2 * (1.2 / 24) ** 2))
+    evening = 0.9 * jnp.exp(-((t - 19.0 / 24) ** 2) / (2 * (2.0 / 24) ** 2))
+    noise = 0.08 * jax.random.normal(k_lnoise, (S, T, P))
+    load = jnp.clip(
+        base + (morning + evening)[None, :, None] + noise, 0.02, None
+    )
+    load = load / jnp.maximum(load.max(axis=1, keepdims=True), 1e-6)
+
+    # PV: weather-scaled bell with cloud flicker (traces.py:87-92). One trace
+    # per scenario, replicated per profile downstream (the reference has a
+    # single pv column, dataset.py:29).
+    weather = jax.random.uniform(k_weather, (S, 1), minval=0.3, maxval=1.0)
+    bell = jnp.exp(-((t - 12.75 / 24) ** 2) / (2 * (2.2 / 24) ** 2))
+    phase = jax.random.uniform(k_phase, (S, 1), minval=0.0, maxval=jnp.pi)
+    cloud = 1.0 - 0.3 * jnp.abs(jnp.sin(40 * jnp.pi * t[None, :] + phase))
+    pv = jnp.clip(weather * bell[None, :] * cloud - 0.02, 0.0, None)
+    pv = pv / jnp.maximum(pv.max(axis=1, keepdims=True), 1e-6)
+
+    # Outdoor temperature: sinusoid, min ~3 am / max mid-afternoon
+    # (traces.py:93-97).
+    t_mean = jax.random.uniform(k_tmean, (S, 1), minval=7.0, maxval=12.0)
+    swing = jax.random.uniform(k_tswing, (S, 1), minval=2.0, maxval=5.0)
+    t_out = (
+        t_mean
+        + swing * jnp.sin(2 * jnp.pi * (t[None, :] - 9.0 / 24))
+        + 0.3 * jax.random.normal(k_tnoise, (S, T))
+    )
+    return t, t_out, load, pv
+
+
+def device_episode_arrays(
+    cfg: ExperimentConfig, key: jax.Array, ratings: AgentRatings, n_scenarios: int
+) -> EpisodeArrays:
+    """Scenario-stacked EpisodeArrays ([S, T, ...]) synthesized on device.
+
+    Applies the same agent-profile assignment and rating denormalization as
+    data/traces.py:agent_profiles (agent i uses profile i %% P, scaled by its
+    W rating; community.py:219-224) and the np.roll next-slot pairing.
+    """
+    A = cfg.sim.n_agents
+    t, t_out, load, pv = device_scenario_traces(key, n_scenarios)
+
+    if cfg.sim.homogeneous:
+        idx = jnp.zeros((A,), dtype=jnp.int32)
+    else:
+        idx = jnp.arange(A, dtype=jnp.int32) % load.shape[2]
+    load_w = load[:, :, idx] * jnp.asarray(ratings.load_rating_w)[None, None, :]
+    pv_w = pv[:, :, None] * jnp.asarray(ratings.pv_rating_w)[None, None, :]
+
+    T = t.shape[0]
+    time = jnp.broadcast_to(t[None, :], (n_scenarios, T))
+    roll = lambda x: jnp.roll(x, -1, axis=1)
+    return EpisodeArrays(
+        time=time,
+        t_out=t_out,
+        load_w=load_w,
+        pv_w=pv_w,
+        next_time=roll(time),
+        next_load_w=roll(load_w),
+        next_pv_w=roll(pv_w),
+    )

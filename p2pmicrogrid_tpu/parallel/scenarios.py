@@ -464,6 +464,31 @@ def _ddpg_update_shared(
     return new_params, scen._replace(replay=replay_s), loss
 
 
+def init_scen_state_only(
+    cfg: ExperimentConfig, key: jax.Array, n_scenarios: Optional[int] = None
+):
+    """Just the per-scenario exploration/replay state (no learnable params):
+    None for tabular, a LockstepReplay for dqn, a DDPGScenState for ddpg.
+
+    The chunked trainer seeds a fresh one of these per (episode, chunk) —
+    the shared parameters persist, the chunk's replay/noise do not (its
+    replay covers the chunk's own episode history, as in a fresh community).
+    """
+    S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
+    A = cfg.sim.n_agents
+    impl = cfg.train.implementation
+    if impl == "tabular":
+        return None
+    if impl == "dqn":
+        return lockstep_replay_init(S, A, cfg.dqn.buffer_size, OBS_DIM, 1)
+    if impl == "ddpg":
+        return DDPGScenState(
+            replay=lockstep_replay_init(S, A, cfg.ddpg.buffer_size, OBS_DIM, 1),
+            ou=cfg.ddpg.ou_init_sd * jax.random.normal(key, (S, A)),
+        )
+    raise ValueError(f"unknown implementation {impl!r}")
+
+
 def init_shared_state(
     cfg: ExperimentConfig, key: jax.Array, n_scenarios: Optional[int] = None
 ) -> Tuple[object, object]:
@@ -475,33 +500,30 @@ def init_shared_state(
     """
     from p2pmicrogrid_tpu.train.policies import init_policy_state
 
-    S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
-    A = cfg.sim.n_agents
     impl = cfg.train.implementation
-
-    if impl == "tabular":
-        return init_policy_state(cfg, key), None
-    if impl == "dqn":
-        return init_policy_state(cfg, key), lockstep_replay_init(
-            S, A, cfg.dqn.buffer_size, OBS_DIM, 1
+    if impl in ("tabular", "dqn"):
+        # Replay init is deterministic; key goes to the params as before.
+        return init_policy_state(cfg, key), init_scen_state_only(
+            cfg, key, n_scenarios
         )
     if impl == "ddpg":
         k_params, k_ou = jax.random.split(key)
-        scen = DDPGScenState(
-            replay=lockstep_replay_init(S, A, cfg.ddpg.buffer_size, OBS_DIM, 1),
-            ou=cfg.ddpg.ou_init_sd * jax.random.normal(k_ou, (S, A)),
+        return (
+            ddpg_params_init(cfg.ddpg, cfg.sim.n_agents, k_params),
+            init_scen_state_only(cfg, k_ou, n_scenarios),
         )
-        return ddpg_params_init(cfg.ddpg, A, k_params), scen
     raise ValueError(f"unknown implementation {impl!r}")
 
 
 def make_shared_episode_fn(
     cfg: ExperimentConfig,
     policy: Policy,
-    arrays_s: EpisodeArrays,
+    arrays_s: Optional[EpisodeArrays],
     ratings: AgentRatings,
     settlement_hook=None,
     record_only: bool = False,
+    arrays_fn: Optional[Callable] = None,
+    n_scenarios: Optional[int] = None,
 ) -> Callable:
     """Jitted: one shared-parameter training episode over S scenarios.
 
@@ -510,6 +532,13 @@ def make_shared_episode_fn(
     ``LockstepReplay`` for dqn, a ``DDPGScenState`` for ddpg (build all three
     with ``init_shared_state``). ``settlement_hook`` is forwarded to
     ``slot_dynamics_batched`` (inter-community trading).
+
+    Episode inputs come from ``arrays_s`` (fixed host-built arrays), or —
+    when ``arrays_fn(key) -> EpisodeArrays`` is given instead (with
+    ``n_scenarios``) — are synthesized inside the compiled program per
+    episode (parallel/device_gen.py): fresh Monte-Carlo draws every episode
+    with zero host↔device traffic, the transport that makes the chunked
+    10k-scenario north star feasible over a tunneled device link.
 
     ``record_only=True`` (dqn only) builds the replay-warmup episode: act +
     record transitions, no parameter updates — the scenario-batched
@@ -522,7 +551,12 @@ def make_shared_episode_fn(
         )
     if record_only and impl != "dqn":
         raise ValueError("record_only warmup applies to dqn only")
-    n_scenarios = arrays_s.time.shape[0]
+    if (arrays_s is None) == (arrays_fn is None):
+        raise ValueError("pass exactly one of arrays_s or arrays_fn")
+    if arrays_fn is not None and n_scenarios is None:
+        raise ValueError("arrays_fn requires an explicit n_scenarios")
+    if arrays_s is not None:
+        n_scenarios = arrays_s.time.shape[0]
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
 
     if impl == "ddpg":
@@ -571,11 +605,12 @@ def make_shared_episode_fn(
     @jax.jit
     def episode(carry, key):
         pol_state, scen_state = carry
-        k_phys, k_scan = jax.random.split(key)
+        k_phys, k_scan, k_gen = jax.random.split(key, 3)
         phys_s = jax.vmap(lambda k: init_physical(cfg, k))(
             jax.random.split(k_phys, n_scenarios)
         )
-        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrays_s)
+        arrs = arrays_s if arrays_fn is None else arrays_fn(k_gen)
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrs)
         xs = (
             xs.time,
             xs.t_out,
@@ -659,3 +694,101 @@ def train_scenarios_shared(
     )
     pol_state, scen_state = carry
     return pol_state, scen_state, rewards, losses, seconds
+
+
+# --- chunked aggregate-scenario mode (the 10k north star) --------------------
+
+
+def train_scenarios_chunked(
+    cfg: ExperimentConfig,
+    policy: Policy,
+    pol_state,
+    ratings: AgentRatings,
+    key: jax.Array,
+    n_episodes: int,
+    n_chunks: int,
+    episode_fn: Optional[Callable] = None,
+    episode0: int = 0,
+    chunk_key_fn: Optional[Callable] = None,
+    episode_cb: Optional[Callable] = None,
+) -> Tuple[object, np.ndarray, np.ndarray, float]:
+    """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
+    Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
+
+    Why chunks: at the north-star scale (BASELINE.md: 1000 agents, 10k
+    scenarios) a single S=10k program is impossible — the negotiation/market
+    matrix alone is [S, A, A] (40 TB at f32) and XLA cannot compile the
+    program — so the scenario axis is processed in S-chunk slices that reuse
+    one compiled episode program, each synthesizing its own fresh scenario
+    draw on device (``device_gen``; nothing crosses the host link).
+
+    Update rule (local update + delta averaging): every chunk runs a full
+    per-slot-learning episode from the episode's starting parameters θ₀,
+    yielding θ_c; the applied episode update is θ₀ + mean_c(θ_c − θ₀).
+    For SGD-style updates this IS chunk-gradient accumulation — the summed
+    per-chunk update scaled 1/K — i.e. the scenario-averaged update at the
+    aggregate scenario count; for adaptive optimizers (Adam in DQN/DDPG) it
+    is local-SGD/FedAvg-style parameter-delta averaging, the standard
+    large-batch decomposition when a synchronized step is unbuildable.
+    Per-chunk exploration/replay state is freshly seeded per (episode, chunk)
+    (``init_scen_state_only``) — replay spans the chunk's own episode.
+
+    Returns (pol_state, rewards [episodes, K*S], losses [episodes, K*S],
+    seconds). ``chunk_key_fn(key, episode, chunk) -> key`` overrides the
+    per-chunk seeding (tests use it to collapse chunks onto one draw).
+    """
+    S = cfg.sim.n_scenarios
+    if episode_fn is None:
+        from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+
+        episode_fn = make_shared_episode_fn(
+            cfg,
+            policy,
+            None,
+            ratings,
+            arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S),
+            n_scenarios=S,
+        )
+    if chunk_key_fn is None:
+        chunk_key_fn = lambda k, e, c: jax.random.fold_in(
+            jax.random.fold_in(k, e), c
+        )
+
+    # On-device tree ops so the K-chunk loop dispatches, never transfers.
+    accumulate = jax.jit(
+        lambda acc, new, old: jax.tree_util.tree_map(
+            lambda a, n, o: a + (n - o), acc, new, old
+        )
+    )
+    apply_mean = jax.jit(
+        lambda base, acc: jax.tree_util.tree_map(
+            lambda b, a: (b + a / n_chunks).astype(b.dtype), base, acc
+        )
+    )
+
+    decay_every = cfg.train.min_episodes_criterion
+    rewards, losses = [], []
+    start = _time.time()
+    for e in range(n_episodes):
+        theta0 = pol_state
+        acc = jax.tree_util.tree_map(jnp.zeros_like, theta0)
+        r_parts, l_parts = [], []
+        for c in range(n_chunks):
+            kc = chunk_key_fn(key, episode0 + e, c)
+            k_scen, k_ep = jax.random.split(kc)
+            scen = init_scen_state_only(cfg, k_scen)
+            (theta_c, _), (r, l) = episode_fn((theta0, scen), k_ep)
+            acc = accumulate(acc, theta_c, theta0)
+            r_parts.append(r)
+            l_parts.append(l)
+        pol_state = apply_mean(theta0, acc)
+        if decay_every and (episode0 + e) % decay_every == 0:
+            pol_state = policy.decay(pol_state)
+        r = np.concatenate([np.asarray(x) for x in r_parts])
+        l = np.concatenate([np.asarray(x) for x in l_parts])
+        rewards.append(r)
+        losses.append(l)
+        if episode_cb:
+            episode_cb(episode0 + e, r, l, pol_state)
+    jax.block_until_ready(pol_state)
+    return pol_state, np.stack(rewards), np.stack(losses), _time.time() - start

@@ -154,8 +154,16 @@ def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
                 f"checkpoint {step_path} cannot be read (corrupted or "
                 f"partial save?); delete it and retrain. Original error: {e}"
             ) from e
+        if not isinstance(raw, dict) or "pol_state" not in raw:
+            # A root without pol_state is another tool's checkpoint entirely
+            # — grafting would "restore" a fresh init and call it success.
+            raise RuntimeError(
+                f"checkpoint {step_path} has no 'pol_state' tree (root keys: "
+                f"{sorted(raw) if isinstance(raw, dict) else type(raw).__name__}); "
+                f"not a checkpoint of this framework. Original error: {e}"
+            ) from e
         pol_state, grafted, extra = _graft_old_checkpoint(
-            template["pol_state"], raw.get("pol_state")
+            template["pol_state"], raw["pol_state"]
         )
         if extra or not grafted:
             raise RuntimeError(

@@ -294,7 +294,7 @@ def evaluate_community(
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
 
     @jax.jit
-    def eval_all(pol_state, keys):
+    def eval_all(pol_state, stacked, keys):
         def one_day(arrays, k):
             # Independent keys for the initial temperatures and the episode —
             # greedy eval consumes no episode randomness today, but correlated
@@ -309,5 +309,7 @@ def evaluate_community(
         return jax.vmap(one_day)(stacked, keys)
 
     keys = jax.random.split(key, len(days))
-    outputs = eval_all(pol_state, keys)
+    # stacked as an argument, not a closure capture — capture would
+    # constant-fold the per-day episode arrays into the executable.
+    outputs = eval_all(pol_state, stacked, keys)
     return days, outputs, stacked

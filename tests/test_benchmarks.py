@@ -47,12 +47,13 @@ class TestConvergedEpisode:
 
 def test_bench_registry_has_all_configs_and_headline_last():
     names = list(BENCHES)
-    assert {"cfg1", "cfg2", "cfg3", "cfg4", "cfg5", "convergence", "scale"} <= set(
-        names
-    )
+    assert {
+        "cfg1", "cfg2", "cfg3", "cfg4", "cfg5", "convergence", "scale",
+        "northstar",
+    } <= set(names)
     # The driver parses the LAST printed JSON line: the north star must print
     # last.
-    assert names[-1] == "cfg4"
+    assert names[-1] == "northstar"
 
 
 def test_numpy_baseline_is_jax_free(monkeypatch):

@@ -1,0 +1,169 @@
+"""On-device scenario synthesis + chunked aggregate-scenario training
+(the transport and update scheme behind the 10k-scenario north star)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.parallel import (
+    device_episode_arrays,
+    device_scenario_traces,
+    init_scen_state_only,
+    train_scenarios_chunked,
+)
+from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+from p2pmicrogrid_tpu.train import make_policy
+
+
+def _cfg(impl="tabular", S=2, A=3, **kw):
+    return default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S),
+        train=TrainConfig(implementation=impl),
+        ddpg=DDPGConfig(buffer_size=32, batch_size=2, share_across_agents=True),
+        **kw,
+    )
+
+
+class TestDeviceGen:
+    def test_trace_shapes_and_ranges(self):
+        t, t_out, load, pv = device_scenario_traces(jax.random.PRNGKey(0), 4)
+        assert t.shape == (96,)
+        assert t_out.shape == (4, 96)
+        assert load.shape == (4, 96, 5)
+        assert pv.shape == (4, 96)
+        # Shared slot grid (the invariant stack_scenario_arrays asserts).
+        np.testing.assert_allclose(np.asarray(t), np.arange(96) / 96, atol=1e-6)
+        # Per-scenario max-normalization (dataset.py:47-49).
+        assert np.asarray(load).max() <= 1.0 + 1e-6
+        assert np.asarray(load).min() > 0.0
+        np.testing.assert_allclose(np.asarray(load).max(axis=1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pv).max(axis=1), 1.0, atol=1e-5)
+        assert np.asarray(pv).min() >= 0.0
+        # October-ish outdoor temperatures.
+        assert 0.0 < np.asarray(t_out).mean() < 20.0
+        # Scenarios are distinct draws.
+        assert not np.allclose(np.asarray(load[0]), np.asarray(load[1]))
+
+    def test_episode_arrays_pairing_and_ratings(self):
+        cfg = _cfg(S=3, A=4)
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        arrs = device_episode_arrays(
+            cfg, jax.random.PRNGKey(1), ratings, 3
+        )
+        assert arrs.load_w.shape == (3, 96, 4)
+        # next_* is the np.roll pairing along time (dataset.py:98-103).
+        np.testing.assert_allclose(
+            np.asarray(arrs.next_load_w),
+            np.roll(np.asarray(arrs.load_w), -1, axis=1),
+            rtol=1e-6,
+        )
+        # Ratings denormalization: agent axis scales match (agent i uses
+        # profile i % 5 scaled by its W rating; community.py:219-224).
+        assert np.asarray(arrs.load_w[:, :, 0]).max() <= ratings.load_rating_w[0] * (
+            1.0 + 1e-5
+        )
+
+    def test_jits_inside_episode_program(self):
+        cfg = _cfg(S=2, A=3)
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        fn = jax.jit(
+            lambda k: device_episode_arrays(cfg, k, ratings, 2).load_w.sum()
+        )
+        assert np.isfinite(float(fn(jax.random.PRNGKey(0))))
+
+
+class TestChunkedTraining:
+    @pytest.mark.parametrize("impl", ["tabular", "ddpg"])
+    def test_runs_and_learns(self, impl):
+        cfg = _cfg(impl=impl)
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        out, rewards, losses, secs = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=2, n_chunks=3,
+        )
+        # K * S per-scenario records per episode.
+        assert rewards.shape == (2, 6)
+        assert np.isfinite(rewards).all()
+        # Parameters moved.
+        before = jax.tree_util.tree_leaves(ps)[0]
+        after = jax.tree_util.tree_leaves(out)[0]
+        assert not np.allclose(np.asarray(before), np.asarray(after))
+
+    def test_identical_chunks_average_to_single_chunk(self):
+        """θ₀ + mean_c(θ_c − θ₀) with identical chunks must equal the one-
+        chunk result — the delta-averaging identity behind chunk-gradient
+        accumulation."""
+        cfg = _cfg(impl="tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        # Collapse every chunk onto one draw: the key ignores the chunk index.
+        same_key = lambda k, e, c: jax.random.fold_in(k, e)
+        one, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(7),
+            n_episodes=1, n_chunks=1, chunk_key_fn=same_key,
+        )
+        many, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(7),
+            n_episodes=1, n_chunks=4, chunk_key_fn=same_key,
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(one), jax.tree_util.tree_leaves(many)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+    def test_distinct_chunks_differ_from_single(self):
+        cfg = _cfg(impl="tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        one, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(7),
+            n_episodes=1, n_chunks=1,
+        )
+        many, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(7),
+            n_episodes=1, n_chunks=3,
+        )
+        one_l = np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(one)]
+        )
+        many_l = np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(many)]
+        )
+        assert not np.allclose(one_l, many_l)
+
+    def test_ddpg_adam_count_dtype_preserved(self):
+        """Delta averaging must not float-ify Adam's int step counters."""
+        cfg = _cfg(impl="ddpg")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        out, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=2,
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ps), jax.tree_util.tree_leaves(out)
+        ):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
