@@ -457,7 +457,8 @@ def bench_cfg4() -> dict:
     # Roofline context (round-1 VERDICT: "is it actually fast, or just faster
     # than eager Python?"): with the rank-1 first round, per-slot matrix
     # traffic is one [S, A, A] write (rank-1 divide) + one read (clear),
-    # plus ~10 learn-pass activations [4*S*A, 64].
+    # plus ~10 learn-pass activations [4*S*A, 64]. Measured per-phase
+    # decomposition: tools/roofline.py -> artifacts/ROOFLINE_r03.json.
     mat = S * A * A * 4
     learn = 10 * 4 * S * A * 64 * 4
     bytes_per_slot = 2 * mat + learn
@@ -468,6 +469,11 @@ def bench_cfg4() -> dict:
         "value": round(value, 1),
         "unit": _chip_unit(),
         "vs_baseline": round(value / _baseline(A, max_slots=2), 2),
+        # The A=1000 NumPy loop is too slow for a full day: its rate is
+        # measured over 2 slots of a cold loop and extrapolated (stated per
+        # round-2 VERDICT weak #5).
+        "baseline_measured_slots": 2,
+        "baseline_extrapolated": True,
         "approx_hbm_gb_per_slot": round(bytes_per_slot / 1e9, 2),
         "achieved_hbm_gb_per_s": round(achieved, 1),
         "hbm_peak_fraction_v5e": round(achieved / 820.0, 3),
@@ -494,6 +500,9 @@ def bench_cfg5() -> dict:
         "value": round(value, 1),
         "unit": _chip_unit(),
         "vs_baseline": round(value / _baseline(A, max_slots=24), 2),
+        # NumPy loop rate measured over 24 slots, extrapolated to the day.
+        "baseline_measured_slots": 24,
+        "baseline_extrapolated": True,
     }
 
 
@@ -599,6 +608,9 @@ def bench_northstar() -> dict:
         "value": round(value, 1),
         "unit": _chip_unit(),
         "vs_baseline": round(value / _baseline(A, max_slots=2), 2),
+        # The A=1000 NumPy loop rate is extrapolated from 2 measured slots.
+        "baseline_measured_slots": 2,
+        "baseline_extrapolated": True,
         "aggregate_scenarios": S_chunk * K,
         "chunk_scenarios": S_chunk,
         "chunks_per_episode": K,
@@ -628,21 +640,21 @@ def converged_episode(
     return converged_ma + window - 1
 
 
-def bench_convergence() -> dict:
-    """Episodes until the trade-weighted mean P2P price converges (the second
-    BASELINE metric). Price formation: midpoint of buy/injection
-    (community.py:70), weighted by the P2P energy actually matched each slot,
-    which shifts as the learners move their heat-pump load across tariff
-    slots. Run over the reference's own 1000-episode budget and epsilon
-    schedule (setup.py:30-31); the per-episode price is smoothed with the
-    reference's 50-episode progress window, and "converged" = the first
-    episode whose windowed price is within 2% of the final windowed price and
-    stays there. Episodes are fused 10-per-device-call; the decay schedule
-    runs inside the block exactly as train_community does."""
+def _convergence_prices(
+    cfg, episodes: int = 1000, block: int = 10, decay_every: "int | None" = None
+) -> np.ndarray:
+    """Per-episode trade-weighted mean P2P price over a training run.
+
+    Price formation: midpoint of buy/injection (community.py:70), weighted by
+    the P2P energy actually matched each slot, which shifts as the learners
+    move their heat-pump load across tariff slots. Episodes are fused
+    ``block``-per-device-call; the epsilon decay runs inside the block on the
+    ``decay_every`` cadence (default: the reference's
+    ``min_episodes_criterion``) exactly as train_community does.
+    """
     import jax
     import jax.numpy as jnp
 
-    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
     from p2pmicrogrid_tpu.data import synthetic_traces
     from p2pmicrogrid_tpu.envs import (
         build_episode_arrays,
@@ -652,12 +664,8 @@ def bench_convergence() -> dict:
     )
     from p2pmicrogrid_tpu.train import init_policy_state, make_policy
 
-    episodes, block = 1000, 10
-    cfg = default_config(
-        sim=SimConfig(n_agents=2, slot_unroll=4),
-        train=TrainConfig(implementation="tabular"),
-    )
-    criterion = cfg.train.min_episodes_criterion
+    if decay_every is None:
+        decay_every = cfg.train.min_episodes_criterion
     traces = synthetic_traces(n_days=1, start_day=11).normalized()
     ratings = make_ratings(cfg, np.random.default_rng(42))
     arrays = build_episode_arrays(cfg, traces, ratings)
@@ -677,7 +685,7 @@ def bench_convergence() -> dict:
             tot = jnp.sum(e)
             price = jnp.where(tot > 0, jnp.sum(out.trade_price * e) / tot, jnp.nan)
             ps = jax.lax.cond(
-                (episode0 + i) % criterion == 0, policy.decay, lambda s: s, ps
+                (episode0 + i) % decay_every == 0, policy.decay, lambda s: s, ps
             )
             return ps, price
 
@@ -689,8 +697,24 @@ def bench_convergence() -> dict:
         key, k = jax.random.split(key)
         ps, p = price_block(ps, b, k)
         prices[b:b + block] = np.asarray(p)
+    return prices
 
-    converged_ep = converged_episode(prices, criterion)
+
+def bench_convergence() -> dict:
+    """Episodes until the trade-weighted mean P2P price converges (the second
+    BASELINE metric), on the reference's own 1000-episode budget and epsilon
+    schedule (setup.py:30-31): the per-episode price is smoothed with the
+    reference's 50-episode progress window, and "converged" = the first
+    episode whose windowed price is within 2% of the final windowed price and
+    stays there."""
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=2, slot_unroll=4),
+        train=TrainConfig(implementation="tabular"),
+    )
+    prices = _convergence_prices(cfg)
+    converged_ep = converged_episode(prices, cfg.train.min_episodes_criterion)
     return {
         "metric": "episodes_to_converged_mean_price_2agent_tabular",
         "value": int(converged_ep),
@@ -700,11 +724,133 @@ def bench_convergence() -> dict:
     }
 
 
+def _convergence_prices_shared(
+    cfg, episodes: int = 1000, block: int = 10, decay_every: int = 10
+) -> np.ndarray:
+    """Per-episode trade-weighted mean P2P price under scenario-averaged
+    shared-tabular training (S scenarios, one table, per-slot averaged
+    updates — parallel/scenarios.py:_tabular_update_shared). The per-episode
+    price averages over all S scenarios' traded energy."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.envs import init_physical, make_ratings
+    from p2pmicrogrid_tpu.envs.community import (
+        AgentRatings,
+        slot_dynamics_batched,
+    )
+    from p2pmicrogrid_tpu.parallel import (
+        init_shared_state,
+        make_scenario_traces,
+        stack_scenario_arrays,
+    )
+    from p2pmicrogrid_tpu.parallel.scenarios import _tabular_update_shared
+    from p2pmicrogrid_tpu.train import make_policy
+
+    S = cfg.sim.n_scenarios
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    traces = make_scenario_traces(cfg)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    xs_all = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrays)
+    xs_all = (
+        xs_all.time, xs_all.t_out, xs_all.load_w, xs_all.pv_w,
+        xs_all.next_time, xs_all.next_load_w, xs_all.next_pv_w,
+    )
+
+    @jax.jit
+    def price_block(ps, episode0, key):
+        def episode(ps, ek):
+            i, k = ek
+            k_phys, k_scan = jax.random.split(k)
+            phys = jax.vmap(lambda kk: init_physical(cfg, kk))(
+                jax.random.split(k_phys, S)
+            )
+
+            def slot(carry, xs_t):
+                phys_s, ps, kk = carry
+                kk, k_act, k_learn = jax.random.split(kk, 3)
+                phys_s, _, out, tr, _ = slot_dynamics_batched(
+                    cfg, policy, ps, phys_s, xs_t, k_act, ratings_j,
+                    explore=True,
+                )
+                ps, _ = _tabular_update_shared(cfg, ps, tr, k_learn)
+                e = jnp.sum(jnp.maximum(out.p_p2p, 0.0), axis=-1)  # [S]
+                return (phys_s, ps, kk), (out.trade_price, e)
+
+            (_, ps, _), (tp, e) = jax.lax.scan(
+                slot, (phys, ps, k_scan), xs_all, unroll=cfg.sim.slot_unroll
+            )
+            tot = jnp.sum(e)
+            price = jnp.where(tot > 0, jnp.sum(tp * e) / tot, jnp.nan)
+            ps = jax.lax.cond(
+                (episode0 + i) % decay_every == 0, policy.decay, lambda s: s, ps
+            )
+            return ps, price
+
+        return jax.lax.scan(
+            episode, ps, (jnp.arange(block), jax.random.split(key, block))
+        )
+
+    key = jax.random.PRNGKey(42)
+    prices = np.empty(episodes)
+    for b in range(0, episodes, block):
+        key, k = jax.random.split(key)
+        ps, p = price_block(ps, b, k)
+        prices[b:b + block] = np.asarray(p)
+    return prices
+
+
+def bench_convergence_fast() -> dict:
+    """Opt-in accelerated schedule for the same metric (defaults untouched).
+
+    What actually gates the parity number is NOT the epsilon cadence: with
+    the decay every 10 episodes (floor reached by ~ep 80, even with floor 0)
+    the windowed price still drifts ~0.004 over the whole run — the two
+    learners keep chasing each other's single-day noise (MARL
+    non-stationarity), so the trade-weighted price trends until the end
+    (measured round 3; fast-decay alone converges at 895, 1.12x). The fix is
+    the TPU-native axis: train ONE shared table over S=32 Monte-Carlo
+    scenarios with per-slot scenario-averaged updates — the day-specific
+    noise the agents chase averages out, the equilibrium price settles in
+    ~140 episodes (7x), and the metric is computed on the SAME 50-episode
+    window as the parity line.
+    """
+    from p2pmicrogrid_tpu.config import (
+        QLearningConfig,
+        SimConfig,
+        TrainConfig,
+        default_config,
+    )
+
+    window = 50  # reference progress window, kept for comparability
+    cfg = default_config(
+        sim=SimConfig(n_agents=2, n_scenarios=32, slot_unroll=4),
+        train=TrainConfig(implementation="tabular"),
+        qlearning=QLearningConfig(alpha=1e-4),
+    )
+    prices = _convergence_prices_shared(cfg, decay_every=10)
+    converged_ep = converged_episode(prices, window)
+    return {
+        "metric": "episodes_to_converged_mean_price_2agent_tabular_accelerated",
+        "value": int(converged_ep),
+        "unit": "episodes",
+        "vs_baseline": round(1000.0 / max(converged_ep, 1), 2),
+        "schedule": (
+            "opt-in: shared table averaged over 32 scenarios, alpha 1e-4, "
+            "epsilon x0.9 every 10 episodes (defaults: 1 scenario, 1e-5, 50)"
+        ),
+    }
+
+
 BENCHES = {
     "cfg1": bench_cfg1,
     "cfg2": bench_cfg2,
     "cfg3": bench_cfg3,
     "convergence": bench_convergence,
+    "convergence_fast": bench_convergence_fast,
     "scale": bench_scale,
     "cfg5": bench_cfg5,
     "cfg4": bench_cfg4,

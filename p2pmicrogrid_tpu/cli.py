@@ -120,6 +120,14 @@ def cmd_train(args) -> int:
                 "applies to shared-scenario DDPG training; also pass: "
                 + ", ".join(problems)
             )
+    if getattr(args, "chunks", 1) > 1 and not (
+        getattr(args, "shared", False) and getattr(args, "scenarios", 1) > 1
+    ):
+        raise SystemExit(
+            "--chunks K (aggregate-scenario chunked training) requires "
+            "--scenarios N --shared: each chunk of N scenarios reuses one "
+            "compiled shared-learner program"
+        )
     if getattr(args, "scenarios", 1) > 1:
         return _cmd_train_scenarios(args)
 
@@ -191,11 +199,14 @@ def cmd_train(args) -> int:
     return 0
 
 
-def _scenario_setting(cfg, shared: bool) -> str:
+def _scenario_setting(cfg, shared: bool, chunks: int = 1) -> str:
     """Experiment identity for scenario-batched runs: the community setting
-    plus the Monte-Carlo axis, e.g. ``2-multi-agent-com-rounds-1-hetero-x256-shared``."""
+    plus the Monte-Carlo axis, e.g. ``2-multi-agent-com-rounds-1-hetero-x256-shared``
+    (chunked aggregate runs append ``-k{chunks}``). Single source for both
+    the train path and eval's checkpoint lookup."""
     mode = "shared" if shared else "indep"
-    return f"{cfg.setting}-x{cfg.sim.n_scenarios}-{mode}"
+    setting = f"{cfg.setting}-x{cfg.sim.n_scenarios}-{mode}"
+    return f"{setting}-k{chunks}" if chunks > 1 else setting
 
 
 def _windowed_episode_cb(cfg, setting, store, ckpt_dir, carry_is_tuple):
@@ -253,16 +264,30 @@ def _cmd_train_scenarios(args) -> int:
 
     cfg = _build_cfg(args)
     S = cfg.sim.n_scenarios
-    setting = _scenario_setting(cfg, args.shared)
+    chunks = getattr(args, "chunks", 1)
+    setting = _scenario_setting(cfg, args.shared, chunks)
     rng = np.random.default_rng(cfg.train.seed)
     ratings = make_ratings(cfg, rng)
     key = jax.random.PRNGKey(cfg.train.seed)
     policy = make_policy(cfg)
 
-    traces = make_scenario_traces(cfg, seed=cfg.train.seed)
-    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    if chunks > 1:
+        # Chunked aggregate-scenario mode synthesizes each chunk's traces on
+        # device (parallel/device_gen.py); no host arrays to build.
+        arrays = None
+    else:
+        traces = make_scenario_traces(cfg, seed=cfg.train.seed)
+        arrays = stack_scenario_arrays(cfg, traces, ratings)
 
-    if args.shared:
+    if args.shared and chunks > 1:
+        # Chunked training seeds fresh per-chunk replay/OU itself
+        # (scenarios.py:init_scen_state_only); a full-size scen_state here
+        # would just pin unused HBM at exactly the north-star scale.
+        from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+
+        pol_state = init_shared_pol_state(cfg, key)
+        scen_state = None
+    elif args.shared:
         pol_state, scen_state = init_shared_state(cfg, key)
     else:
         pol_state = jax.vmap(lambda k: init_policy_state(cfg, k))(
@@ -284,17 +309,25 @@ def _cmd_train_scenarios(args) -> int:
             print("nothing to do: checkpoint is at or past --episodes")
             return 0
         # Advance the key chain past the trained episodes so the resumed run
-        # does not replay the original run's random stream.
-        key = jax.random.fold_in(key, episode0)
+        # does not replay the original run's random stream. Chunked mode
+        # already keys every chunk by the ABSOLUTE episode index
+        # (train_scenarios_chunked's chunk_key_fn), so folding here would
+        # make resumed runs draw different scenarios than straight-through
+        # runs at the same episode.
+        if chunks <= 1:
+            key = jax.random.fold_in(key, episode0)
 
     episode_cb = _windowed_episode_cb(
-        cfg, setting, store, ckpt_dir, carry_is_tuple=args.shared
+        cfg, setting, store, ckpt_dir,
+        carry_is_tuple=args.shared and chunks <= 1,
     )
     n_episodes = cfg.train.max_episodes - episode0
-    print(f"setting: {setting} ({cfg.train.implementation}, S={S})")
-    if args.shared and cfg.train.implementation == "dqn":
+    agg = f", {chunks} chunks = {S * chunks} aggregate" if chunks > 1 else ""
+    print(f"setting: {setting} ({cfg.train.implementation}, S={S}{agg})")
+    if args.shared and chunks <= 1 and cfg.train.implementation == "dqn":
         # Replay warmup before gradient steps (the reference's init_buffers,
         # community.py:125-147 — it runs after load_agents too, :265-267).
+        # Chunked mode re-seeds per-chunk state instead (scenarios.py).
         from p2pmicrogrid_tpu.parallel import warmup_shared_dqn
 
         key, k_warm = jax.random.split(key)
@@ -302,7 +335,14 @@ def _cmd_train_scenarios(args) -> int:
             cfg, policy, pol_state, scen_state, arrays, ratings, k_warm
         )
     with _profile_ctx(args):
-        if args.shared:
+        if chunks > 1:
+            from p2pmicrogrid_tpu.parallel import train_scenarios_chunked
+
+            pol_state, rewards, _, seconds = train_scenarios_chunked(
+                cfg, policy, pol_state, ratings, key, n_episodes,
+                n_chunks=chunks, episode0=episode0, episode_cb=episode_cb,
+            )
+        elif args.shared:
             pol_state, _, rewards, _, seconds = train_scenarios_shared(
                 cfg, policy, pol_state, arrays, ratings, key, n_episodes,
                 replay_s=scen_state, episode0=episode0, episode_cb=episode_cb,
@@ -315,10 +355,11 @@ def _cmd_train_scenarios(args) -> int:
     save_checkpoint(ckpt_dir, pol_state, cfg.train.max_episodes - 1)
     if args.timing_json:
         _save_times(args.timing_json, setting, train_time=seconds)
-    steps = n_episodes * int(arrays.time.shape[1]) * S
+    steps = n_episodes * cfg.sim.slots_per_day * S * max(chunks, 1)
     print(
-        f"trained {n_episodes} episodes x {S} scenarios in {seconds:.1f}s "
-        f"({steps / seconds:.0f} env-steps/s); checkpoint: {ckpt_dir}"
+        f"trained {n_episodes} episodes x {S * max(chunks, 1)} scenarios in "
+        f"{seconds:.1f}s ({steps / seconds:.0f} env-steps/s); "
+        f"checkpoint: {ckpt_dir}"
     )
     return 0
 
@@ -479,7 +520,7 @@ def _restore_eval_state(args, cfg, key):
         pol_state, episode = restore_checkpoint(ckpt_dir, template)
         return pol_state, episode, ckpt_dir
 
-    setting = _scenario_setting(cfg, args.shared)
+    setting = _scenario_setting(cfg, args.shared, getattr(args, "chunks", 1))
     ckpt_dir = checkpoint_dir(args.model_dir, setting, impl)
     if args.shared:
         if impl == "ddpg":
@@ -968,6 +1009,12 @@ def main(argv=None) -> int:
     p.add_argument("--shared", action="store_true",
                    help="with --scenarios: one shared learner with per-slot "
                         "scenario-averaged updates (default: S independent)")
+    p.add_argument("--chunks", type=int, default=1,
+                   help="with --scenarios N --shared: train K*N aggregate "
+                        "scenarios per episode — K chunks reuse one compiled "
+                        "N-scenario program with on-device trace synthesis "
+                        "and chunk-averaged parameter deltas (the 10k-"
+                        "scenario north-star mode)")
     p.add_argument("--share-agents", action="store_true", dest="share_agents",
                    help="ddpg + --shared: ONE actor-critic for the whole "
                         "community (shared-critic MARL) instead of per-agent "
@@ -999,6 +1046,8 @@ def main(argv=None) -> int:
                    help="locate the checkpoint of a --scenarios N training run")
     p.add_argument("--shared", action="store_true",
                    help="the checkpoint came from --shared training")
+    p.add_argument("--chunks", type=int, default=1,
+                   help="the checkpoint came from --chunks K training")
     p.add_argument("--share-agents", action="store_true", dest="share_agents",
                    help="the checkpoint came from --share-agents training")
     p.add_argument("--market-dtype", choices=["float32", "bfloat16"],

@@ -126,6 +126,10 @@ def ddpg_learn_batch(
     scenario trainer (parallel/scenarios.py) calls it on scenario-flattened
     batches so the per-slot gradient is the scenario average (the
     psum-over-ICI path when scenario-sharded).
+
+    The last return element is the per-sample squared critic residual [B]
+    (its mean is the classic critic loss); scenario-flattened callers
+    reshape it back to report real per-scenario errors for free.
     """
     actor = Actor(hidden=cfg.actor_hidden)
     critic = Critic(hidden=cfg.critic_hidden)
@@ -139,9 +143,10 @@ def ddpg_learn_batch(
 
     def critic_loss(p):
         q = critic.apply({"params": p}, s, a)[:, 0]
-        return jnp.mean(jnp.square(q_target - q))
+        sq = jnp.square(q_target - q)
+        return jnp.mean(sq), sq
 
-    c_loss, c_grads = jax.value_and_grad(critic_loss)(pc)
+    (c_loss, c_sq), c_grads = jax.value_and_grad(critic_loss, has_aux=True)(pc)
     c_updates, oc = c_opt.update(c_grads, oc, pc)
     pc = optax.apply_updates(pc, c_updates)
 
@@ -157,7 +162,7 @@ def ddpg_learn_batch(
     polyak = lambda t, o: jax.tree_util.tree_map(
         lambda x, y: (1.0 - cfg.tau) * x + cfg.tau * y, t, o
     )
-    return pa, pc, polyak(pat, pa), polyak(pct, pc), oa, oc, c_loss
+    return pa, pc, polyak(pat, pa), polyak(pct, pc), oa, oc, c_loss, c_sq
 
 
 def _params_init_per_agent(
@@ -272,7 +277,7 @@ def ddpg_update(
     def learn_one(pa, pc, pat, pct, oa, oc, s, a, r, ns):
         return ddpg_learn_batch(cfg, pa, pc, pat, pct, oa, oc, s, a, r, ns)
 
-    pa, pc, pat, pct, oa, oc, loss = jax.vmap(learn_one)(
+    pa, pc, pat, pct, oa, oc, loss, _ = jax.vmap(learn_one)(
         state.actor,
         state.critic,
         state.actor_target,

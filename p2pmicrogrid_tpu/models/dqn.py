@@ -118,7 +118,12 @@ def dqn_act(
 
 def _td_loss(cfg: DQNConfig, net: QNetwork, params, target_params, s, a, r, ns):
     """TD(0) loss against the target net's action-enumerated max
-    (rl.py:308-326). No terminal masking: reference episodes have none."""
+    (rl.py:308-326). No terminal masking: reference episodes have none.
+
+    Returns ``(mean_loss, per_sample_sq [B])`` — the per-sample squared
+    residuals ride along as grad aux so callers batching many scenarios can
+    report a REAL per-scenario error instead of a broadcast mean.
+    """
     b = s.shape[0]
 
     def q_target_for(action_value):
@@ -130,7 +135,8 @@ def _td_loss(cfg: DQNConfig, net: QNetwork, params, target_params, s, a, r, ns):
     )
     q_target = r + cfg.gamma * q_max
     q_value = net.apply({"params": params}, s, a)[:, 0]
-    return jnp.mean(jnp.square(q_target - q_value))
+    sq = jnp.square(q_target - q_value)
+    return jnp.mean(sq), sq
 
 
 def _clip_first_layer(cfg: DQNConfig, grads: dict) -> dict:
@@ -151,16 +157,19 @@ def apply_td_update(cfg: DQNConfig, loss_fn, params, target_params, opt_state):
     Shared by the single-scenario per-slot update (``dqn_update``) and the
     scenario-averaged shared-parameter update (parallel/scenarios.py) so the
     clip/optimizer/tau semantics can never diverge between the two paths.
+
+    ``loss_fn(params) -> (scalar, per_sample_aux)``; returns
+    (params, target_params, opt_state, loss, per_sample_aux).
     """
     opt = _make_optimizer(cfg)
-    loss, grads = jax.value_and_grad(loss_fn)(params)
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
     grads = _clip_first_layer(cfg, grads)
     updates, opt_state = opt.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     target_params = jax.tree_util.tree_map(
         lambda t, o: (1.0 - cfg.tau) * t + cfg.tau * o, target_params, params
     )
-    return params, target_params, opt_state, loss
+    return params, target_params, opt_state, loss, aux
 
 
 def dqn_update(
@@ -194,7 +203,7 @@ def dqn_update(
             opt_state,
         )
 
-    online, target, opt_state, loss = jax.vmap(learn_one)(
+    online, target, opt_state, loss, _ = jax.vmap(learn_one)(
         state.online, state.target, state.opt_state, s, a, r, ns
     )
     return (

@@ -25,6 +25,7 @@ from p2pmicrogrid_tpu.parallel.mesh import (
 from p2pmicrogrid_tpu.parallel.scenarios import (
     DDPGScenState,
     init_scen_state_only,
+    init_shared_pol_state,
     init_shared_state,
     make_scenario_traces,
     stack_scenario_arrays,
@@ -49,6 +50,7 @@ __all__ = [
     "device_episode_arrays",
     "device_scenario_traces",
     "init_scen_state_only",
+    "init_shared_pol_state",
     "init_shared_state",
     "make_scenario_traces",
     "stack_scenario_arrays",

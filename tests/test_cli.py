@@ -232,3 +232,29 @@ class TestAnalyseFigures:
         assert any(n.startswith("rounds_") for n in names), names
         assert "sweep_curves.png" in names, names
         assert any(n.startswith("qtable_") for n in names), names
+
+
+class TestChunkedCLI:
+    def test_chunked_train_then_eval_round_trip(self, tmp_path):
+        """--chunks K: aggregate-scenario training (the north-star mode) is
+        reachable from the CLI and its checkpoint evaluates."""
+        db = str(tmp_path / "r.db")
+        common = [
+            "--agents", "2", "--scenarios", "2", "--shared",
+            "--implementation", "ddpg",
+            "--results-db", db, "--model-dir", str(tmp_path / "m"),
+        ]
+        assert main(["train", *common, "--chunks", "3", "--episodes", "2"]) == 0
+        ckpt = tmp_path / "m" / "models_ddpg"
+        assert any("k3" in d.name for d in ckpt.iterdir())
+        assert main(["eval", *common, "--chunks", "3", "--test"]) == 0
+
+    def test_chunks_without_shared_errors(self, tmp_path):
+        with pytest.raises(SystemExit, match="--chunks"):
+            main(
+                [
+                    "train", "--agents", "2", "--scenarios", "2",
+                    "--chunks", "3", "--episodes", "1",
+                    "--model-dir", str(tmp_path / "m"),
+                ]
+            )

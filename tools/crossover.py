@@ -1,0 +1,73 @@
+"""Measured CPU/TPU crossover for small sequential communities.
+
+Round-2 VERDICT: benchmark configs 1-2 (2-agent tabular, 10-agent
+actor-critic) report host-CPU numbers because toy sequential programs cannot
+fill the chip — but no measured crossover backed that placement. This script
+runs the SAME jitted single-scenario training program
+(benchmarks.single_community_steps_per_sec) on both backends across community
+sizes and emits the crossover table for ``artifacts/``.
+
+Usage: ``PYTHONPATH=/root/repo python tools/crossover.py``
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+
+from p2pmicrogrid_tpu.benchmarks import single_community_steps_per_sec
+
+SIZES_TABULAR = (2, 10, 50, 100, 250)
+SIZES_DDPG = (10, 50, 100)
+
+
+def main() -> dict:
+    tpu = jax.devices()[0]
+    cpu = jax.devices("cpu")[0]
+    assert tpu.platform != "cpu", "run this on a TPU host"
+
+    rows = []
+    for impl, sizes in (("tabular", SIZES_TABULAR), ("ddpg", SIZES_DDPG)):
+        for a in sizes:
+            r_cpu = single_community_steps_per_sec(a, impl, device=cpu)
+            r_tpu = single_community_steps_per_sec(a, impl, device=tpu)
+            rows.append(
+                {
+                    "implementation": impl,
+                    "n_agents": a,
+                    "cpu_steps_per_sec": round(r_cpu, 1),
+                    "tpu_steps_per_sec": round(r_tpu, 1),
+                    "tpu_over_cpu": round(r_tpu / r_cpu, 2),
+                    "winner": "tpu" if r_tpu > r_cpu else "cpu",
+                }
+            )
+            print(
+                f"{impl} A={a}: cpu {r_cpu:.0f} vs tpu {r_tpu:.0f} "
+                f"({r_tpu / r_cpu:.2f}x)",
+                flush=True,
+            )
+
+    crossover = {}
+    for impl in ("tabular", "ddpg"):
+        sizes = [r["n_agents"] for r in rows if r["implementation"] == impl]
+        winners = [r["winner"] for r in rows if r["implementation"] == impl]
+        above = [a for a, w in zip(sizes, winners) if w == "tpu"]
+        crossover[impl] = min(above) if above else f"> {max(sizes)}"
+
+    doc = {
+        "what": (
+            "same jitted single-scenario training program placed on each "
+            "backend; one sequential community, 96-slot day, "
+            "20-episode fused blocks"
+        ),
+        "device": jax.devices()[0].device_kind,
+        "rows": rows,
+        "tpu_wins_from_n_agents": crossover,
+    }
+    print(json.dumps(doc, indent=2))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

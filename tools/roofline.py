@@ -1,0 +1,181 @@
+"""Measured per-kernel decomposition backing the config-4 roofline claim.
+
+Round-2 VERDICT: the "~40% of HBM roofline" statement rested on an analytic
+traffic model only. This script MEASURES, on the real chip at config-4 scale,
+the per-slot cost of each fused phase of the scenario-batched slot program —
+the negotiation matrix kernels (ops/pallas_market.py), the pooled DDPG learn
+pass, and the full slot — plus each phase's HBM traffic model, and emits one
+JSON document for ``artifacts/``.
+
+Timing protocol (from .claude/skills/verify/SKILL.md): the tunneled TPU has
+~85-260 ms of blocked-round-trip overhead and ``block_until_ready`` may
+return early, so each phase chains N dependent calls, forces sync with a
+scalar pull, divides by N, and takes best-of-3.
+
+Usage: ``PYTHONPATH=/root/repo python tools/roofline.py [S] [A]``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HBM_PEAK_GB_S = 820.0  # TPU v5e spec sheet
+
+
+def _timeit(fn, *args, n: int = 20, repeats: int = 3) -> float:
+    """Best-of-``repeats`` seconds per call of jitted ``fn`` chained n deep."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+
+    def chain():
+        res = args
+        t0 = time.time()
+        for _ in range(n):
+            res = fn(*res) if isinstance(res, tuple) else fn(res)
+        leaves = jax.tree_util.tree_leaves(res)
+        float(leaves[0].sum())  # force a real sync through the tunnel
+        return (time.time() - t0) / n
+
+    return min(chain() for _ in range(repeats))
+
+
+def main(S: int = 64, A: int = 1000) -> dict:
+    from p2pmicrogrid_tpu.config import (
+        BatteryConfig,
+        DDPGConfig,
+        SimConfig,
+        TrainConfig,
+        default_config,
+    )
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.models.ddpg import ddpg_learn_batch, ddpg_params_init
+    from p2pmicrogrid_tpu.ops.pallas_market import (
+        clear_market_fused,
+        divide_power_fused_with_mean,
+        divide_rank1_fused,
+    )
+    from p2pmicrogrid_tpu.parallel import (
+        init_shared_state,
+        make_scenario_traces,
+        stack_scenario_arrays,
+    )
+    from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+    from p2pmicrogrid_tpu.train import make_policy
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S),
+        battery=BatteryConfig(enabled=True),
+        train=TrainConfig(implementation="ddpg"),
+        ddpg=DDPGConfig(buffer_size=256, batch_size=4, share_across_agents=True),
+    )
+    d = cfg.ddpg
+    key = jax.random.PRNGKey(0)
+    rows = []
+
+    def add(name, secs, traffic_bytes, note):
+        rows.append(
+            {
+                "phase": name,
+                "ms": round(secs * 1e3, 3),
+                "hbm_gb_modeled": round(traffic_bytes / 1e9, 3),
+                "achieved_gb_per_s": round(traffic_bytes / secs / 1e9, 1),
+                "hbm_peak_fraction_v5e": round(
+                    traffic_bytes / secs / 1e9 / HBM_PEAK_GB_S, 3
+                ),
+                "note": note,
+            }
+        )
+
+    # --- negotiation matrix kernels (per invocation = one round of one slot)
+    mat_bytes = S * A * A * 4  # one f32 [S, A, A] matrix in HBM
+    vec = jax.random.normal(key, (S, A))
+    p2p = jax.random.normal(key, (S, A, A))
+
+    f_rank1 = jax.jit(lambda v: divide_rank1_fused(v, v)[0][:, 0, :])
+    secs = _timeit(f_rank1, vec)
+    add("divide_rank1_fused", secs, mat_bytes,
+        "round-1 proposal split: writes [S,A,A], reads only [S,A] vectors")
+
+    f_div = jax.jit(lambda m: divide_power_fused_with_mean(m, m[:, :, 0])[0])
+    secs = _timeit(f_div, p2p)
+    add("divide_power_fused_with_mean", secs, 2 * mat_bytes,
+        "later rounds: read + write [S,A,A] in one pass (round 2+ only)")
+
+    # Chainable: fold the [S, A] clear result back into an [S, A, A] carry.
+    f_clear = jax.jit(lambda m: m + clear_market_fused(m)[0][:, None, :])
+    secs = _timeit(f_clear, p2p)
+    add("clear_market_fused (+chain add)", secs, 3 * mat_bytes,
+        "market clearing reads [S,A,A] in VMEM; the chaining add costs an "
+        "extra matrix read+write, included in the traffic model")
+
+    # --- pooled shared-critic learn pass (per slot update)
+    B = d.batch_size * S * A  # pooled batch rows
+    params = ddpg_params_init(d, A, key)
+    s_b = jax.random.normal(key, (B, 4))
+    a_b = jax.random.normal(key, (B, 1))
+    r_b = jax.random.normal(key, (B,))
+
+    @jax.jit
+    def learn(s_in):
+        out = ddpg_learn_batch(
+            d, params.actor, params.critic, params.actor_target,
+            params.critic_target, params.actor_opt, params.critic_opt,
+            s_in, a_b, r_b, s_in,
+        )
+        # Chainable: mean residual folded into the carried input.
+        return s_in + jnp.mean(out[-1])
+
+    h = max(d.actor_hidden, d.critic_hidden)
+    # ~10 activation passes (actor/critic fwd+bwd+target) of [B, h] f32.
+    learn_bytes = 10 * B * h * 4
+    secs = _timeit(learn, s_b)
+    add("ddpg_learn_batch (pooled)", secs, learn_bytes,
+        f"one shared actor-critic update on the pooled [{B}, obs] batch")
+
+    # --- the full slot, from the real compiled episode program
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    traces = make_scenario_traces(cfg)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    ps, scen = init_shared_state(cfg, key)
+    episode_fn = make_shared_episode_fn(cfg, policy, arrays, ratings)
+    carry = (ps, scen)
+    out = episode_fn(carry, key)
+    jax.block_until_ready(out[0][0])
+    best = np.inf
+    for _ in range(3):
+        t0 = time.time()
+        carry, _ = episode_fn(carry, key)
+        jax.block_until_ready(carry[0])
+        best = min(best, time.time() - t0)
+    slots = int(arrays.time.shape[1])
+    slot_secs = best / slots
+    # Per-slot traffic: rank-1 write + clear read (round 0-1 path) + learn.
+    slot_bytes = 2 * mat_bytes + learn_bytes
+    add("full slot (episode/96)", slot_secs, slot_bytes,
+        "whole compiled slot: negotiate + clear + settle + learn + step")
+
+    doc = {
+        "config": {
+            "n_agents": A, "n_scenarios": S, "implementation": "ddpg",
+            "share_across_agents": True, "batch_size": d.batch_size,
+            "device": jax.devices()[0].device_kind,
+            "hbm_peak_gb_s_assumed": HBM_PEAK_GB_S,
+        },
+        "phases": rows,
+        "protocol": "chained x20 dependent calls, scalar-sync, best of 3",
+    }
+    print(json.dumps(doc, indent=2))
+    return doc
+
+
+if __name__ == "__main__":
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    A = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    main(S, A)
