@@ -29,4 +29,4 @@ Layer map (mirrors SURVEY.md section 1 of the parent repo):
 * ``analysis``  — post-run reporting (data_analysis.py)
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"

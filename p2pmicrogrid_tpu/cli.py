@@ -46,7 +46,15 @@ def _build_cfg(args) -> "ExperimentConfig":
         ),
         battery=BatteryConfig(enabled=args.battery),
         ddpg=DDPGConfig(
-            share_across_agents=getattr(args, "share_agents", False)
+            share_across_agents=getattr(args, "share_agents", False),
+            **{
+                k: v
+                for k, v in (
+                    ("actor_lr", getattr(args, "actor_lr", None)),
+                    ("critic_lr", getattr(args, "critic_lr", None)),
+                )
+                if v is not None
+            },
         ),
         train=TrainConfig(
             max_episodes=args.episodes,
@@ -1019,6 +1027,14 @@ def main(argv=None) -> int:
                    help="ddpg + --shared: ONE actor-critic for the whole "
                         "community (shared-critic MARL) instead of per-agent "
                         "copies")
+    p.add_argument("--actor-lr", type=float, dest="actor_lr",
+                   help="DDPG actor learning rate (default 1e-4; scale DOWN "
+                        "for large pooled batches — chunked 100-agent runs "
+                        "are stable at 2.5e-5, see "
+                        "artifacts/LEARNING_chunked_r03.json)")
+    p.add_argument("--critic-lr", type=float, dest="critic_lr",
+                   help="DDPG critic learning rate (default 2e-4; see "
+                        "--actor-lr)")
     p.add_argument("--market-dtype", choices=["float32", "bfloat16"],
                    default="float32", dest="market_dtype",
                    help="storage dtype of the batched negotiation matrices "

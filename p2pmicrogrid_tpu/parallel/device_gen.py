@@ -24,9 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.data.traces import SLOTS_PER_DAY
 from p2pmicrogrid_tpu.envs.community import AgentRatings, EpisodeArrays
-
-SLOTS_PER_DAY = 96
 
 
 def device_scenario_traces(

@@ -721,6 +721,41 @@ def train_scenarios_shared(
 # --- chunked aggregate-scenario mode (the 10k north star) --------------------
 
 
+def make_chunked_episode_runner(
+    cfg: ExperimentConfig, episode_fn: Callable, n_chunks: int
+) -> Callable:
+    """The jitted K-chunk episode: ONE device call — a ``lax.scan`` over
+    chunk keys whose body runs the chunk episode from θ₀ and accumulates its
+    parameter delta (per-chunk host dispatches through the tunneled runtime
+    cost ~0.1 s each — at K=80 that was ~10% of the episode).
+
+    Signature: ``runner(theta0, chunk_keys [K, 2]) -> (theta',
+    rewards [K*S], losses [K*S])``. Built once and reused across
+    ``train_scenarios_chunked`` calls (each call would otherwise create a
+    fresh jit wrapper and recompile).
+    """
+
+    @jax.jit
+    def run_chunks(theta0, chunk_keys):
+        def body(acc, kc):
+            k_scen, k_ep = jax.random.split(kc)
+            scen = init_scen_state_only(cfg, k_scen)
+            (theta_c, _), (r, l) = episode_fn((theta0, scen), k_ep)
+            acc = jax.tree_util.tree_map(
+                lambda a, n, o: a + (n - o), acc, theta_c, theta0
+            )
+            return acc, (r, l)
+
+        acc0 = jax.tree_util.tree_map(jnp.zeros_like, theta0)
+        acc, (rs, ls) = jax.lax.scan(body, acc0, chunk_keys)
+        new = jax.tree_util.tree_map(
+            lambda b, a: (b + a / n_chunks).astype(b.dtype), theta0, acc
+        )
+        return new, rs.reshape(-1), ls.reshape(-1)  # chunk-major [K*S]
+
+    return run_chunks
+
+
 def train_scenarios_chunked(
     cfg: ExperimentConfig,
     policy: Policy,
@@ -733,6 +768,7 @@ def train_scenarios_chunked(
     episode0: int = 0,
     chunk_key_fn: Optional[Callable] = None,
     episode_cb: Optional[Callable] = None,
+    runner: Optional[Callable] = None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
     Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
@@ -758,6 +794,13 @@ def train_scenarios_chunked(
     Returns (pol_state, rewards [episodes, K*S], losses [episodes, K*S],
     seconds). ``chunk_key_fn(key, episode, chunk) -> key`` overrides the
     per-chunk seeding (tests use it to collapse chunks onto one draw).
+
+    Step-size note (measured, artifacts/LEARNING_chunked_r03.json): the
+    pooled DDPG batch is ``batch_size * S * A`` transitions per slot — at
+    the DDPG default lrs the critic over-drives and training diverges after
+    early convergence; a quarter of the default (actor 2.5e-5, critic 5e-5)
+    is stable for 100-agent chunked runs. Scale the lrs down as the pooled
+    batch grows.
     """
     S = cfg.sim.n_scenarios
     if episode_fn is None:
@@ -775,39 +818,21 @@ def train_scenarios_chunked(
         chunk_key_fn = lambda k, e, c: jax.random.fold_in(
             jax.random.fold_in(k, e), c
         )
-
-    # On-device tree ops so the K-chunk loop dispatches, never transfers.
-    accumulate = jax.jit(
-        lambda acc, new, old: jax.tree_util.tree_map(
-            lambda a, n, o: a + (n - o), acc, new, old
-        )
-    )
-    apply_mean = jax.jit(
-        lambda base, acc: jax.tree_util.tree_map(
-            lambda b, a: (b + a / n_chunks).astype(b.dtype), base, acc
-        )
-    )
+    if runner is None:
+        runner = make_chunked_episode_runner(cfg, episode_fn, n_chunks)
+    run_chunks = runner
 
     decay_every = cfg.train.min_episodes_criterion
     rewards, losses = [], []
     start = _time.time()
     for e in range(n_episodes):
-        theta0 = pol_state
-        acc = jax.tree_util.tree_map(jnp.zeros_like, theta0)
-        r_parts, l_parts = [], []
-        for c in range(n_chunks):
-            kc = chunk_key_fn(key, episode0 + e, c)
-            k_scen, k_ep = jax.random.split(kc)
-            scen = init_scen_state_only(cfg, k_scen)
-            (theta_c, _), (r, l) = episode_fn((theta0, scen), k_ep)
-            acc = accumulate(acc, theta_c, theta0)
-            r_parts.append(r)
-            l_parts.append(l)
-        pol_state = apply_mean(theta0, acc)
+        chunk_keys = jnp.stack(
+            [chunk_key_fn(key, episode0 + e, c) for c in range(n_chunks)]
+        )
+        pol_state, r, l = run_chunks(pol_state, chunk_keys)
         if decay_every and (episode0 + e) % decay_every == 0:
             pol_state = policy.decay(pol_state)
-        r = np.concatenate([np.asarray(x) for x in r_parts])
-        l = np.concatenate([np.asarray(x) for x in l_parts])
+        r, l = np.asarray(r), np.asarray(l)
         rewards.append(r)
         losses.append(l)
         if episode_cb:

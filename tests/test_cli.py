@@ -258,3 +258,23 @@ class TestChunkedCLI:
                     "--model-dir", str(tmp_path / "m"),
                 ]
             )
+
+
+def test_ddpg_lr_flags_reach_config(tmp_path):
+    from p2pmicrogrid_tpu.cli import _build_cfg, main as cli_main
+    import argparse
+
+    ns = argparse.Namespace(
+        agents=2, rounds=1, homogeneous=False, no_trading=False, battery=False,
+        episodes=1, implementation="ddpg", seed=0, scenarios=1,
+        actor_lr=2.5e-5, critic_lr=5e-5,
+    )
+    cfg = _build_cfg(ns)
+    assert cfg.ddpg.actor_lr == 2.5e-5
+    assert cfg.ddpg.critic_lr == 5e-5
+    # Omitted flags keep the defaults.
+    ns2 = argparse.Namespace(
+        agents=2, rounds=1, homogeneous=False, no_trading=False, battery=False,
+        episodes=1, implementation="ddpg", seed=0, scenarios=1,
+    )
+    assert _build_cfg(ns2).ddpg.actor_lr == 1e-4
