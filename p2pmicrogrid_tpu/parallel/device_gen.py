@@ -78,16 +78,32 @@ def device_scenario_traces(
 
 
 def device_episode_arrays(
-    cfg: ExperimentConfig, key: jax.Array, ratings: AgentRatings, n_scenarios: int
+    cfg: ExperimentConfig,
+    key: jax.Array,
+    ratings: AgentRatings,
+    n_scenarios: int,
+    scenario_sharding=None,
 ) -> EpisodeArrays:
     """Scenario-stacked EpisodeArrays ([S, T, ...]) synthesized on device.
 
     Applies the same agent-profile assignment and rating denormalization as
     data/traces.py:agent_profiles (agent i uses profile i %% P, scaled by its
     W rating; community.py:219-224) and the np.roll next-slot pairing.
+
+    ``scenario_sharding`` (a NamedSharding over the leading scenario axis)
+    constrains the generated leaves so a mesh-sharded chunk program computes
+    each scenario shard on its own device — the multi-chip path of the
+    chunked north star. GSPMD propagates the constraint through the slot
+    dynamics; host-built arrays get the same treatment via
+    ``mesh.shard_leading_axis`` instead.
     """
     A = cfg.sim.n_agents
     t, t_out, load, pv = device_scenario_traces(key, n_scenarios)
+    if scenario_sharding is not None:
+        constrain = lambda x: jax.lax.with_sharding_constraint(
+            x, scenario_sharding
+        )
+        t_out, load, pv = constrain(t_out), constrain(load), constrain(pv)
 
     if cfg.sim.homogeneous:
         idx = jnp.zeros((A,), dtype=jnp.int32)

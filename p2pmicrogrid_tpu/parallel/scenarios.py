@@ -769,6 +769,7 @@ def train_scenarios_chunked(
     chunk_key_fn: Optional[Callable] = None,
     episode_cb: Optional[Callable] = None,
     runner: Optional[Callable] = None,
+    scenario_sharding=None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
     Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
@@ -803,6 +804,14 @@ def train_scenarios_chunked(
     batch grows.
     """
     S = cfg.sim.n_scenarios
+    if scenario_sharding is not None and (
+        episode_fn is not None or runner is not None
+    ):
+        raise ValueError(
+            "scenario_sharding only applies to the default device-gen "
+            "episode program; a custom episode_fn/runner must apply its own "
+            "sharding constraints (device_episode_arrays(scenario_sharding=))"
+        )
     if episode_fn is None:
         from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
 
@@ -811,7 +820,12 @@ def train_scenarios_chunked(
             policy,
             None,
             ratings,
-            arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S),
+            # scenario_sharding (e.g. mesh.scenario_sharding(make_mesh()))
+            # pins each chunk's scenario shard to its own device — the
+            # multi-chip path; None runs single-device.
+            arrays_fn=lambda k: device_episode_arrays(
+                cfg, k, ratings, S, scenario_sharding=scenario_sharding
+            ),
             n_scenarios=S,
         )
     if chunk_key_fn is None:

@@ -15,6 +15,7 @@ Usage: ``PYTHONPATH=/root/repo python tools/learning_chunked.py``
 from __future__ import annotations
 
 import json
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -123,17 +124,33 @@ def run_variant(actor_lr: float, critic_lr: float) -> list:
             jnp.mean(reward, axis=-1), axis=0
         ).mean()
 
+    # One episode_fn + runner per variant: a fresh jit wrapper per
+    # train_scenarios_chunked call would recompile the chunk program every
+    # 20 episodes and fold compile time into the recorded train_secs.
+    from p2pmicrogrid_tpu.parallel.scenarios import (
+        make_chunked_episode_runner,
+        make_shared_episode_fn,
+    )
+
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S_CHUNK),
+        n_scenarios=S_CHUNK,
+    )
+    runner = make_chunked_episode_runner(cfg, episode_fn, K)
+
     curve = []
     c0, r0 = greedy_cost(params, jax.random.PRNGKey(1))
     curve.append({"episode": 0, "greedy_cost_eur": round(float(c0), 2),
                   "greedy_reward": round(float(r0), 1)})
-    print(curve[-1], flush=True)
+    print(curve[-1], file=sys.stderr, flush=True)
 
     key = jax.random.PRNGKey(7)
     for start in range(0, EPISODES, EVAL_EVERY):
         params, rewards, _, secs = train_scenarios_chunked(
             cfg, policy, params, ratings, key,
             n_episodes=EVAL_EVERY, n_chunks=K, episode0=start,
+            episode_fn=episode_fn, runner=runner,
         )
         c, r = greedy_cost(params, jax.random.PRNGKey(1))
         curve.append(
@@ -145,7 +162,7 @@ def run_variant(actor_lr: float, critic_lr: float) -> list:
                 "train_secs": round(secs, 1),
             }
         )
-        print(curve[-1], flush=True)
+        print(curve[-1], file=sys.stderr, flush=True)
     return curve
 
 
