@@ -130,19 +130,50 @@ def statistics_nr_rounds(
     return out
 
 
+def default_comparison_pairs(df) -> list:
+    """The reference's thesis comparisons, derived from whatever the results
+    table holds (data_analysis.py:1300-1330): each RL "com" run vs each of
+    its ``baseline-``-prefixed twins and vs its "no-com" counterparts.
+
+    Works on run LABELS, not bare settings: a setting holding several
+    implementations (e.g. tabular and dqn evaluated under one community
+    setting, or the two baseline kinds) contributes one label per
+    implementation, and every RL label pairs against every twin label.
+    """
+    by_setting = (
+        _labelled(df).groupby("setting")["label"].unique().to_dict()
+    )
+    pairs = []
+    for s in sorted(by_setting):
+        m = re.match(r"^([0-9]+)-multi-agent-com-rounds-[0-9]+-(homo|hetero)$", s)
+        if not m:
+            continue
+        nocom = f"{m.group(1)}-multi-agent-no-com-{m.group(2)}"
+        twins = sorted(by_setting.get(f"baseline-{s}", [])) + sorted(
+            by_setting.get(nocom, [])
+        )
+        for rl in sorted(by_setting[s]):
+            pairs.extend((rl, twin) for twin in twins)
+    return pairs
+
+
 def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]:
     """Run the available test battery over a ResultsStore's test results
     (the reference's ``statistical_tests`` driver, data_analysis.py:1440-1457).
 
     ``settings_pairs``: optional list of (setting_a, setting_b) for paired
-    t-tests. Scale/rounds analyses run when >= 2 matching settings exist.
+    t-tests; by default the reference's thesis comparisons are derived from
+    the table itself (``default_comparison_pairs``). Scale/rounds analyses
+    run when >= 2 matching settings exist.
     """
     df = store.get_test_results()
     results: Dict[str, Dict[str, float]] = {}
     if df.empty:
         return results
 
-    for a, b in settings_pairs or []:
+    if settings_pairs is None:
+        settings_pairs = default_comparison_pairs(df)
+    for a, b in settings_pairs:
         results[f"ttest[{a} vs {b}]"] = paired_cost_ttest(df, a, b)
 
     scale_settings = sorted(

@@ -137,6 +137,38 @@ class TestStats:
         )
 
 
+    def test_default_pairs_derive_thesis_comparisons(self, eval_run):
+        """Round-3 fix: with no explicit pairs, the battery derives the
+        reference's thesis comparisons (RL vs each baseline implementation,
+        com vs no-com) from the table itself instead of running nothing."""
+        from p2pmicrogrid_tpu.analysis.stats import default_comparison_pairs
+
+        _, store, days, outputs, day_arrays, _ = eval_run
+        extra = ResultsStore(":memory:")
+        rl = "2-multi-agent-com-rounds-1-hetero"
+        save_eval_outputs(extra, rl, "tabular", True, days, outputs, day_arrays)
+        save_eval_outputs(
+            extra, "2-multi-agent-no-com-hetero", "tabular", True,
+            days, outputs, day_arrays,
+        )
+        for impl in ("rule-based", "semi-intelligent"):
+            save_eval_outputs(
+                extra, f"baseline-{rl}", impl, True, days, outputs, day_arrays
+            )
+        pairs = default_comparison_pairs(extra.get_test_results())
+        assert (rl, f"baseline-{rl}[rule-based]") in pairs
+        assert (rl, f"baseline-{rl}[semi-intelligent]") in pairs
+        assert (rl, "2-multi-agent-no-com-hetero") in pairs
+        out = statistical_tests(extra)
+        assert any(k.startswith("ttest[") for k in out)
+        # A second RL implementation under the SAME setting must not silence
+        # the derivation: every RL label pairs against every twin.
+        save_eval_outputs(extra, rl, "dqn", True, days, outputs, day_arrays)
+        pairs2 = default_comparison_pairs(extra.get_test_results())
+        assert (f"{rl}[tabular]", f"baseline-{rl}[rule-based]") in pairs2
+        assert (f"{rl}[dqn]", "2-multi-agent-no-com-hetero") in pairs2
+
+
 class TestPlots:
     def test_all_plots_render(self, eval_run):
         cfg, store, days, _, _, ps = eval_run

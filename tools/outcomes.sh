@@ -1,0 +1,20 @@
+#!/bin/sh
+# Reference-experiment outcome replication (artifacts/OUTCOMES_r03.json):
+# train the reference's 2-agent tabular community (com + no-com variants,
+# 1000 episodes), evaluate greedily on the test days, run both baselines,
+# then the statistics battery — all through the public CLI.
+#
+# Usage: PYTHONPATH=/root/repo sh tools/outcomes.sh /tmp/outcomes
+set -e
+DIR="${1:-/tmp/outcomes}"
+mkdir -p "$DIR" && cd "$DIR"
+P="python -m p2pmicrogrid_tpu"
+COMMON="--agents 2 --results-db r.db --model-dir m --timing-json t.json"
+
+$P train $COMMON --episodes 1000 --jit-block 50
+$P train $COMMON --episodes 1000 --jit-block 50 --no-trading
+$P eval $COMMON --test
+$P eval $COMMON --test --no-trading
+$P baseline $COMMON --test
+$P baseline $COMMON --test --kind semi-intelligent
+$P analyse --results-db r.db --figures-dir figs --timing-json t.json --model-dir m
