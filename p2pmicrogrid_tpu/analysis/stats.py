@@ -59,6 +59,18 @@ def mean_cost_per_setting_agent(df):
     return out.rename(columns={"label": "setting"})
 
 
+def _ttest_from_table(table, setting_a: str, setting_b: str) -> Dict[str, float]:
+    costs = table[[setting_a, setting_b]].dropna()
+    diff = np.asarray(costs[setting_a]) - np.asarray(costs[setting_b])
+    t, p = stats.ttest_1samp(diff, 0)
+    return {
+        "mean_diff": float(diff.mean()),
+        "t": float(t),
+        "p": float(p),
+        "n_days": int(len(diff)),
+    }
+
+
 def paired_cost_ttest(
     df, setting_a: str, setting_b: str
 ) -> Dict[str, float]:
@@ -68,15 +80,7 @@ def paired_cost_ttest(
     (see ``_labelled``) — this is how baseline-vs-RL comparisons are keyed.
     Days present in only one run are dropped (and counted) rather than
     silently poisoning the test with NaN."""
-    costs = daily_cost_table(df)[[setting_a, setting_b]].dropna()
-    diff = np.asarray(costs[setting_a]) - np.asarray(costs[setting_b])
-    t, p = stats.ttest_1samp(diff, 0)
-    return {
-        "mean_diff": float(diff.mean()),
-        "t": float(t),
-        "p": float(p),
-        "n_days": int(len(diff)),
-    }
+    return _ttest_from_table(daily_cost_table(df), setting_a, setting_b)
 
 
 def statistics_community_scale(
@@ -173,25 +177,35 @@ def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]
 
     if settings_pairs is None:
         settings_pairs = default_comparison_pairs(df)
-    for a, b in settings_pairs:
-        results[f"ttest[{a} vs {b}]"] = paired_cost_ttest(df, a, b)
+    if settings_pairs:
+        table = daily_cost_table(df)  # one pivot for every derived pair
+        for a, b in settings_pairs:
+            results[f"ttest[{a} vs {b}]"] = _ttest_from_table(table, a, b)
 
+    # Scale analysis over a MATCHED family only — same com/rounds treatment,
+    # varying community size (the reference compares its rounds-1 com
+    # settings across sizes, data_analysis.py:1378-1401). Pooling e.g.
+    # no-com or rounds-3 runs into a size group would confound the test.
     scale_settings = sorted(
-        {
-            s
-            for s in df["setting"].unique()
-            if re.match(r"^[0-9]+-", s)
-        }
+        s
+        for s in df["setting"].unique()
+        if re.match(r"^[0-9]+-multi-agent-com-rounds-1-(homo|hetero)$", s)
     )
     if len({re.match(r"^([0-9]+)-", s).groups()[0] for s in scale_settings}) >= 2:
         results["community_scale"] = statistics_community_scale(df, scale_settings)
 
-    # Anchored: only RL-run settings (leading agent count), never the
-    # 'baseline-'-prefixed rows.
-    rounds_settings = [
-        s for s in df["setting"].unique() if re.match(r"^[0-9]+-.*rounds-[0-9]+", s)
-    ]
-    if len({re.search(r"rounds-([0-9]+)", s).groups()[0] for s in rounds_settings}) >= 2:
-        results["nr_rounds"] = statistics_nr_rounds(df, rounds_settings)
+    # Rounds analysis within ONE community size (the reference varies rounds
+    # at fixed size, data_analysis.py:1404-1437): pick the smallest size
+    # holding >= 2 distinct round counts.
+    by_size: Dict[str, list] = {}
+    for s in df["setting"].unique():
+        m = re.match(r"^([0-9]+)-multi-agent-com-rounds-[0-9]+-(homo|hetero)$", s)
+        if m:
+            by_size.setdefault(m.group(1), []).append(s)
+    for size in sorted(by_size, key=int):
+        group = sorted(by_size[size])
+        if len({re.search(r"rounds-([0-9]+)", s).groups()[0] for s in group}) >= 2:
+            results["nr_rounds"] = statistics_nr_rounds(df, group)
+            break
 
     return results

@@ -45,7 +45,14 @@ def eval_run():
     )
 
     store = ResultsStore(":memory:")
-    for setting in ("2-multi-agent-com-rounds-1-hetero", "3-multi-agent-com-rounds-2-hetero"):
+    # Matched families: scale varies at fixed rounds-1; rounds vary at fixed
+    # 2-agent size (the confounded-pool gating in statistical_tests requires
+    # this, mirroring the reference's experiment design).
+    for setting in (
+        "2-multi-agent-com-rounds-1-hetero",
+        "3-multi-agent-com-rounds-1-hetero",
+        "2-multi-agent-com-rounds-3-hetero",
+    ):
         save_eval_outputs(store, setting, "tabular", True, days, outputs, day_arrays)
         save_eval_outputs(store, setting, "tabular", False, days, outputs, day_arrays)
     for ep in range(0, 200, 50):
@@ -77,7 +84,7 @@ class TestResultsStore:
         _, store, days, outputs, _, _ = eval_run
         df = store.get_test_results()
         n_days, T, A = np.asarray(outputs.cost).shape
-        assert len(df) == 2 * n_days * T * A  # two settings
+        assert len(df) == 3 * n_days * T * A  # three settings
         # Costs survive the round trip.
         got = df[
             (df["setting"] == "2-multi-agent-com-rounds-1-hetero")
@@ -122,7 +129,7 @@ class TestStats:
         _, store, *_ = eval_run
         df = store.get_test_results()
         r = paired_cost_ttest(
-            df, "2-multi-agent-com-rounds-1-hetero", "3-multi-agent-com-rounds-2-hetero"
+            df, "2-multi-agent-com-rounds-1-hetero", "3-multi-agent-com-rounds-1-hetero"
         )
         # Identical data -> zero diff, p is nan (0/0) or 1; mean_diff must be 0.
         assert r["mean_diff"] == pytest.approx(0.0)

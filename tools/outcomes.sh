@@ -17,4 +17,14 @@ $P eval $COMMON --test
 $P eval $COMMON --test --no-trading
 $P baseline $COMMON --test
 $P baseline $COMMON --test --kind semi-intelligent
+
+# Scale and negotiation-round variants: population for the community-scale
+# and nr-rounds Levene/ANOVA analyses (reference data_analysis.py:1378-1437).
+SCALE="--agents 5 --results-db r.db --model-dir m --timing-json t.json"
+$P train $SCALE --episodes 1000 --jit-block 50
+$P eval $SCALE --test
+ROUNDS="--agents 2 --rounds 3 --results-db r.db --model-dir m --timing-json t.json"
+$P train $ROUNDS --episodes 1000 --jit-block 50
+$P eval $ROUNDS --test
+
 $P analyse --results-db r.db --figures-dir figs --timing-json t.json --model-dir m
