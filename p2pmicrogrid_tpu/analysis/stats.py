@@ -182,28 +182,33 @@ def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]
         for a, b in settings_pairs:
             results[f"ttest[{a} vs {b}]"] = _ttest_from_table(table, a, b)
 
-    # Scale analysis over a MATCHED family only — same com/rounds treatment,
-    # varying community size (the reference compares its rounds-1 com
-    # settings across sizes, data_analysis.py:1378-1401). Pooling e.g.
-    # no-com or rounds-3 runs into a size group would confound the test.
-    scale_settings = sorted(
-        s
-        for s in df["setting"].unique()
-        if re.match(r"^[0-9]+-multi-agent-com-rounds-1-(homo|hetero)$", s)
-    )
-    if len({re.match(r"^([0-9]+)-", s).groups()[0] for s in scale_settings}) >= 2:
-        results["community_scale"] = statistics_community_scale(df, scale_settings)
+    # Scale analysis over a MATCHED family only — same com/rounds/population
+    # treatment, varying community size ONLY (the reference compares its
+    # rounds-1 com settings across sizes, data_analysis.py:1378-1401).
+    # Pooling no-com / rounds-3 / homo-vs-hetero runs into a size group
+    # would confound the test; heterogeneity is pinned per pool like rounds.
+    for hom in ("hetero", "homo"):
+        scale_settings = sorted(
+            s
+            for s in df["setting"].unique()
+            if re.match(rf"^[0-9]+-multi-agent-com-rounds-1-{hom}$", s)
+        )
+        if len({re.match(r"^([0-9]+)-", s).groups()[0] for s in scale_settings}) >= 2:
+            results["community_scale"] = statistics_community_scale(
+                df, scale_settings
+            )
+            break
 
-    # Rounds analysis within ONE community size (the reference varies rounds
-    # at fixed size, data_analysis.py:1404-1437): pick the smallest size
-    # holding >= 2 distinct round counts.
-    by_size: Dict[str, list] = {}
+    # Rounds analysis within ONE (community size, population) cell (the
+    # reference varies rounds at fixed size, data_analysis.py:1404-1437):
+    # pick the smallest cell holding >= 2 distinct round counts.
+    by_cell: Dict[tuple, list] = {}
     for s in df["setting"].unique():
         m = re.match(r"^([0-9]+)-multi-agent-com-rounds-[0-9]+-(homo|hetero)$", s)
         if m:
-            by_size.setdefault(m.group(1), []).append(s)
-    for size in sorted(by_size, key=int):
-        group = sorted(by_size[size])
+            by_cell.setdefault((int(m.group(1)), m.group(2)), []).append(s)
+    for cell in sorted(by_cell):
+        group = sorted(by_cell[cell])
         if len({re.search(r"rounds-([0-9]+)", s).groups()[0] for s in group}) >= 2:
             results["nr_rounds"] = statistics_nr_rounds(df, group)
             break

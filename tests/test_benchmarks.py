@@ -104,3 +104,17 @@ def test_bench_survives_simulated_backend_outage():
     # CPU fallback must label honestly: host, not chip, throughput.
     assert final["unit"] == "env-steps/sec/host"
     assert final["device"] == "cpu"
+
+
+def test_blocked_measurement_path_runs():
+    """scenario_steps_per_sec(episode_block>1) — the steady-state measurement
+    path the batched benches use — compiles and yields a positive rate."""
+    from p2pmicrogrid_tpu.benchmarks import scenario_steps_per_sec
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=2, n_scenarios=2),
+        train=TrainConfig(implementation="tabular"),
+    )
+    rate = scenario_steps_per_sec(cfg, 2, 2, episode_block=2)
+    assert rate > 0
