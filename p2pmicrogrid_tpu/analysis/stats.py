@@ -194,10 +194,18 @@ def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]
             if re.match(rf"^[0-9]+-multi-agent-com-rounds-1-{hom}$", s)
         )
         if len({re.match(r"^([0-9]+)-", s).groups()[0] for s in scale_settings}) >= 2:
-            results["community_scale"] = statistics_community_scale(
-                df, scale_settings
+            # First qualifying pool takes the canonical key; a second
+            # population's pool gets its own key — which population each
+            # analysis covers is recorded either way.
+            key = (
+                "community_scale"
+                if "community_scale" not in results
+                else f"community_scale_{hom}"
             )
-            break
+            results[key] = {
+                **statistics_community_scale(df, scale_settings),
+                "population": hom,
+            }
 
     # Rounds analysis within ONE (community size, population) cell (the
     # reference varies rounds at fixed size, data_analysis.py:1404-1437):

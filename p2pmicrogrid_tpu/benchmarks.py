@@ -964,7 +964,7 @@ def main() -> None:
     backend = ensure_backend()
     print(f"bench: backend resolved to {backend}", file=sys.stderr, flush=True)
 
-    headline = None  # last successful row in BENCHES order (cfg4 when it runs)
+    headline = None  # last successful row in BENCHES order (the north star)
     for name in BENCHES:
         if name not in selected:
             continue
@@ -980,6 +980,24 @@ def main() -> None:
                 "error": f"{type(err).__name__}: {err}"[:300],
             }
         print(json.dumps(row), flush=True)
+        # Drop the finished bench's compiled executables and cached buffers:
+        # letting them accumulate leaves the last (largest) benches to run
+        # under device-memory pressure — a single-session suite run measured
+        # the 1000-agent north star 3.7x slower than the same program in a
+        # fresh process until this was added.
+        try:
+            import jax
+
+            jax.clear_caches()
+        except Exception as err:  # noqa: BLE001
+            # A failed clear re-introduces the documented memory-pressure
+            # regression — make a degraded capture detectable.
+            print(
+                f"bench: jax.clear_caches() failed ({type(err).__name__}: "
+                f"{err}); later benches may run under cache pressure",
+                file=sys.stderr,
+                flush=True,
+            )
     # The driver parses the LAST stdout line: when the final bench failed but
     # earlier ones succeeded, close with the best successful row (a duplicate
     # line is harmless; a value-0 error row as the round's number is not).
