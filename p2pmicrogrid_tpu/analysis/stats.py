@@ -207,9 +207,13 @@ def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]
                 "population": hom,
             }
 
-    # Rounds analysis within ONE (community size, population) cell (the
-    # reference varies rounds at fixed size, data_analysis.py:1404-1437):
-    # pick the smallest cell holding >= 2 distinct round counts.
+    # Rounds analysis within ONE (community size, population) cell at a time
+    # (the reference varies rounds at fixed size, data_analysis.py:1404-1437).
+    # EVERY qualifying cell gets analyzed — the smallest takes the canonical
+    # key (mirrors the community_scale convention above), the rest get
+    # nr_rounds_{size}_{population} keys — and each records which cell it
+    # covers, so a DB holding e.g. both 2- and 3-agent round families yields
+    # both analyses instead of silently dropping one (round-3 advisor).
     by_cell: Dict[tuple, list] = {}
     for s in df["setting"].unique():
         m = re.match(r"^([0-9]+)-multi-agent-com-rounds-[0-9]+-(homo|hetero)$", s)
@@ -218,7 +222,14 @@ def statistical_tests(store, settings_pairs=None) -> Dict[str, Dict[str, float]]
     for cell in sorted(by_cell):
         group = sorted(by_cell[cell])
         if len({re.search(r"rounds-([0-9]+)", s).groups()[0] for s in group}) >= 2:
-            results["nr_rounds"] = statistics_nr_rounds(df, group)
-            break
+            key = (
+                "nr_rounds"
+                if "nr_rounds" not in results
+                else f"nr_rounds_{cell[0]}_{cell[1]}"
+            )
+            results[key] = {
+                **statistics_nr_rounds(df, group),
+                "cell": {"n_agents": cell[0], "population": cell[1]},
+            }
 
     return results

@@ -599,7 +599,7 @@ def bench_northstar() -> dict:
         default_config,
     )
     from p2pmicrogrid_tpu.envs import make_ratings
-    from p2pmicrogrid_tpu.parallel import init_shared_state, init_scen_state_only
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
     from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
     from p2pmicrogrid_tpu.parallel.scenarios import (
         make_shared_episode_fn,
@@ -619,7 +619,10 @@ def bench_northstar() -> dict:
     ratings = make_ratings(cfg, np.random.default_rng(42))
     policy = make_policy(cfg)
     key = jax.random.PRNGKey(0)
-    ps, _ = init_shared_state(cfg, key)
+    # Only the learnable bundle: the chunked trainer seeds per-chunk replay
+    # itself, and a full init_shared_state would park an unused [96, 128,
+    # 1000, ...] replay in HBM for the whole measured run.
+    ps = init_shared_pol_state(cfg, key)
     episode_fn = make_shared_episode_fn(
         cfg,
         policy,

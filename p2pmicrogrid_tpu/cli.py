@@ -47,6 +47,13 @@ def _build_cfg(args) -> "ExperimentConfig":
         battery=BatteryConfig(enabled=args.battery),
         ddpg=DDPGConfig(
             share_across_agents=getattr(args, "share_agents", False),
+            # Explicit lr flags pin the lrs exactly: the pooled-batch
+            # auto-scaling rule (parallel/scenarios.py:auto_scale_ddpg_lrs)
+            # must not rescale a user-chosen value.
+            lr_auto_scale=(
+                getattr(args, "actor_lr", None) is None
+                and getattr(args, "critic_lr", None) is None
+            ),
             **{
                 k: v
                 for k, v in (

@@ -178,6 +178,14 @@ class DDPGConfig:
     # as A vmapped [S, 4] matmuls; agent-shared runs one [S*A, 4] matmul,
     # which is what actually fills the MXU at 1000 agents.
     share_across_agents: bool = False
+    # Scale actor/critic lrs down automatically with the pooled update batch
+    # (batch_size * n_scenarios * n_agents in agent-shared mode): at the
+    # defaults the pooled update over-drives the critic and training diverges
+    # once the pool is large (measured, artifacts/LEARNING_chunked_r03.json).
+    # The rule lives in parallel/scenarios.py:auto_scale_ddpg_lrs and applies
+    # only to shared-parameter scenario training; explicit --actor-lr /
+    # --critic-lr on the CLI disables it.
+    lr_auto_scale: bool = True
 
 
 @dataclass(frozen=True)

@@ -479,6 +479,57 @@ def _ddpg_update_shared(
     return new_params, scen._replace(replay=replay_s), loss
 
 
+# Pooled-batch lr rule calibration (round 4; artifacts/lr_probe_a100.json,
+# artifacts/lr_probe_a1000.json, artifacts/LEARNING_northstar_r04.json).
+# Below DDPG_LR_REF_POOLED pooled transitions per update the config lrs hold
+# unchanged; above it the stable step size falls off as pooled^(-DDPG_LR_EXP).
+DDPG_LR_REF_POOLED = 1600.0
+DDPG_LR_EXP = 0.5
+
+
+def ddpg_pooled_batch(cfg: ExperimentConfig, n_scenarios: Optional[int] = None) -> int:
+    """Transitions pooled into ONE shared-DDPG gradient step per slot:
+    ``batch_size * S`` per agent-batched update, ``* n_agents`` more when one
+    actor-critic is shared across agents (``share_across_agents``)."""
+    S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
+    A = cfg.sim.n_agents if cfg.ddpg.share_across_agents else 1
+    return cfg.ddpg.batch_size * S * A
+
+
+def auto_scale_ddpg_lrs(
+    cfg: ExperimentConfig, n_scenarios: Optional[int] = None
+) -> ExperimentConfig:
+    """Scale actor/critic lrs down with the pooled update batch.
+
+    The reference's per-agent DDPG update consumes ``batch_size`` transitions
+    (rl_backup.py:96); the scenario-pooled shared update consumes
+    ``batch_size*S*A``. At the default lrs that pooled step over-drives the
+    critic — training converges early then diverges (measured at A=100:
+    artifacts/LEARNING_chunked_r03.json) — so past a calibrated pooled size
+    the lrs shrink as ``(ref_pooled / pooled) ** exp``. Returns ``cfg``
+    unchanged when ``lr_auto_scale`` is off, the pool is small, or the
+    implementation is not ddpg. Pure config→config; callers build optimizers
+    from the result (Adam opt state itself is lr-independent, so the rule
+    composes with checkpoints saved at other lrs).
+    """
+    if cfg.train.implementation != "ddpg" or not cfg.ddpg.lr_auto_scale:
+        return cfg
+    pooled = ddpg_pooled_batch(cfg, n_scenarios)
+    if pooled <= DDPG_LR_REF_POOLED:
+        return cfg
+    import dataclasses
+
+    scale = (DDPG_LR_REF_POOLED / pooled) ** DDPG_LR_EXP
+    return dataclasses.replace(
+        cfg,
+        ddpg=dataclasses.replace(
+            cfg.ddpg,
+            actor_lr=cfg.ddpg.actor_lr * scale,
+            critic_lr=cfg.ddpg.critic_lr * scale,
+        ),
+    )
+
+
 def init_scen_state_only(
     cfg: ExperimentConfig, key: jax.Array, n_scenarios: Optional[int] = None
 ):
@@ -580,6 +631,10 @@ def make_shared_episode_fn(
         raise ValueError("arrays_fn requires an explicit n_scenarios")
     if arrays_s is not None:
         n_scenarios = arrays_s.time.shape[0]
+    # Pooled-batch lr rule (docstring of auto_scale_ddpg_lrs): the episode
+    # program bakes the *effective* lrs in; greedy eval / acting is
+    # lr-independent so only this training closure needs the scaled config.
+    cfg = auto_scale_ddpg_lrs(cfg, n_scenarios)
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
 
     if impl == "ddpg":
@@ -722,12 +777,24 @@ def train_scenarios_shared(
 
 
 def make_chunked_episode_runner(
-    cfg: ExperimentConfig, episode_fn: Callable, n_chunks: int
+    cfg: ExperimentConfig,
+    episode_fn: Callable,
+    n_chunks: int,
+    warmup_fn: Optional[Callable] = None,
 ) -> Callable:
     """The jitted K-chunk episode: ONE device call — a ``lax.scan`` over
     chunk keys whose body runs the chunk episode from θ₀ and accumulates its
     parameter delta (per-chunk host dispatches through the tunneled runtime
     cost ~0.1 s each — at K=80 that was ~10% of the episode).
+
+    ``warmup_fn`` (a ``make_shared_episode_fn(..., record_only=True)``
+    program) runs ``cfg.dqn.warmup_passes`` record-only episodes on each
+    chunk's FRESH replay before its learning episode — the per-chunk mirror
+    of the reference's ``init_buffers`` (community.py:125-147). Without it a
+    fresh chunk replay starts empty and early-slot updates resample the
+    first few transitions, silently diverging from ``--chunks 1`` semantics
+    (round-3 advisor finding); ``train_scenarios_chunked`` builds it
+    automatically for dqn.
 
     Signature: ``runner(theta0, chunk_keys [K, 2]) -> (theta',
     rewards [K*S], losses [K*S])``. Built once and reused across
@@ -740,6 +807,18 @@ def make_chunked_episode_runner(
         def body(acc, kc):
             k_scen, k_ep = jax.random.split(kc)
             scen = init_scen_state_only(cfg, k_scen)
+            if warmup_fn is not None and cfg.dqn.warmup_passes > 0:
+                k_warm = jax.random.split(k_ep, cfg.dqn.warmup_passes + 1)
+
+                def warm(carry, k):
+                    carry, _ = warmup_fn(carry, k)
+                    return carry, None
+
+                # record_only leaves theta untouched; only scen (replay) fills.
+                (_, scen), _ = jax.lax.scan(
+                    warm, (theta0, scen), k_warm[:-1]
+                )
+                k_ep = k_warm[-1]
             (theta_c, _), (r, l) = episode_fn((theta0, scen), k_ep)
             acc = jax.tree_util.tree_map(
                 lambda a, n, o: a + (n - o), acc, theta_c, theta0
@@ -799,9 +878,11 @@ def train_scenarios_chunked(
     Step-size note (measured, artifacts/LEARNING_chunked_r03.json): the
     pooled DDPG batch is ``batch_size * S * A`` transitions per slot — at
     the DDPG default lrs the critic over-drives and training diverges after
-    early convergence; a quarter of the default (actor 2.5e-5, critic 5e-5)
-    is stable for 100-agent chunked runs. Scale the lrs down as the pooled
-    batch grows.
+    early convergence. The default episode program therefore applies the
+    pooled-batch lr rule automatically (``auto_scale_ddpg_lrs``, baked in by
+    ``make_shared_episode_fn``; disable with ``DDPGConfig.lr_auto_scale=False``
+    or explicit CLI lr flags). A custom prebuilt ``episode_fn`` carries
+    whatever lrs its own config had at build time.
     """
     S = cfg.sim.n_scenarios
     if scenario_sharding is not None and (
@@ -812,28 +893,37 @@ def train_scenarios_chunked(
             "episode program; a custom episode_fn/runner must apply its own "
             "sharding constraints (device_episode_arrays(scenario_sharding=))"
         )
+    warmup_fn = None
     if episode_fn is None:
         from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
 
-        episode_fn = make_shared_episode_fn(
-            cfg,
-            policy,
-            None,
-            ratings,
+        arrays_fn = lambda k: device_episode_arrays(
             # scenario_sharding (e.g. mesh.scenario_sharding(make_mesh()))
             # pins each chunk's scenario shard to its own device — the
             # multi-chip path; None runs single-device.
-            arrays_fn=lambda k: device_episode_arrays(
-                cfg, k, ratings, S, scenario_sharding=scenario_sharding
-            ),
-            n_scenarios=S,
+            cfg, k, ratings, S, scenario_sharding=scenario_sharding
         )
+        episode_fn = make_shared_episode_fn(
+            cfg, policy, None, ratings, arrays_fn=arrays_fn, n_scenarios=S
+        )
+        if cfg.train.implementation == "dqn" and cfg.dqn.warmup_passes > 0:
+            # Per-chunk replay warmup (see make_chunked_episode_runner): a
+            # chunk's fresh replay gets the reference's record-only
+            # init_buffers passes before its learning episode. Only built on
+            # this default path — a caller-prebuilt episode_fn must pass its
+            # own warmup_fn/runner if it wants warmed chunks.
+            warmup_fn = make_shared_episode_fn(
+                cfg, policy, None, ratings, arrays_fn=arrays_fn,
+                n_scenarios=S, record_only=True,
+            )
     if chunk_key_fn is None:
         chunk_key_fn = lambda k, e, c: jax.random.fold_in(
             jax.random.fold_in(k, e), c
         )
     if runner is None:
-        runner = make_chunked_episode_runner(cfg, episode_fn, n_chunks)
+        runner = make_chunked_episode_runner(
+            cfg, episode_fn, n_chunks, warmup_fn=warmup_fn
+        )
     run_chunks = runner
 
     decay_every = cfg.train.min_episodes_criterion

@@ -117,7 +117,18 @@ def _graft_old_checkpoint(template, raw):
             extra.append(f"{path} is a container, expected array leaf")
             return tpl
         tpl_arr = np.asarray(tpl)
-        arr = np.asarray(node, dtype=tpl_arr.dtype)
+        src = np.asarray(node)
+        if src.dtype != tpl_arr.dtype and (
+            src.dtype.itemsize > tpl_arr.dtype.itemsize
+            or src.dtype.kind != tpl_arr.dtype.kind
+        ):
+            # A narrowing (or kind-changing) cast loses checkpoint precision
+            # silently — surface it through the same warning channel as
+            # missing fields so a lossy restore is visible (round-3 advisor).
+            grafted.append(
+                f"{path} dtype {src.dtype.name}->{tpl_arr.dtype.name} (narrowed)"
+            )
+        arr = src.astype(tpl_arr.dtype) if src.dtype != tpl_arr.dtype else src
         if arr.shape != tpl_arr.shape:
             extra.append(f"{path} shape {arr.shape} != {tpl_arr.shape}")
         return arr
@@ -175,8 +186,9 @@ def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
         import warnings
 
         warnings.warn(
-            f"checkpoint {step_path} predates fields {grafted}; restored "
-            f"with their init defaults",
+            f"checkpoint {step_path} is an older-version state ({grafted}); "
+            f"missing fields restored at their init defaults, narrowed "
+            f"dtypes cast to the template dtype",
             stacklevel=2,
         )
         restored = {"pol_state": pol_state, "episode": raw.get("episode", 0)}

@@ -169,6 +169,201 @@ class TestChunkedTraining:
             assert np.asarray(a).dtype == np.asarray(b).dtype
 
 
+class TestLrAutoScale:
+    """The pooled-batch lr rule (scenarios.py:auto_scale_ddpg_lrs): shared
+    DDPG lrs shrink as (ref_pooled / pooled)^exp once the pooled update batch
+    (batch*S*A) exceeds the calibrated reference pool — the automatic form of
+    the round-3 measured divergence fix (LEARNING_chunked_r03.json)."""
+
+    def test_large_pool_scales_down(self):
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            DDPG_LR_EXP,
+            DDPG_LR_REF_POOLED,
+            auto_scale_ddpg_lrs,
+            ddpg_pooled_batch,
+        )
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=100, n_scenarios=64),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(batch_size=4, share_across_agents=True),
+        )
+        pooled = ddpg_pooled_batch(cfg)
+        assert pooled == 4 * 64 * 100
+        scaled = auto_scale_ddpg_lrs(cfg)
+        expect = (DDPG_LR_REF_POOLED / pooled) ** DDPG_LR_EXP
+        assert scaled.ddpg.actor_lr == pytest.approx(cfg.ddpg.actor_lr * expect)
+        assert scaled.ddpg.critic_lr == pytest.approx(
+            cfg.ddpg.critic_lr * expect
+        )
+        # The critic/actor ratio (reference rl.py:596-597) is preserved.
+        assert scaled.ddpg.critic_lr / scaled.ddpg.actor_lr == pytest.approx(
+            cfg.ddpg.critic_lr / cfg.ddpg.actor_lr
+        )
+
+    def test_small_pool_unchanged(self):
+        from p2pmicrogrid_tpu.parallel.scenarios import auto_scale_ddpg_lrs
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=2, n_scenarios=2),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(batch_size=4, share_across_agents=True),
+        )
+        assert auto_scale_ddpg_lrs(cfg) is cfg
+
+    def test_per_agent_pool_has_no_agent_factor(self):
+        from p2pmicrogrid_tpu.parallel.scenarios import ddpg_pooled_batch
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=100, n_scenarios=64),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(batch_size=4, share_across_agents=False),
+        )
+        assert ddpg_pooled_batch(cfg) == 4 * 64
+
+    def test_opt_out_and_non_ddpg_untouched(self):
+        from p2pmicrogrid_tpu.parallel.scenarios import auto_scale_ddpg_lrs
+
+        pinned = default_config(
+            sim=SimConfig(n_agents=100, n_scenarios=64),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(
+                batch_size=4, share_across_agents=True, lr_auto_scale=False
+            ),
+        )
+        assert auto_scale_ddpg_lrs(pinned) is pinned
+        tab = _cfg(impl="tabular", S=64, A=100)
+        assert auto_scale_ddpg_lrs(tab) is tab
+
+    def test_episode_fn_bakes_scaled_lrs(self):
+        """Two identically-seeded single-episode runs, one with the rule and
+        one pinned at the rule's output lrs, must produce identical params —
+        proof the episode program actually consumed the scaled lrs."""
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            auto_scale_ddpg_lrs,
+            train_scenarios_shared,
+        )
+
+        S, A = 80, 5  # pooled = 8*80*5 = 3200 > DDPG_LR_REF_POOLED (1600)
+        import dataclasses
+
+        base = default_config(
+            sim=SimConfig(n_agents=A, n_scenarios=S),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(buffer_size=16, batch_size=8,
+                            share_across_agents=True),
+        )
+        scaled_cfg = auto_scale_ddpg_lrs(base)
+        assert scaled_cfg.ddpg.actor_lr < base.ddpg.actor_lr
+        pinned = dataclasses.replace(
+            base,
+            ddpg=dataclasses.replace(
+                base.ddpg,
+                actor_lr=scaled_cfg.ddpg.actor_lr,
+                critic_lr=scaled_cfg.ddpg.critic_lr,
+                lr_auto_scale=False,
+            ),
+        )
+        ratings = make_ratings(base, np.random.default_rng(0))
+        policy = make_policy(base)
+        from p2pmicrogrid_tpu.parallel import stack_scenario_arrays
+        from p2pmicrogrid_tpu.parallel.scenarios import make_scenario_traces
+
+        traces = make_scenario_traces(base, S)
+        arrays = stack_scenario_arrays(base, traces, ratings)
+        outs = []
+        for cfg in (base, pinned):
+            ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+            out, _, _, _, _ = train_scenarios_shared(
+                cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(1),
+                n_episodes=1, replay_s=scen,
+            )
+            outs.append(out)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(outs[0]), jax.tree_util.tree_leaves(outs[1])
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestChunkedDqnWarmup:
+    def test_record_only_pass_fills_replay(self):
+        """The warmup mechanism itself: a record_only episode must advance
+        the replay count by one full episode of transitions and leave the
+        parameters untouched (reference init_buffers semantics)."""
+        from p2pmicrogrid_tpu.config import DQNConfig
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+        from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=3, n_scenarios=2),
+            train=TrainConfig(implementation="dqn"),
+            dqn=DQNConfig(buffer_size=200, batch_size=2, warmup_passes=1),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        warmup_fn = make_shared_episode_fn(
+            cfg, policy, None, ratings,
+            arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, 2),
+            n_scenarios=2, record_only=True,
+        )
+        (ps2, scen2), _ = warmup_fn((ps, scen), jax.random.PRNGKey(1))
+        assert int(scen.count) == 0
+        assert int(scen2.count) == cfg.sim.slots_per_day
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ps), jax.tree_util.tree_leaves(ps2)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_warmup_changes_training_and_stays_finite(self):
+        """The default chunked-DQN path warms each chunk's fresh replay with
+        record-only passes (reference init_buffers, community.py:125-147);
+        an unwarmed custom-runner run from the same keys must differ."""
+        from p2pmicrogrid_tpu.config import DQNConfig
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+        from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=3, n_scenarios=2),
+            train=TrainConfig(implementation="dqn"),
+            dqn=DQNConfig(buffer_size=16, batch_size=2, warmup_passes=1),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+
+        warmed, _, losses, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=2,
+        )
+        assert np.isfinite(losses).all()
+
+        # Same keys, runner WITHOUT warmup: different replay contents at the
+        # early slots -> different parameters out.
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            make_chunked_episode_runner,
+        )
+
+        episode_fn = make_shared_episode_fn(
+            cfg, policy, None, ratings,
+            arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, 2),
+            n_scenarios=2,
+        )
+        runner = make_chunked_episode_runner(cfg, episode_fn, 2)
+        unwarmed, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=2, episode_fn=episode_fn, runner=runner,
+        )
+        w = np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(warmed)]
+        )
+        u = np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(unwarmed)]
+        )
+        assert not np.allclose(w, u)
+
+
 class TestChunkedOnMesh:
     def test_sharded_chunked_matches_unsharded(self):
         """The chunked north star's multi-chip path: constraining the

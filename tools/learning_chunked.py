@@ -80,6 +80,9 @@ def run_variant(actor_lr: float, critic_lr: float) -> list:
         ddpg=DDPGConfig(
             buffer_size=96, batch_size=4, share_across_agents=True,
             actor_lr=actor_lr, critic_lr=critic_lr,
+            # This tool A/B-compares PINNED lrs; the pooled-batch auto rule
+            # (scenarios.py:auto_scale_ddpg_lrs) must not rescale them.
+            lr_auto_scale=False,
         ),
     )
     ratings = make_ratings(cfg, np.random.default_rng(42))

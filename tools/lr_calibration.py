@@ -1,0 +1,191 @@
+"""Calibrate the chunked shared-DDPG learning-rate scale against scale.
+
+Round 3 measured (artifacts/LEARNING_chunked_r03.json) that the DDPG default
+lrs (1e-4/2e-4) diverge in chunked aggregate-scenario mode at 100 agents
+(pooled update batch = batch*S*A = 25.6k transitions) while lr/4 is stable.
+To turn that observation into a default RULE (scale lrs automatically with
+the pooled batch, round-3 VERDICT item 1) we need the stable lr at more than
+one pooled-batch size.  This tool trains the chunked shared-critic community
+at a given (A, S_chunk, K) for several lr scales and records the greedy
+held-out community cost curve per scale; the cross-scale fit picks the rule.
+
+Usage::
+
+    PYTHONPATH=/root/repo python tools/lr_calibration.py \
+        --agents 1000 --chunk-scenarios 128 --chunks 4 \
+        --episodes 120 --eval-every 20 --scales 0.25,0.125,0.056 \
+        --out artifacts/lr_probe_a1000.json
+
+Emits incremental progress on stderr and one JSON document on --out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import (
+    BatteryConfig,
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import init_physical, make_ratings
+from p2pmicrogrid_tpu.envs.community import AgentRatings, slot_dynamics_batched
+from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    make_chunked_episode_runner,
+    make_shared_episode_fn,
+    train_scenarios_chunked,
+)
+from p2pmicrogrid_tpu.train import make_policy
+
+
+def build_cfg(args, scale: float):
+    return default_config(
+        sim=SimConfig(
+            n_agents=args.agents,
+            n_scenarios=args.chunk_scenarios,
+            market_dtype=args.market_dtype,
+        ),
+        battery=BatteryConfig(enabled=True),
+        train=TrainConfig(implementation="ddpg"),
+        ddpg=DDPGConfig(
+            buffer_size=96,
+            batch_size=4,
+            share_across_agents=True,
+            actor_lr=1e-4 * scale,
+            critic_lr=2e-4 * scale,
+            lr_auto_scale=False,  # this tool IS the calibration of that rule
+        ),
+    )
+
+
+def run_scale(args, scale: float) -> list:
+    cfg = build_cfg(args, scale)
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    policy = make_policy(cfg)
+    params = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+    S_eval = args.eval_scenarios
+
+    eval_arrays = device_episode_arrays(
+        cfg, jax.random.PRNGKey(10_000), ratings, S_eval
+    )
+
+    @jax.jit
+    def greedy_cost(params, key):
+        def act_fn(p, obs_s, prev, round_key, ex):
+            frac, q, _ = ddpg_shared_act(
+                cfg.ddpg, p, obs_s, jnp.zeros(obs_s.shape[:2]),
+                round_key, explore=False,
+            )
+            return frac, frac, q, ex
+
+        k_phys, k_scan = jax.random.split(key)
+        phys = jax.vmap(lambda k: init_physical(cfg, k))(
+            jax.random.split(k_phys, S_eval)
+        )
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), eval_arrays)
+        xs = (xs.time, xs.t_out, xs.load_w, xs.pv_w,
+              xs.next_time, xs.next_load_w, xs.next_pv_w)
+
+        def slot(carry, xs_t):
+            phys_s, kk = carry
+            kk, k_act = jax.random.split(kk)
+            phys_s, _, out, _, _ = slot_dynamics_batched(
+                cfg, policy, params, phys_s, xs_t, k_act, ratings_j,
+                explore=False, act_fn=act_fn,
+            )
+            return (phys_s, kk), (out.cost, out.reward)
+
+        (_, _), (cost, reward) = jax.lax.scan(slot, (phys, k_scan), xs)
+        return jnp.sum(cost, axis=(0, 2)).mean(), jnp.sum(
+            jnp.mean(reward, axis=-1), axis=0
+        ).mean()
+
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(
+            cfg, k, ratings, args.chunk_scenarios
+        ),
+        n_scenarios=args.chunk_scenarios,
+    )
+    runner = make_chunked_episode_runner(cfg, episode_fn, args.chunks)
+
+    curve = []
+
+    def record(ep, extra=None):
+        c, r = greedy_cost(params, jax.random.PRNGKey(1))
+        row = {"episode": ep, "greedy_cost_eur": round(float(c), 2),
+               "greedy_reward": round(float(r), 1)}
+        row.update(extra or {})
+        curve.append(row)
+        print(f"scale={scale}", row, file=sys.stderr, flush=True)
+
+    record(0)
+    key = jax.random.PRNGKey(7)
+    for start in range(0, args.episodes, args.eval_every):
+        params, rewards, _, secs = train_scenarios_chunked(
+            cfg, policy, params, ratings, key,
+            n_episodes=args.eval_every, n_chunks=args.chunks, episode0=start,
+            episode_fn=episode_fn, runner=runner,
+        )
+        record(start + args.eval_every, {
+            "train_reward_mean": round(float(np.mean(rewards[-5:])), 1),
+            "train_secs": round(secs, 1),
+        })
+    return curve
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--agents", type=int, default=100)
+    ap.add_argument("--chunk-scenarios", type=int, default=64)
+    ap.add_argument("--chunks", type=int, default=4)
+    ap.add_argument("--episodes", type=int, default=120)
+    ap.add_argument("--eval-every", type=int, default=20)
+    ap.add_argument("--eval-scenarios", type=int, default=8)
+    ap.add_argument("--scales", default="0.25,0.125")
+    ap.add_argument("--market-dtype", default="float32")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    scales = [float(s) for s in args.scales.split(",")]
+    pooled = 4 * args.chunk_scenarios * args.agents
+    doc = {
+        "what": (
+            "Greedy held-out cost curves for chunked shared-critic DDPG at "
+            "several lr scales (x the 1e-4/2e-4 defaults) — calibration data "
+            "for the automatic pooled-batch lr rule."
+        ),
+        "config": {
+            "n_agents": args.agents,
+            "chunk_scenarios": args.chunk_scenarios,
+            "chunks": args.chunks,
+            "pooled_batch": pooled,
+            "episodes": args.episodes,
+            "eval_scenarios": args.eval_scenarios,
+            "market_dtype": args.market_dtype,
+            "device": jax.devices()[0].device_kind,
+        },
+        "scales": {},
+    }
+    for s in scales:
+        doc["scales"][str(s)] = run_scale(args, s)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=2)
+    print(json.dumps(doc, indent=2) if not args.out else f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
