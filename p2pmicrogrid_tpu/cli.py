@@ -687,6 +687,74 @@ def cmd_baseline(args) -> int:
     return 0
 
 
+def cmd_single(args) -> int:
+    """Standalone single-home harness (the reference's hand-rolled
+    single-agent path, rl.py:362-418 ``run_episode`` / :424-440
+    ``run_single_trial`` / :443-488 ``test``): train ONE home with no P2P
+    negotiation or trading — observation (time, indoor temp, balance, zero
+    p2p signal), reward -(cost + 10*penalty^2) with grid-only settlement —
+    then immediately evaluate the greedy policy against the bang-bang
+    thermostat (``RuleAgent``) on the SAME held-out day arrays and report
+    both, the reference's "Price paid" comparison (rl.py:561-563).
+    """
+    args.agents = 1
+    args.no_trading = True
+    rc = cmd_train(args)
+    if rc:
+        return rc
+
+    import jax
+
+    from p2pmicrogrid_tpu.envs import (
+        init_physical,
+        make_ratings,
+        rule_baseline_episode,
+    )
+    from p2pmicrogrid_tpu.train import evaluate_community, make_policy
+
+    cfg = _build_cfg(args)
+    _, val_traces, test_traces = _load_traces(args)
+    traces = test_traces if getattr(args, "test", False) else val_traces
+    ratings = make_ratings(cfg, np.random.default_rng(cfg.train.seed))
+    key = jax.random.PRNGKey(cfg.train.seed)
+    policy = make_policy(cfg)
+    pol_state, episode, ckpt_dir = _restore_eval_state(args, cfg, key)
+    print(f"restored {ckpt_dir} at episode {episode}")
+
+    days, outputs, day_arrays = evaluate_community(
+        cfg, policy, pol_state, traces, ratings, key,
+        rng=np.random.default_rng(cfg.train.seed),
+    )
+    rl_cost = np.asarray(outputs.cost).sum(axis=(1, 2))
+    rl_reward = np.asarray(outputs.reward).sum(axis=(1, 2))
+
+    # Thermostat on the EXACT day arrays the greedy eval saw (same redrawn
+    # profile scales), so the comparison is apples-to-apples per day.
+    base_cost, base_reward = [], []
+    for i in range(len(days)):
+        arrays_d = jax.tree_util.tree_map(lambda x: x[i], day_arrays)
+        phys = init_physical(cfg, jax.random.PRNGKey(cfg.train.seed))
+        _, out = rule_baseline_episode(cfg, phys, arrays_d)
+        base_cost.append(float(np.asarray(out.cost).sum()))
+        base_reward.append(float(np.asarray(out.reward).sum()))
+
+    for i, d in enumerate(days.tolist()):
+        print(
+            f"day {d}: rl cost {rl_cost[i]:+.3f} € (reward {rl_reward[i]:+.1f})"
+            f" | thermostat cost {base_cost[i]:+.3f} € "
+            f"(reward {base_reward[i]:+.1f})"
+        )
+    summary = {
+        "days": days.tolist(),
+        "rl_cost_eur": round(float(rl_cost.sum()), 3),
+        "thermostat_cost_eur": round(float(np.sum(base_cost)), 3),
+        "rl_reward": round(float(rl_reward.sum()), 2),
+        "thermostat_reward": round(float(np.sum(base_reward)), 2),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
 def _maybe_pv_drop(args, arrays):
     """--pv-drop AGENT[:START_SLOT[:FACTOR]] — fault-inject one agent's PV."""
     spec = getattr(args, "pv_drop", None)
@@ -1052,6 +1120,24 @@ def main(argv=None) -> int:
     p.add_argument("--profile-dir", dest="profile_dir",
                    help="write a jax.profiler trace of the training run here")
     p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser(
+        "single",
+        help="standalone single-home training + thermostat comparison "
+             "(the reference's hand-rolled single-agent harness, "
+             "rl.py:362-488)",
+    )
+    _add_common(p)
+    p.add_argument("--jit-block", type=int, default=1, dest="jit_block")
+    p.add_argument("--scenarios", type=int, default=1,
+                   help="N>1: scenario-batched single-home training "
+                        "(sample-efficient on small hardware budgets)")
+    p.add_argument("--shared", action="store_true",
+                   help="with --scenarios: one shared learner over scenarios")
+    p.add_argument("--test", action="store_true",
+                   help="compare on test days (default: validation)")
+    p.add_argument("--resume", action="store_true")
+    p.set_defaults(fn=cmd_single, scenario_index=0)
 
     p = sub.add_parser("multi", help="multi-community training with "
                                      "inter-community trading")

@@ -483,7 +483,13 @@ def _ddpg_update_shared(
 # artifacts/lr_probe_a1000.json, artifacts/LEARNING_northstar_r04.json).
 # Below DDPG_LR_REF_POOLED pooled transitions per update the config lrs hold
 # unchanged; above it the stable step size falls off as pooled^(-DDPG_LR_EXP).
-DDPG_LR_REF_POOLED = 1600.0
+# Measured anchors (greedy held-out cost curves, chunked shared-critic):
+#   pooled 25.6k (A=100):  scale 1.0 diverges by ep ~80, 0.25 converges then
+#     diverges late (ep ~260), 0.125 stable through 300 episodes;
+#   pooled 512k (A=1000):  scale 0.25 turns up by ep ~100, 0.056 still
+#     monotonically improving and stable at ep 120.
+# sqrt(400/P) passes on the safe side of both anchors (0.125 / 0.028).
+DDPG_LR_REF_POOLED = 400.0
 DDPG_LR_EXP = 0.5
 
 

@@ -1,0 +1,160 @@
+"""Learning evidence at the full north-star scale (round-3 VERDICT item 1).
+
+Trains BASELINE.md's flagship configuration — 1000 agents, 80 chunks x 128 =
+10,240 Monte-Carlo scenarios per episode, community-shared actor-critic DDPG,
+bfloat16 market matrices — with the DEFAULT pooled-batch lr rule
+(parallel/scenarios.py:auto_scale_ddpg_lrs; nothing hand-tuned) and tracks
+the GREEDY policy's community cost on a fixed held-out scenario set. The
+claim under test: at 200x the scale of the reference's learning-curve
+evidence (data_analysis.py:697-772), held-out cost falls and STAYS low —
+replacing round 3's 100-agent-only evidence whose default lrs diverged.
+
+Writes ``artifacts/LEARNING_northstar_r04.json`` incrementally (the run is
+hours long; a partial curve survives interruption).
+
+Usage: ``PYTHONPATH=/root/repo:$PYTHONPATH python tools/learning_northstar.py``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import (
+    BatteryConfig,
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import init_physical, make_ratings
+from p2pmicrogrid_tpu.envs.community import AgentRatings, slot_dynamics_batched
+from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    auto_scale_ddpg_lrs,
+    ddpg_pooled_batch,
+    make_chunked_episode_runner,
+    make_shared_episode_fn,
+    train_scenarios_chunked,
+)
+from p2pmicrogrid_tpu.train import make_policy
+
+A, S_CHUNK, K = 1000, 128, 80        # 10,240 aggregate scenarios per episode
+EPISODES, EVAL_EVERY = 240, 10
+S_EVAL = 8
+OUT = "artifacts/LEARNING_northstar_r04.json"
+
+
+def main() -> None:
+    cfg = default_config(
+        sim=SimConfig(
+            n_agents=A, n_scenarios=S_CHUNK, market_dtype="bfloat16"
+        ),
+        battery=BatteryConfig(enabled=True),
+        train=TrainConfig(implementation="ddpg"),
+        # bench_northstar's exact learner config; lrs come from the default
+        # auto rule, not from hand tuning.
+        ddpg=DDPGConfig(buffer_size=96, batch_size=4, share_across_agents=True),
+    )
+    eff = auto_scale_ddpg_lrs(cfg)
+    doc = {
+        "round": 4,
+        "what": (
+            "Greedy held-out community cost while training the FULL north "
+            f"star ({A} agents, {K} chunks x {S_CHUNK} = {K * S_CHUNK} "
+            "scenarios/episode, shared-critic DDPG, bf16 market) at the "
+            "DEFAULT pooled-batch lr rule — no hand-tuned lrs."
+        ),
+        "config": {
+            "n_agents": A, "chunk_scenarios": S_CHUNK, "chunks": K,
+            "aggregate_scenarios": K * S_CHUNK, "episodes": EPISODES,
+            "eval_scenarios": S_EVAL, "market_dtype": "bfloat16",
+            "pooled_batch": ddpg_pooled_batch(cfg),
+            "lr_rule": "auto (sqrt(400/pooled), scenarios.py)",
+            "effective_actor_lr": eff.ddpg.actor_lr,
+            "effective_critic_lr": eff.ddpg.critic_lr,
+            "device": jax.devices()[0].device_kind,
+        },
+        "curve": [],
+    }
+
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    policy = make_policy(cfg)
+    params = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+
+    eval_arrays = device_episode_arrays(
+        cfg, jax.random.PRNGKey(10_000), ratings, S_EVAL
+    )
+
+    @jax.jit
+    def greedy_cost(params, key):
+        def act_fn(p, obs_s, prev, round_key, ex):
+            frac, q, _ = ddpg_shared_act(
+                cfg.ddpg, p, obs_s, jnp.zeros(obs_s.shape[:2]),
+                round_key, explore=False,
+            )
+            return frac, frac, q, ex
+
+        k_phys, k_scan = jax.random.split(key)
+        phys = jax.vmap(lambda k: init_physical(cfg, k))(
+            jax.random.split(k_phys, S_EVAL)
+        )
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), eval_arrays)
+        xs = (xs.time, xs.t_out, xs.load_w, xs.pv_w,
+              xs.next_time, xs.next_load_w, xs.next_pv_w)
+
+        def slot(carry, xs_t):
+            phys_s, kk = carry
+            kk, k_act = jax.random.split(kk)
+            phys_s, _, out, _, _ = slot_dynamics_batched(
+                cfg, policy, params, phys_s, xs_t, k_act, ratings_j,
+                explore=False, act_fn=act_fn,
+            )
+            return (phys_s, kk), (out.cost, out.reward)
+
+        (_, _), (cost, reward) = jax.lax.scan(slot, (phys, k_scan), xs)
+        return jnp.sum(cost, axis=(0, 2)).mean(), jnp.sum(
+            jnp.mean(reward, axis=-1), axis=0
+        ).mean()
+
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S_CHUNK),
+        n_scenarios=S_CHUNK,
+    )
+    runner = make_chunked_episode_runner(cfg, episode_fn, K)
+
+    def record(ep, extra=None):
+        c, r = greedy_cost(params, jax.random.PRNGKey(1))
+        row = {"episode": ep, "greedy_cost_eur": round(float(c), 2),
+               "greedy_reward": round(float(r), 1)}
+        row.update(extra or {})
+        doc["curve"].append(row)
+        print(row, file=sys.stderr, flush=True)
+        with open(OUT, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    record(0)
+    key = jax.random.PRNGKey(7)
+    for start in range(0, EPISODES, EVAL_EVERY):
+        params, rewards, _, secs = train_scenarios_chunked(
+            cfg, policy, params, ratings, key,
+            n_episodes=EVAL_EVERY, n_chunks=K, episode0=start,
+            episode_fn=episode_fn, runner=runner,
+        )
+        record(start + EVAL_EVERY, {
+            "train_reward_mean": round(float(np.mean(rewards[-2:])), 1),
+            "train_secs": round(secs, 1),
+        })
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
