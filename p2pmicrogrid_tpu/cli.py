@@ -117,6 +117,41 @@ def _profile_ctx(args):
     return contextlib.nullcontext()
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _cpu_placement_ctx():
+    """Place the run on host XLA-CPU: ``jax.default_device`` plus the
+    ``P2P_DISABLE_PALLAS`` override — on a TPU host ``default_backend()``
+    still reports the accelerator, so without the override the env would
+    compile Mosaic TPU kernels for a CPU-placed program and fail (same
+    mechanism as the benchmark suite's host-CPU retry, benchmarks.py)."""
+    import os
+
+    import jax
+
+    prior = os.environ.get("P2P_DISABLE_PALLAS")
+    os.environ["P2P_DISABLE_PALLAS"] = "1"
+    try:
+        with jax.default_device(jax.devices("cpu")[0]):
+            yield
+    finally:
+        if prior is None:
+            os.environ.pop("P2P_DISABLE_PALLAS", None)
+        else:
+            os.environ["P2P_DISABLE_PALLAS"] = prior
+
+
+def _explicit_device_ctx(args):
+    """Placement context for an explicit ``--device cpu`` choice; a null
+    context for auto/default (auto's measured-crossover decision needs the
+    built config and lives in cmd_train's sequential branch)."""
+    if getattr(args, "device", "auto") == "cpu":
+        return _cpu_placement_ctx()
+    return contextlib.nullcontext()
+
+
 def cmd_train(args) -> int:
     if getattr(args, "share_agents", False):
         # DDPGConfig.share_across_agents only reaches the shared-scenario
@@ -144,7 +179,11 @@ def cmd_train(args) -> int:
             "compiled shared-learner program"
         )
     if getattr(args, "scenarios", 1) > 1:
-        return _cmd_train_scenarios(args)
+        # Scenario-batched modes belong on the accelerator (auto placement
+        # never moves them), but an explicit --device cpu must still win —
+        # the whole path (arrays, init, training) runs under the context.
+        with _explicit_device_ctx(args):
+            return _cmd_train_scenarios(args)
 
     import dataclasses
 
@@ -197,8 +236,22 @@ def cmd_train(args) -> int:
     def checkpoint(ep, ps):
         save_checkpoint(ckpt_dir, ps, ep)
 
+    # Crossover-driven placement (train/placement.py): single-scenario
+    # tabular on a TPU host measured up to 33x slower than the same program
+    # on host XLA-CPU — place it where it is fast unless --device pins it.
+    device_ctx = contextlib.nullcontext()
+    if getattr(args, "device", "auto") == "auto":
+        from p2pmicrogrid_tpu.train.placement import pick_train_device
+
+        device, reason = pick_train_device(cfg)
+        if device is not None:
+            print(f"placing training on {device.platform}: {reason}")
+            device_ctx = _cpu_placement_ctx()
+    elif args.device == "cpu":
+        device_ctx = _cpu_placement_ctx()
+
     print(f"setting: {cfg.setting} ({cfg.train.implementation})")
-    with _profile_ctx(args):
+    with _profile_ctx(args), device_ctx:
         result = train_community(
             cfg, policy, pol_state, train_traces, ratings, key,
             progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
@@ -1119,6 +1172,12 @@ def main(argv=None) -> int:
                         "continue the episode/decay schedule from there")
     p.add_argument("--profile-dir", dest="profile_dir",
                    help="write a jax.profiler trace of the training run here")
+    p.add_argument("--device", choices=["auto", "default", "cpu"],
+                   default="auto",
+                   help="auto (default): place single-scenario configs that "
+                        "measured faster on host XLA-CPU there "
+                        "(artifacts/CROSSOVER_r03.json); 'default' pins the "
+                        "default backend; 'cpu' forces host XLA-CPU")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser(
@@ -1137,6 +1196,9 @@ def main(argv=None) -> int:
     p.add_argument("--test", action="store_true",
                    help="compare on test days (default: validation)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--device", choices=["auto", "default", "cpu"],
+                   default="auto",
+                   help="see train --device (auto placement applies here too)")
     p.set_defaults(fn=cmd_single, scenario_index=0)
 
     p = sub.add_parser("multi", help="multi-community training with "

@@ -245,7 +245,7 @@ class TestLrAutoScale:
             train_scenarios_shared,
         )
 
-        S, A = 80, 5  # pooled = 8*80*5 = 3200 > DDPG_LR_REF_POOLED (1600)
+        S, A = 80, 5  # pooled = 8*80*5 = 3200 > DDPG_LR_REF_POOLED (400)
         import dataclasses
 
         base = default_config(

@@ -95,6 +95,57 @@ class TestTrainResume:
         assert data["2-multi-agent-com-rounds-1-hetero"]["train"] > 0
 
 
+class TestPlacement:
+    def test_crossover_decisions(self):
+        """Crossover-driven auto-placement (train/placement.py): CPU-wins
+        region is exactly the measured single-scenario tabular table
+        (artifacts/CROSSOVER_r03.json); everything else stays put."""
+        from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+        from p2pmicrogrid_tpu.train.placement import (
+            pick_train_device,
+            sequential_cpu_advantage,
+        )
+
+        tab2 = default_config(
+            sim=SimConfig(n_agents=2), train=TrainConfig(implementation="tabular")
+        )
+        dev, reason = pick_train_device(tab2, default_backend="tpu")
+        assert dev is not None and dev.platform == "cpu"
+        assert "33x" in reason  # 1/0.03 measured at 2 agents
+
+        # Already on CPU: nothing to move.
+        assert pick_train_device(tab2, default_backend="cpu")[0] is None
+
+        # ddpg wins on the accelerator from 10 agents: no move.
+        ddpg = default_config(
+            sim=SimConfig(n_agents=10), train=TrainConfig(implementation="ddpg")
+        )
+        assert pick_train_device(ddpg, default_backend="tpu")[0] is None
+
+        # Scenario-batched modes always belong on the accelerator.
+        import dataclasses
+
+        scen = dataclasses.replace(tab2, sim=SimConfig(n_agents=2, n_scenarios=8))
+        assert pick_train_device(scen, default_backend="tpu")[0] is None
+
+        # Outside the measured table: no claim, no move.
+        assert sequential_cpu_advantage("tabular", 300) is None
+        assert sequential_cpu_advantage("dqn", 2) is None
+
+    def test_train_device_flag_cpu(self, tmp_path):
+        from p2pmicrogrid_tpu.cli import main as cli_main
+
+        assert (
+            cli_main(
+                [
+                    "train", "--agents", "2", "--episodes", "2",
+                    "--device", "cpu", "--model-dir", str(tmp_path / "m"),
+                ]
+            )
+            == 0
+        )
+
+
 class TestSingle:
     def test_single_home_trains_and_beats_thermostat(self, tmp_path, capsys):
         """Standalone single-home harness (reference rl.py:362-488): trains a

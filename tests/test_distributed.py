@@ -1,0 +1,32 @@
+"""Multi-host (multi-controller) mesh path: the 2-process jax.distributed
+dryrun tool must pass end-to-end — hybrid mesh via the process_count()
+branch, one sharded shared episode, cross-process and vs-single-process
+equivalence (tools/distributed_dryrun.py; round-3 VERDICT weak #6)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_distributed_dryrun(tmp_path):
+    out = tmp_path / "distributed.json"
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "distributed_dryrun.py"),
+            "--out", str(out),
+        ],
+        env=env,
+        timeout=540,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    assert doc["ok"], doc
+    assert [w["process_count"] for w in doc["workers"]] == [2, 2]

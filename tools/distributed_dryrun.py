@@ -1,0 +1,243 @@
+"""Two-process ``jax.distributed`` dryrun of the multi-host mesh path.
+
+Round-3 VERDICT weak #6: ``make_hybrid_mesh``'s ``jax.process_count()``
+branch (parallel/mesh.py:54) and the hybrid DCN x ICI grid were only ever
+exercised inside one process on a virtual mesh. This tool launches TWO real
+OS processes, each with 4 virtual CPU devices, wires them together with
+``jax.distributed.initialize`` (the multi-controller runtime a TPU pod
+uses), builds the (2 hosts x 4 chips) hybrid mesh via the process_count()
+branch in each, and runs ONE shared-tabular training episode with the
+scenario axis sharded over the full host x chip grid — the scenario-mean
+parameter update lowers to a hierarchical all-reduce crossing the "dcn"
+axis. A third, single-process run on 8 virtual devices with the same seeds
+is the equivalence reference: identical results prove sharding-over-
+processes changes placement, not math.
+
+Usage::
+
+    PYTHONPATH=/root/repo:$PYTHONPATH python tools/distributed_dryrun.py
+        [--out artifacts/DISTRIBUTED_r04.json]
+
+Exit 0 and ``"ok": true`` in the JSON document on success. Worker mode
+(internal): ``--worker PID --nproc N --port P`` / ``--single``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+
+S, A = 8, 3  # scenarios (sharded over all 8 devices) x agents
+
+
+def run_step(mesh) -> dict:
+    """One shared-tabular episode on ``mesh`` with on-device scenario
+    synthesis, scenario axis sharded over every mesh axis. Returns
+    replicated scalar summaries (addressable on every process)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+    from p2pmicrogrid_tpu.parallel.mesh import (
+        hybrid_scenario_sharding,
+        replicate,
+    )
+    from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+    from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S),
+        train=TrainConfig(implementation="tabular"),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    policy = make_policy(cfg)
+    sh = hybrid_scenario_sharding(mesh)
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(
+            cfg, k, ratings, S, scenario_sharding=sh
+        ),
+        n_scenarios=S,
+    )
+    # Identical on every process; explicit replication makes the inputs
+    # global arrays the multi-controller runtime accepts.
+    pol_state = replicate(init_policy_state(cfg, jax.random.PRNGKey(0)), mesh)
+
+    @jax.jit
+    def step(carry, key):
+        (pol, _), (r, _) = episode_fn(carry, key)
+        return jnp.sum(jnp.abs(pol.q_table)), jnp.sum(r)
+
+    qsum, rsum = step((pol_state, None), jax.random.PRNGKey(1))
+    return {"qsum": float(qsum), "rsum": float(rsum)}
+
+
+def worker(pid: int, nproc: int, port: int) -> None:
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from p2pmicrogrid_tpu.parallel.mesh import make_hybrid_mesh
+
+    # No dcn_size: THE process_count() branch under test.
+    mesh = make_hybrid_mesh()
+    assert mesh.devices.shape == (nproc, 8 // nproc), mesh.devices.shape
+    out = run_step(mesh)
+    out.update(
+        {
+            "process": pid,
+            "process_count": jax.process_count(),
+            "local_devices": len(jax.local_devices()),
+            "mesh_shape": list(mesh.devices.shape),
+            "mesh_axes": list(mesh.axis_names),
+        }
+    )
+    print(json.dumps(out), flush=True)
+
+
+def single() -> None:
+    """Single-process equivalence reference: same mesh geometry (2 x 4) on
+    8 virtual devices in one process, same seeds."""
+    from p2pmicrogrid_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(dcn_size=2)
+    out = run_step(mesh)
+    out["mesh_shape"] = list(mesh.devices.shape)
+    print(json.dumps(out), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", type=int, default=None)
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--single", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    if args.worker is not None:
+        worker(args.worker, args.nproc, args.port)
+        return 0
+    if args.single:
+        single()
+        return 0
+
+    # Coordinator: pick a free port, launch 2 workers (4 virtual CPU devices
+    # each) + the single-process reference (8 devices).
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    # The TPU-plugin site hook (a path entry like ~/.axon_site) pins the
+    # platform via jax.config at interpreter startup, SHADOWING the
+    # JAX_PLATFORMS env var — strip it so the workers really run the CPU
+    # backend with virtual devices.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo]
+        + [
+            p
+            for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            # Only the plugin hook dirs (hidden "*_site" entries) are
+            # stripped; ordinary user paths pass through untouched.
+            if p
+            and not (
+                os.path.basename(p).startswith(".")
+                and os.path.basename(p).endswith("_site")
+            )
+        ]
+    )
+    base = [sys.executable, os.path.abspath(__file__)]
+
+    def spawn(extra, n_local):
+        e = dict(env)
+        e["JAX_PLATFORMS"] = "cpu"
+        e["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n_local}"
+        )
+        return subprocess.Popen(
+            base + extra, env=e, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+
+    nproc = 2
+    procs = [
+        spawn(["--worker", str(i), "--nproc", str(nproc), "--port", str(port)], 4)
+        for i in range(nproc)
+    ]
+    ref = spawn(["--single"], 8)
+
+    rows, errs = [], []
+    children = procs + [ref]
+    for p in children:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            # One hung child (e.g. a lost coordinator port) must not orphan
+            # the rest or leave --out unwritten: kill everything, record it.
+            for q in children:
+                if q.poll() is None:
+                    q.kill()
+            out, err = p.communicate()
+            errs.append(f"timeout after 600s; partial stderr: {err[-1500:]}")
+            continue
+        if p.returncode != 0:
+            errs.append(err[-2000:])
+        for line in out.splitlines():
+            if line.startswith("{"):
+                rows.append(json.loads(line))
+
+    workers = [r for r in rows if "process" in r]
+    singles = [r for r in rows if "process" not in r]
+    ok = (
+        not errs
+        and len(workers) == nproc
+        and len(singles) == 1
+        and all(r["process_count"] == nproc for r in workers)
+        and all(r["mesh_shape"] == [2, 4] for r in workers)
+        # Both processes computed the SAME replicated result...
+        and abs(workers[0]["qsum"] - workers[1]["qsum"]) < 1e-6
+        # ...equal to the single-process 8-device run (placement, not math).
+        and abs(workers[0]["qsum"] - singles[0]["qsum"]) < 1e-4
+        and abs(workers[0]["rsum"] - singles[0]["rsum"]) < 1e-2
+    )
+    doc = {
+        "ok": ok,
+        "what": (
+            "2-process jax.distributed dryrun: hybrid (2 hosts x 4 devices) "
+            "mesh via the process_count() branch, one shared-tabular episode "
+            "with the scenario axis sharded over the host grid, checked "
+            "equal across processes AND against a single-process 8-device "
+            "run of the same seeds."
+        ),
+        "workers": workers,
+        "single_reference": singles,
+        "errors": errs,
+    }
+    text = json.dumps(doc, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out} ok={ok}")
+    else:
+        print(text)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
