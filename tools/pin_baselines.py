@@ -1,0 +1,94 @@
+"""Measure and PIN the sequential-NumPy baseline rates (round-3 VERDICT #3).
+
+``vs_baseline`` ratios were re-derived each bench session by re-timing the
+NumPy reference loop on a shared host — the same cfg3 measurement reported
+713x in one capture and 1,341x in another, and the 1000-agent rate was
+extrapolated from 2 cold slots. This tool measures every community size the
+benchmark suite compares against over FULL days (96 slots — even at 1000
+agents a full day is ~15 s), takes the best of ``--repeats`` runs (the
+baseline is a rate: contention can only slow it, so max is the honest
+choice), and writes ``artifacts/BASELINES_PINNED.json`` with provenance.
+``benchmarks._baseline_info`` reads the committed table by default;
+``P2P_REMEASURE_BASELINES=1`` bypasses it.
+
+Usage: ``PYTHONPATH=/root/repo:$PYTHONPATH python tools/pin_baselines.py``
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import platform
+import time
+
+from p2pmicrogrid_tpu.benchmarks import numpy_reference_steps_per_sec
+
+SIZES = (2, 10, 50, 128, 1000)
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "artifacts",
+    "BASELINES_PINNED.json",
+)
+
+
+def cpu_model() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    return line.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    return platform.processor() or "unknown"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--slots", type=int, default=96)
+    ap.add_argument("--sizes", default=",".join(map(str, SIZES)))
+    args = ap.parse_args()
+
+    rates = {}
+    for a in (int(s) for s in args.sizes.split(",")):
+        runs = []
+        for _ in range(args.repeats):
+            t0 = time.time()
+            runs.append(numpy_reference_steps_per_sec(a, args.slots))
+            print(
+                f"A={a}: {runs[-1]:.2f} slots/s ({time.time() - t0:.1f}s)",
+                flush=True,
+            )
+        rates[str(a)] = {
+            "steps_per_sec": round(max(runs), 3),
+            "all_runs": [round(r, 3) for r in runs],
+            "slots_measured": args.slots,
+        }
+
+    doc = {
+        "what": (
+            "Sequential per-agent NumPy reference loop rates "
+            "(benchmarks.numpy_reference_steps_per_sec — the reference's "
+            "execution model, community.py:67-93, minus TF overhead), "
+            "measured over full days, best of repeats. The committed "
+            "denominator for every vs_baseline ratio."
+        ),
+        "provenance": {
+            "date": datetime.date.today().isoformat(),
+            "host": platform.node(),
+            "cpu": cpu_model(),
+            "python": platform.python_version(),
+            "repeats": args.repeats,
+            "selection": "max over repeats (contention only slows a rate)",
+        },
+        "rates": rates,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
