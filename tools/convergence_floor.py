@@ -1,0 +1,177 @@
+"""Measured floor argument for the reference-defaults convergence metric.
+
+``episodes_to_converged_mean_price_2agent_tabular`` sits at ~935 of the
+reference's 1000-episode budget (BENCH_r03) and round-3's VERDICT asked for
+either ≤800 at reference defaults or a measured argument that ~935 is the
+schedule's floor. This tool runs the ablations that make that argument:
+
+1. **defaults** — the bench's exact configuration (anchor).
+2. **alpha0** — learning OFF (alpha=0), everything else default: any
+   "convergence" is pure estimator noise + the epsilon schedule. Measured
+   round 4: fires at ~988 — LATER than with learning, so the detector
+   cannot fire early even when there is nothing to converge.
+3. **eps_floor** — epsilon pinned at its floor (0.1) from episode 0, so the
+   behavior policy is stationary modulo learning: still ~969.
+4. **greedy_estimator** — per-episode price measured from the GREEDY policy
+   on a fixed evaluation draw (deterministic estimator, zero exploration
+   noise): still ~942, and the raw greedy price remains spread ~±20% late
+   in training — the alpha=1e-5 tabular policy itself keeps flipping
+   argmaxes for the whole budget.
+
+Why this is a floor: the detector (benchmarks.converged_episode) fires at
+the first window that stays within band=max(0.002 EUR/kWh, 2%) of the FINAL
+window. The ablations show the 50-episode-window price series has
+window-to-window variation of the band's order under EVERY noise source
+removal that leaves the reference's alpha/epsilon/rounds schedule intact —
+so the first window that stays within band of the final one is necessarily
+near the end of ANY run of this schedule. Beating ~935 at strict reference
+defaults would require changing the learner's step size or schedule, which
+is exactly what the opt-in accelerated line does (7.14x, BENCH).
+
+Writes ``artifacts/CONVERGENCE_FLOOR_r04.json``.
+
+Usage: ``JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python
+tools/convergence_floor.py`` (single-scenario 2-agent tabular is host-XLA
+fast; artifacts/CROSSOVER_r03.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from p2pmicrogrid_tpu.benchmarks import _convergence_prices, converged_episode
+from p2pmicrogrid_tpu.config import (
+    QLearningConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+
+OUT = "artifacts/CONVERGENCE_FLOOR_r04.json"
+WINDOW = 50
+
+
+def greedy_prices(cfg, episodes: int = 1000, block: int = 10) -> np.ndarray:
+    """Training at defaults, but the per-episode price comes from a greedy
+    (training=False) episode on a FIXED draw — the deterministic estimator
+    ablation."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.data import synthetic_traces
+    from p2pmicrogrid_tpu.envs import (
+        build_episode_arrays,
+        init_physical,
+        make_ratings,
+        run_episode,
+    )
+    from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+
+    decay_every = cfg.train.min_episodes_criterion
+    traces = synthetic_traces(n_days=1, start_day=11).normalized()
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    arrays = build_episode_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+
+    @jax.jit
+    def price_block(ps, episode0, key):
+        def body(ps, xs):
+            i, k = xs
+            k_phys, k_ep = jax.random.split(k)
+            phys = init_physical(cfg, k_phys)
+            _, ps, _ = run_episode(
+                cfg, policy, ps, phys, arrays, ratings, k_ep, training=True
+            )
+            phys_e = init_physical(cfg, jax.random.PRNGKey(123))
+            _, _, out = run_episode(
+                cfg, policy, ps, phys_e, arrays, ratings,
+                jax.random.PRNGKey(7), training=False,
+            )
+            e = jnp.sum(jnp.maximum(out.p_p2p, 0.0), axis=-1)
+            tot = jnp.sum(e)
+            price = jnp.where(
+                tot > 0, jnp.sum(out.trade_price * e) / tot, jnp.nan
+            )
+            ps = jax.lax.cond(
+                (episode0 + i) % decay_every == 0, policy.decay, lambda s: s, ps
+            )
+            return ps, price
+
+        return jax.lax.scan(
+            body, ps, (jnp.arange(block), jax.random.split(key, block))
+        )
+
+    key = jax.random.PRNGKey(42)
+    prices = np.empty(episodes)
+    for b in range(0, episodes, block):
+        key, k = jax.random.split(key)
+        ps, p = price_block(ps, b, k)
+        prices[b:b + block] = np.asarray(p)
+    return prices
+
+
+def summarize(prices: np.ndarray) -> dict:
+    ma = np.convolve(prices, np.ones(WINDOW) / WINDOW, mode="valid")
+    final = float(ma[-1])
+    band = max(0.002, 0.02 * abs(final))
+    # Window-to-window variation on non-overlapping windows: the noise the
+    # detector must wait out.
+    strides = ma[::WINDOW]
+    return {
+        "converged_episode": int(converged_episode(prices, WINDOW)),
+        "final_windowed_price": round(final, 5),
+        "band": round(band, 5),
+        "windowed_price_range": [round(float(ma.min()), 5),
+                                 round(float(ma.max()), 5)],
+        "stride_window_std": round(float(np.std(strides)), 5),
+        "raw_price_std_last_100": round(float(np.std(prices[-100:])), 5),
+    }
+
+
+def main() -> None:
+    base = default_config(
+        sim=SimConfig(n_agents=2, slot_unroll=4),
+        train=TrainConfig(implementation="tabular"),
+    )
+    variants = {}
+
+    variants["defaults"] = summarize(_convergence_prices(base))
+    variants["alpha0_no_learning"] = summarize(
+        _convergence_prices(
+            dataclasses.replace(base, qlearning=QLearningConfig(alpha=0.0))
+        )
+    )
+    variants["eps_floor_from_start"] = summarize(
+        _convergence_prices(
+            dataclasses.replace(
+                base,
+                qlearning=QLearningConfig(epsilon=0.1, epsilon_decay=1.0),
+            )
+        )
+    )
+    variants["greedy_estimator"] = summarize(greedy_prices(base))
+
+    doc = {
+        "round": 4,
+        "what": (
+            "Floor argument for episodes_to_converged_mean_price at strict "
+            "reference defaults: the detector's band (0.002 EUR/kWh) is of "
+            "the same order as the 50-episode-window price noise under "
+            "every schedule-preserving ablation — including NO LEARNING — "
+            "so it can only fire near the end of any run. See module "
+            "docstring of tools/convergence_floor.py."
+        ),
+        "window": WINDOW,
+        "variants": variants,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc, indent=2))
+
+
+if __name__ == "__main__":
+    main()
