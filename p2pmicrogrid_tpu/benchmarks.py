@@ -515,7 +515,9 @@ def bench_cfg4() -> dict:
         # HBM traffic at this scale; halving them measured +8.3% in a
         # back-to-back A/B at this config (26.1k -> 28.2k steps/s, round 3;
         # compute stays f32 in VMEM, ~0.4% relative on Watt-scale proposals).
-        sim=SimConfig(n_agents=A, n_scenarios=S, market_dtype="bfloat16"),
+        # market_dtype default "auto" resolves to bfloat16 here (TPU
+        # Pallas path, A >= 256 — envs/community.py:resolve_market_dtype).
+        sim=SimConfig(n_agents=A, n_scenarios=S),
         battery=BatteryConfig(enabled=True),
         train=TrainConfig(implementation="ddpg"),
         # batch_size=4 PER (scenario, agent): with one actor-critic shared by
@@ -538,7 +540,11 @@ def bench_cfg4() -> dict:
 
     # The bf16 stream only exists on the Pallas path (the jnp fallback
     # carries f32 matrices) — the traffic model must match what actually ran.
-    bf16_active = cfg.sim.market_dtype == "bfloat16" and resolve_use_pallas(cfg)
+    from p2pmicrogrid_tpu.envs.community import resolve_market_dtype
+
+    bf16_active = (
+        resolve_market_dtype(cfg) == "bfloat16" and resolve_use_pallas(cfg)
+    )
     mat = S * A * A * (2 if bf16_active else 4)
     learn = 10 * 4 * S * A * 64 * 4
     bytes_per_slot = 2 * mat + learn
@@ -648,7 +654,7 @@ def bench_northstar() -> dict:
 
     A, S_chunk, K = 1000, 128, 80
     cfg = default_config(
-        sim=SimConfig(n_agents=A, n_scenarios=S_chunk, market_dtype="bfloat16"),
+        sim=SimConfig(n_agents=A, n_scenarios=S_chunk),  # market auto->bf16
         battery=BatteryConfig(enabled=True),
         train=TrainConfig(implementation="ddpg"),
         # Same pooled-batch reasoning as bench_cfg4: batch 4 per

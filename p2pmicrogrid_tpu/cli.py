@@ -42,7 +42,7 @@ def _build_cfg(args) -> "ExperimentConfig":
             homogeneous=args.homogeneous,
             n_scenarios=getattr(args, "scenarios", 1),
             trading=not getattr(args, "no_trading", False),
-            market_dtype=getattr(args, "market_dtype", "float32"),
+            market_dtype=getattr(args, "market_dtype", "auto"),
         ),
         battery=BatteryConfig(enabled=args.battery),
         ddpg=DDPGConfig(
@@ -1163,10 +1163,13 @@ def main(argv=None) -> int:
     p.add_argument("--critic-lr", type=float, dest="critic_lr",
                    help="DDPG critic learning rate (default 2e-4; see "
                         "--actor-lr)")
-    p.add_argument("--market-dtype", choices=["float32", "bfloat16"],
-                   default="float32", dest="market_dtype",
-                   help="storage dtype of the batched negotiation matrices "
-                        "(bfloat16 halves their HBM traffic; compute stays f32)")
+    p.add_argument("--market-dtype",
+                   choices=["auto", "float32", "bfloat16"],
+                   default="auto", dest="market_dtype",
+                   help="storage dtype of the batched negotiation matrices; "
+                        "auto (default) = bfloat16 on the fused TPU path at "
+                        ">=256 agents (halves their HBM traffic; compute "
+                        "stays f32), float32 elsewhere")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint for this setting and "
                         "continue the episode/decay schedule from there")
@@ -1221,8 +1224,9 @@ def main(argv=None) -> int:
                    help="the checkpoint came from --chunks K training")
     p.add_argument("--share-agents", action="store_true", dest="share_agents",
                    help="the checkpoint came from --share-agents training")
-    p.add_argument("--market-dtype", choices=["float32", "bfloat16"],
-                   default="float32", dest="market_dtype",
+    p.add_argument("--market-dtype",
+                   choices=["auto", "float32", "bfloat16"],
+                   default="auto", dest="market_dtype",
                    help=argparse.SUPPRESS)
     p.add_argument("--scenario-index", type=int, default=0, dest="scenario_index",
                    help="which learner to evaluate from an independent-mode "

@@ -245,7 +245,13 @@ class SimConfig:
     # large A; "bfloat16" halves it (~0.4% relative precision on Watt-scale
     # proposals — compute stays f32 in VMEM, only the carried matrix is
     # compressed). Default keeps full precision.
-    market_dtype: str = "float32"
+    # Storage dtype of the batched [S, A, A] negotiation matrices — the
+    # dominant HBM stream at large A. "auto" (default) resolves to bfloat16
+    # on the fused-Pallas TPU path at n_agents >= 256 (measured ~f32-accurate,
+    # tests/test_pallas.py; halves the matrix traffic) and float32 everywhere
+    # else; compute is always f32 in VMEM. Resolution:
+    # envs/community.py:resolve_market_dtype.
+    market_dtype: str = "auto"
     # lax.scan unroll factor for the 96-slot episode scan. Small communities
     # are bound by per-scan-iteration kernel overheads (~0.1-0.4 ms/slot on
     # TPU), which unrolling amortizes; large batched configs are
@@ -254,9 +260,9 @@ class SimConfig:
     slot_unroll: int = 1
 
     def __post_init__(self):
-        if self.market_dtype not in ("float32", "bfloat16"):
+        if self.market_dtype not in ("auto", "float32", "bfloat16"):
             raise ValueError(
-                f"market_dtype must be 'float32' or 'bfloat16', "
+                f"market_dtype must be 'auto', 'float32' or 'bfloat16', "
                 f"got {self.market_dtype!r}"
             )
 

@@ -413,6 +413,30 @@ def resolve_use_pallas(cfg: ExperimentConfig) -> bool:
     return use_pallas
 
 
+# Smallest community size at which the auto market dtype compresses to
+# bfloat16: below it the [S, A, A] stream is not the traffic that matters
+# and f32 keeps bit-compat with the jnp reference path.
+MARKET_BF16_MIN_AGENTS = 256
+
+
+def resolve_market_dtype(cfg: ExperimentConfig) -> str:
+    """Resolve ``SimConfig.market_dtype``'s "auto" default.
+
+    bfloat16 storage for the negotiation matrices is measured ~f32-accurate
+    (tests/test_pallas.py: episode rewards within 2%) and halves the
+    dominant HBM stream, but only exists on the fused-Pallas path — so auto
+    resolves to bfloat16 exactly when the Pallas path is active AND the
+    community is large enough (>= MARKET_BF16_MIN_AGENTS agents) for the
+    matrix stream to dominate; float32 otherwise.
+    """
+    md = cfg.sim.market_dtype
+    if md != "auto":
+        return md
+    if resolve_use_pallas(cfg) and cfg.sim.n_agents >= MARKET_BF16_MIN_AGENTS:
+        return "bfloat16"
+    return "float32"
+
+
 def slot_dynamics_batched(
     cfg: ExperimentConfig,
     policy: Policy,
@@ -504,8 +528,13 @@ def slot_dynamics_batched(
         # in VMEM from the [S, A] vector (divide_rank1_fused); later rounds
         # run the full fused kernel, which emits the next round's mean while
         # its output is still in VMEM.
-        # market_dtype is validated at config construction (SimConfig).
-        mdt = jnp.bfloat16 if cfg.sim.market_dtype == "bfloat16" else jnp.float32
+        # market_dtype is validated at config construction (SimConfig);
+        # "auto" resolves here (bf16 on this path at large A).
+        mdt = (
+            jnp.bfloat16
+            if resolve_market_dtype(cfg) == "bfloat16"
+            else jnp.float32
+        )
         n_rounds = cfg.sim.rounds + 1
         keys = jax.random.split(key, n_rounds)
         A = load_w.shape[1]

@@ -150,3 +150,29 @@ def test_bf16_market_storage_close_to_f32():
     np.testing.assert_allclose(
         rewards["bfloat16"], rewards["float32"], rtol=0.02, atol=0.5
     )
+
+
+def test_resolve_market_dtype_auto():
+    """market_dtype='auto' (the default): bfloat16 exactly on the Pallas path
+    at >= MARKET_BF16_MIN_AGENTS agents, float32 everywhere else; explicit
+    choices pass through."""
+    from p2pmicrogrid_tpu.config import SimConfig, default_config
+    from p2pmicrogrid_tpu.envs.community import (
+        MARKET_BF16_MIN_AGENTS,
+        resolve_market_dtype,
+    )
+
+    big = default_config(
+        sim=SimConfig(n_agents=MARKET_BF16_MIN_AGENTS, use_pallas=True)
+    )
+    assert resolve_market_dtype(big) == "bfloat16"
+    small = default_config(sim=SimConfig(n_agents=8, use_pallas=True))
+    assert resolve_market_dtype(small) == "float32"
+    off = default_config(
+        sim=SimConfig(n_agents=MARKET_BF16_MIN_AGENTS, use_pallas=False)
+    )
+    assert resolve_market_dtype(off) == "float32"
+    explicit = default_config(
+        sim=SimConfig(n_agents=2, use_pallas=True, market_dtype="bfloat16")
+    )
+    assert resolve_market_dtype(explicit) == "bfloat16"
