@@ -138,38 +138,151 @@ def main(S: int = 64, A: int = 1000) -> dict:
     add("ddpg_learn_batch (pooled)", secs, learn_bytes,
         f"one shared actor-critic update on the pooled [{B}, obs] batch")
 
-    # --- the full slot, from the real compiled episode program
+    # --- full compiled episodes: the authoritative rows -----------------
+    # Standalone kernel rows above are dispatch-bound UPPER bounds (each
+    # isolated dispatch through the tunneled runtime costs ~5 ms); only
+    # whole compiled programs measure true device cost. The ablation rows
+    # below re-measure the full slot with one phase removed AT COMPILE TIME
+    # — the difference attributes the slot's time without any standalone-
+    # dispatch distortion (round-4 method; the chain-add in the standalone
+    # clear row is a measurement artifact, not real slot traffic).
+    import dataclasses
+
+    from p2pmicrogrid_tpu.envs import init_physical
+    from p2pmicrogrid_tpu.envs.community import (
+        AgentRatings,
+        resolve_market_dtype,
+        slot_dynamics_batched,
+    )
+
     ratings = make_ratings(cfg, np.random.default_rng(42))
     traces = make_scenario_traces(cfg)
-    arrays = stack_scenario_arrays(cfg, traces, ratings)
     policy = make_policy(cfg)
-    ps, scen = init_shared_state(cfg, key)
-    episode_fn = make_shared_episode_fn(cfg, policy, arrays, ratings)
-    carry = (ps, scen)
-    out = episode_fn(carry, key)
-    jax.block_until_ready(out[0][0])
-    best = np.inf
-    for _ in range(3):
-        t0 = time.time()
-        carry, _ = episode_fn(carry, key)
-        jax.block_until_ready(carry[0])
-        best = min(best, time.time() - t0)
-    slots = int(arrays.time.shape[1])
-    slot_secs = best / slots
-    # Per-slot traffic: rank-1 write + clear read (round 0-1 path) + learn.
-    slot_bytes = 2 * mat_bytes + learn_bytes
-    add("full slot (episode/96)", slot_secs, slot_bytes,
+
+    def episode_secs(cfg_v, learn: bool = True) -> float:
+        """Best-of-3 seconds per compiled episode of the given config
+        variant; ``learn=False`` runs act+market+physics only (the
+        environment half of the slot, no parameter update, no replay)."""
+        arrays_v = stack_scenario_arrays(cfg_v, traces, ratings)
+        if learn:
+            ep = make_shared_episode_fn(cfg_v, policy, arrays_v, ratings)
+            carry = init_shared_state(cfg_v, key)
+        else:
+            ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+            xs0 = jax.tree_util.tree_map(
+                lambda x: jnp.swapaxes(x, 0, 1), arrays_v
+            )
+            xs0 = (xs0.time, xs0.t_out, xs0.load_w, xs0.pv_w,
+                   xs0.next_time, xs0.next_load_w, xs0.next_pv_w)
+
+            from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+
+            params_eval = ddpg_params_init(d, A, key)
+
+            def act_fn(p, obs_s, prev, round_key, ex):
+                frac, q, _ = ddpg_shared_act(
+                    d, p, obs_s, jnp.zeros(obs_s.shape[:2]),
+                    round_key, explore=False,
+                )
+                return frac, frac, q, ex
+
+            @jax.jit
+            def ep(phys, k):
+                def slot(carry, xs_t):
+                    phys_s, kk = carry
+                    kk, k_act = jax.random.split(kk)
+                    phys_s, _, out, _, _ = slot_dynamics_batched(
+                        cfg_v, policy, params_eval, phys_s, xs_t, k_act,
+                        ratings_j, explore=False, act_fn=act_fn,
+                    )
+                    return (phys_s, kk), jnp.mean(out.reward, axis=-1)
+
+                (phys, _), r = jax.lax.scan(slot, (phys, k), xs0)
+                return phys, r
+            carry = jax.vmap(lambda k: init_physical(cfg_v, k))(
+                jax.random.split(key, S)
+            )
+        best = np.inf
+        cur = carry
+        for i in range(4):  # first iteration = compile warmup
+            t0 = time.time()
+            if learn:
+                cur, _ = ep(cur, key)
+                jax.block_until_ready(cur[0])
+            else:
+                cur, _ = ep(cur, key)
+                jax.block_until_ready(cur)
+            if i:
+                best = min(best, time.time() - t0)
+        return best
+
+    slots = cfg.sim.slots_per_day
+    mdt = resolve_market_dtype(cfg)
+    mat_stored = S * A * A * (2 if mdt == "bfloat16" else 4)
+    slot_bytes = 2 * mat_stored + learn_bytes
+
+    full = episode_secs(cfg) / slots
+    add(f"full slot ({mdt} market, auto)", full, slot_bytes,
         "whole compiled slot: negotiate + clear + settle + learn + step")
+
+    cfg_f32 = dataclasses.replace(
+        cfg, sim=dataclasses.replace(cfg.sim, market_dtype="float32")
+    )
+    full_f32 = episode_secs(cfg_f32) / slots
+    add("full slot (float32 market)", full_f32,
+        2 * S * A * A * 4 + learn_bytes,
+        "same slot with f32-carried matrices — isolates the bf16 saving")
+
+    env_only = episode_secs(cfg, learn=False) / slots
+    add(f"env-only slot ({mdt})", env_only, 2 * mat_stored,
+        "act + negotiate + clear + settle + physics, NO learn/replay — "
+        "market traffic only")
+
+    cfg_nt = dataclasses.replace(
+        cfg, sim=dataclasses.replace(cfg.sim, trading=False)
+    )
+    no_trade = episode_secs(cfg_nt) / slots
+    add("no-trading slot", no_trade, learn_bytes,
+        "act + physics + learn, no negotiation matrices at all — "
+        "learn-side traffic only")
+
+    cfg_u4 = dataclasses.replace(
+        cfg, sim=dataclasses.replace(cfg.sim, slot_unroll=4)
+    )
+    unroll4 = episode_secs(cfg_u4) / slots
+    add(f"full slot (unroll=4, {mdt})", unroll4, slot_bytes,
+        "slot scan unrolled x4 — measures scan-iteration overhead headroom")
+
+    breakdown = {
+        "market_side_ms": round((full - no_trade) * 1e3, 3),
+        "learn_side_ms": round((full - env_only) * 1e3, 3),
+        "overlap_or_fixed_ms": round(
+            (env_only + no_trade - full) * 1e3, 3
+        ),
+        "bf16_saving_ms": round((full_f32 - full) * 1e3, 3),
+        "note": (
+            "full = env_only + no_trade - overlap (the two ablations share "
+            "act+physics); a positive overlap_or_fixed term is the shared "
+            "act/physics/scan cost, which is compute/iteration-bound, not "
+            "matrix HBM"
+        ),
+    }
 
     doc = {
         "config": {
             "n_agents": A, "n_scenarios": S, "implementation": "ddpg",
             "share_across_agents": True, "batch_size": d.batch_size,
+            "market_dtype_resolved": mdt,
             "device": jax.devices()[0].device_kind,
             "hbm_peak_gb_s_assumed": HBM_PEAK_GB_S,
         },
         "phases": rows,
-        "protocol": "chained x20 dependent calls, scalar-sync, best of 3",
+        "in_program_breakdown": breakdown,
+        "protocol": (
+            "standalone rows: chained x20 dependent calls, scalar-sync, "
+            "best of 3 (dispatch-bound upper bounds); full-slot rows: whole "
+            "compiled episodes, best of 3"
+        ),
     }
     print(json.dumps(doc, indent=2))
     return doc
