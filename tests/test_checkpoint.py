@@ -95,3 +95,59 @@ def test_newer_or_alien_checkpoint_still_raises(tmp_path):
     _save_raw(tmp_path / "ep_3", {"pol_state": alien, "episode": 3})
     with pytest.raises(RuntimeError, match="from_the_future"):
         restore_checkpoint(str(tmp_path), init_policy_state(cfg, jax.random.PRNGKey(1)))
+
+
+def test_checkpoints_are_episode_exact_inside_fused_blocks(day_traces=None):
+    """Round-3 VERDICT weak #7: with episodes_per_jit_block > 1, a
+    save_episodes boundary inside a block used to get end-of-block state.
+    Blocks are now chopped at the cadence, so the checkpoint at episode e
+    equals the final state of an identically-seeded run with
+    max_episodes = e + 1 (its first blocks chop identically)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.data import synthetic_traces
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.train import (
+        init_policy_state,
+        make_policy,
+        train_community,
+    )
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=2),
+        train=TrainConfig(
+            implementation="tabular", max_episodes=6,
+            episodes_per_jit_block=4, save_episodes=3,
+            min_episodes_criterion=2,
+        ),
+    )
+    traces = synthetic_traces(n_days=1, seed=0, start_day=11).normalized()
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    policy = make_policy(cfg)
+    ps0 = init_policy_state(cfg, jax.random.PRNGKey(1))
+
+    saved = {}
+    train_community(
+        cfg, policy, ps0, traces, ratings, jax.random.PRNGKey(2),
+        checkpoint_cb=lambda ep, ps: saved.__setitem__(
+            ep, jax.tree_util.tree_map(np.asarray, ps)
+        ),
+    )
+    assert 2 in saved  # cadence 3 -> checkpoint after episode index 2
+
+    short = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, max_episodes=3)
+    )
+    res = train_community(
+        short, policy, ps0, traces, ratings, jax.random.PRNGKey(2),
+        checkpoint_cb=lambda ep, ps: None,
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(saved[2]),
+        jax.tree_util.tree_leaves(res.pol_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
