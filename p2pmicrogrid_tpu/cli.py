@@ -9,6 +9,8 @@ Subcommands:
   train     train a community (tabular/dqn/ddpg), checkpoint, log progress;
             --scenarios N batches Monte-Carlo scenarios (--shared for one
             scenario-averaged learner), --resume continues from a checkpoint
+  single    standalone single-home harness (train one no-trading home, then
+            compare the greedy policy against the bang-bang thermostat)
   multi     multi-community training with inter-community trading
   eval      load a checkpoint, run greedy per-day evaluation, persist results
   baseline  run the rule-based thermostat baseline over the test days
