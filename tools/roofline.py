@@ -269,6 +269,16 @@ def main(S: int = 64, A: int = 1000) -> dict:
     }
 
     doc = {
+        "round": 4,
+        "what": (
+            "Measured decomposition of the config-4 slot program. "
+            "Authoritative rows are the full-compiled-episode ones; the "
+            "standalone kernel rows are dispatch-bound upper bounds "
+            "(~5 ms tunneled dispatch each). The in_program_breakdown "
+            "attributes the slot via compile-time ablations: market side = "
+            "full - no_trading, learn side = full - env_only, and the "
+            "overlap term is the shared act/physics/scan cost."
+        ),
         "config": {
             "n_agents": A, "n_scenarios": S, "implementation": "ddpg",
             "share_across_agents": True, "batch_size": d.batch_size,
