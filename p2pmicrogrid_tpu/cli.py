@@ -1158,10 +1158,11 @@ def main(argv=None) -> int:
                         "community (shared-critic MARL) instead of per-agent "
                         "copies")
     p.add_argument("--actor-lr", type=float, dest="actor_lr",
-                   help="DDPG actor learning rate (default 1e-4; scale DOWN "
-                        "for large pooled batches — chunked 100-agent runs "
-                        "are stable at 2.5e-5, see "
-                        "artifacts/LEARNING_chunked_r03.json)")
+                   help="DDPG actor learning rate (default 1e-4, scaled "
+                        "automatically with the pooled shared-update batch "
+                        "— sqrt(400/(batch*S*A)), calibrated in "
+                        "artifacts/lr_probe_*.json; passing an explicit "
+                        "value pins it exactly and disables the rule)")
     p.add_argument("--critic-lr", type=float, dest="critic_lr",
                    help="DDPG critic learning rate (default 2e-4; see "
                         "--actor-lr)")
