@@ -49,9 +49,21 @@ A, S_CHUNK, K = 1000, 128, 80        # 10,240 aggregate scenarios per episode
 EPISODES, EVAL_EVERY = 240, 10
 S_EVAL = 8
 OUT = "artifacts/LEARNING_northstar_r04.json"
+SEED = 0
 
 
 def main() -> None:
+    import sys as _sys
+
+    global EPISODES, OUT, SEED
+    args = _sys.argv[1:]
+    # Optional: EPISODES OUT SEED (the seed-robustness rerun uses them).
+    if len(args) >= 1:
+        EPISODES = int(args[0])
+    if len(args) >= 2:
+        OUT = args[1]
+    if len(args) >= 3:
+        SEED = int(args[2])
     cfg = default_config(
         sim=SimConfig(
             n_agents=A, n_scenarios=S_CHUNK, market_dtype="bfloat16"
@@ -79,6 +91,7 @@ def main() -> None:
             "lr_rule": "auto (sqrt(400/pooled), scenarios.py)",
             "effective_actor_lr": eff.ddpg.actor_lr,
             "effective_critic_lr": eff.ddpg.critic_lr,
+            "seed": SEED,  # init/training randomness; community + eval fixed
             "device": jax.devices()[0].device_kind,
         },
         "curve": [],
@@ -87,7 +100,7 @@ def main() -> None:
     ratings = make_ratings(cfg, np.random.default_rng(42))
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
     policy = make_policy(cfg)
-    params = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+    params = init_shared_pol_state(cfg, jax.random.PRNGKey(SEED))
 
     eval_arrays = device_episode_arrays(
         cfg, jax.random.PRNGKey(10_000), ratings, S_EVAL
@@ -142,7 +155,12 @@ def main() -> None:
             json.dump(doc, f, indent=2)
 
     record(0)
-    key = jax.random.PRNGKey(7)
+    # SEED 0 reproduces the original committed run's exact key chain.
+    key = (
+        jax.random.PRNGKey(7)
+        if SEED == 0
+        else jax.random.fold_in(jax.random.PRNGKey(7), SEED)
+    )
     for start in range(0, EPISODES, EVAL_EVERY):
         params, rewards, _, secs = train_scenarios_chunked(
             cfg, policy, params, ratings, key,
