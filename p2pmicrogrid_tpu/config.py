@@ -186,6 +186,15 @@ class DDPGConfig:
     # only to shared-parameter scenario training; explicit --actor-lr /
     # --critic-lr on the CLI disables it.
     lr_auto_scale: bool = True
+    # Freeze the actor (params, targets, and its optimizer) for the first N
+    # critic updates while the critic calibrates on the exploration data —
+    # delayed policy updates. 0 disables (the reference-parity default);
+    # auto_scale_ddpg_lrs turns it on for large pooled batches, where an
+    # unlucky init otherwise locks the actor into a costly policy the
+    # scaled-down lr cannot escape (measured at 1000 agents, round 4:
+    # artifacts/learning_northstar_seed1.log plateaus at 5800 EUR vs the
+    # seed-0 run's 1006).
+    actor_delay_updates: int = 0
 
 
 @dataclass(frozen=True)

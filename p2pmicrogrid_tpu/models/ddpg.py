@@ -156,8 +156,20 @@ def ddpg_learn_batch(
         return -jnp.mean(critic.apply({"params": pc}, s, pi)[:, 0])
 
     a_grads = jax.grad(actor_loss)(pa)
-    a_updates, oa = a_opt.update(a_grads, oa, pa)
-    pa = optax.apply_updates(pa, a_updates)
+    a_updates, oa_new = a_opt.update(a_grads, oa, pa)
+    pa_new = optax.apply_updates(pa, a_updates)
+    if cfg.actor_delay_updates > 0:
+        # Delayed policy updates: the actor (and its optimizer) holds still
+        # until the critic has taken actor_delay_updates steps — the critic
+        # Adam count is the step clock (index 0 of optax.adam's state chain).
+        gate = oc[0].count >= cfg.actor_delay_updates
+        pick = lambda new, old: jax.tree_util.tree_map(
+            lambda n, o: jnp.where(gate, n, o), new, old
+        )
+        pa_new = pick(pa_new, pa)
+        oa_new = pick(oa_new, oa)
+    pa = pa_new
+    oa = oa_new
 
     polyak = lambda t, o: jax.tree_util.tree_map(
         lambda x, y: (1.0 - cfg.tau) * x + cfg.tau * y, t, o

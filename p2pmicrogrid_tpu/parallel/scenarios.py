@@ -532,6 +532,15 @@ def auto_scale_ddpg_lrs(
             cfg.ddpg,
             actor_lr=cfg.ddpg.actor_lr * scale,
             critic_lr=cfg.ddpg.critic_lr * scale,
+            # Delayed policy updates ride along with the lr scaling: at
+            # large pools an unlucky actor/critic init otherwise locks in a
+            # costly policy that the scaled-down lr cannot escape (measured
+            # at 1000 agents: seed 1 plateaued at 5.8x the converged cost,
+            # artifacts/learning_northstar_seed1.log). Two episodes of
+            # critic-only calibration removes the init dependence.
+            actor_delay_updates=max(
+                cfg.ddpg.actor_delay_updates, 2 * cfg.sim.slots_per_day
+            ),
         ),
     )
 

@@ -262,6 +262,7 @@ class TestLrAutoScale:
                 base.ddpg,
                 actor_lr=scaled_cfg.ddpg.actor_lr,
                 critic_lr=scaled_cfg.ddpg.critic_lr,
+                actor_delay_updates=scaled_cfg.ddpg.actor_delay_updates,
                 lr_auto_scale=False,
             ),
         )
@@ -284,6 +285,70 @@ class TestLrAutoScale:
             jax.tree_util.tree_leaves(outs[0]), jax.tree_util.tree_leaves(outs[1])
         ):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestActorDelay:
+    def test_actor_frozen_until_critic_count_then_released(self):
+        """Delayed policy updates (DDPGConfig.actor_delay_updates): the
+        actor, its optimizer and nothing else hold still until the critic
+        has taken N steps; the critic trains throughout."""
+        import dataclasses
+
+        from p2pmicrogrid_tpu.config import DDPGConfig
+        from p2pmicrogrid_tpu.models.ddpg import (
+            ddpg_learn_batch,
+            ddpg_params_init,
+        )
+
+        d = DDPGConfig(batch_size=4, share_across_agents=True,
+                       actor_delay_updates=2)
+        p = ddpg_params_init(d, None, jax.random.PRNGKey(0))
+        k = jax.random.PRNGKey(1)
+        s = jax.random.normal(k, (4, 4))
+        a = jax.random.uniform(k, (4, 1))
+        r = jax.random.normal(k, (4,))
+
+        def step(p):
+            pa, pc, pat, pct, oa, oc, _, _ = ddpg_learn_batch(
+                d, p.actor, p.critic, p.actor_target, p.critic_target,
+                p.actor_opt, p.critic_opt, s, a, r, s,
+            )
+            return p._replace(actor=pa, critic=pc, actor_target=pat,
+                              critic_target=pct, actor_opt=oa, critic_opt=oc)
+
+        p1 = step(p)   # critic count 1 < 2: actor frozen
+        for x, y in zip(jax.tree_util.tree_leaves(p.actor),
+                        jax.tree_util.tree_leaves(p1.actor)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert not all(
+            np.allclose(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree_util.tree_leaves(p.critic),
+                            jax.tree_util.tree_leaves(p1.critic))
+        )
+        p2 = step(p1)  # critic count 2 >= 2: actor released
+        assert not all(
+            np.allclose(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree_util.tree_leaves(p1.actor),
+                            jax.tree_util.tree_leaves(p2.actor))
+        )
+
+    def test_auto_rule_sets_delay_for_large_pools(self):
+        from p2pmicrogrid_tpu.parallel.scenarios import auto_scale_ddpg_lrs
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=100, n_scenarios=64),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(batch_size=4, share_across_agents=True),
+        )
+        scaled = auto_scale_ddpg_lrs(cfg)
+        assert scaled.ddpg.actor_delay_updates == 2 * cfg.sim.slots_per_day
+        # Small pools: reference-parity zero delay.
+        small = default_config(
+            sim=SimConfig(n_agents=2, n_scenarios=2),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(batch_size=4, share_across_agents=True),
+        )
+        assert auto_scale_ddpg_lrs(small).ddpg.actor_delay_updates == 0
 
 
 class TestChunkedDqnWarmup:
