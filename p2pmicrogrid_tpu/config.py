@@ -186,14 +186,14 @@ class DDPGConfig:
     # only to shared-parameter scenario training; explicit --actor-lr /
     # --critic-lr on the CLI disables it.
     lr_auto_scale: bool = True
-    # Freeze the actor (params, targets, and its optimizer) for the first N
-    # critic updates while the critic calibrates on the exploration data —
-    # delayed policy updates. 0 disables (the reference-parity default);
-    # auto_scale_ddpg_lrs turns it on for large pooled batches, where an
-    # unlucky init otherwise locks the actor into a costly policy the
-    # scaled-down lr cannot escape (measured at 1000 agents, round 4:
-    # artifacts/learning_northstar_seed1.log plateaus at 5800 EUR vs the
-    # seed-0 run's 1006).
+    # Freeze the actor (params and its optimizer) for the first N critic
+    # updates while the critic calibrates on the exploration data — delayed
+    # policy updates, gated on the critic's Adam step count inside the
+    # compiled program. 0 (default) disables. Measured at 1000 agents
+    # (round 4): an unlucky init's cost excursion is INVARIANT to this
+    # delay (identical trajectories at 0/2/5 episodes of delay) — the knob
+    # exists as a standard stabilizer for new configurations, not as a
+    # default (artifacts/LEARNING_northstar_seeds_r04.json).
     actor_delay_updates: int = 0
 
 

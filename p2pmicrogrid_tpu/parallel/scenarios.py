@@ -526,21 +526,19 @@ def auto_scale_ddpg_lrs(
     import dataclasses
 
     scale = (DDPG_LR_REF_POOLED / pooled) ** DDPG_LR_EXP
+    # Note on DDPGConfig.actor_delay_updates: a seed-robustness sweep at
+    # 1000 agents found an unlucky init (seed 1) takes a long excursion
+    # (greedy cost peaks ~2x init around episode 60-80) before recovering —
+    # and measured the SAME trajectory with 0, 2 and 5 episodes of actor
+    # delay, so the rule deliberately does NOT turn the delay on: the
+    # excursion is exploration/init-driven and self-correcting, not a
+    # frozen-critic problem (artifacts/LEARNING_northstar_seeds_r04.json).
     return dataclasses.replace(
         cfg,
         ddpg=dataclasses.replace(
             cfg.ddpg,
             actor_lr=cfg.ddpg.actor_lr * scale,
             critic_lr=cfg.ddpg.critic_lr * scale,
-            # Delayed policy updates ride along with the lr scaling: at
-            # large pools an unlucky actor/critic init otherwise locks in a
-            # costly policy that the scaled-down lr cannot escape (measured
-            # at 1000 agents: seed 1 plateaued at 5.8x the converged cost,
-            # artifacts/learning_northstar_seed1.log). Two episodes of
-            # critic-only calibration removes the init dependence.
-            actor_delay_updates=max(
-                cfg.ddpg.actor_delay_updates, 2 * cfg.sim.slots_per_day
-            ),
         ),
     )
 
