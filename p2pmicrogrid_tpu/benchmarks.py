@@ -536,17 +536,36 @@ def bench_cfg4() -> dict:
     # traffic is one [S, A, A] write (rank-1 divide) + one read (clear),
     # plus ~10 learn-pass activations [4*S*A, 64]. Measured per-phase
     # decomposition: tools/roofline.py -> artifacts/ROOFLINE_r03.json.
-    from p2pmicrogrid_tpu.envs.community import resolve_use_pallas
-
-    # The bf16 stream only exists on the Pallas path (the jnp fallback
-    # carries f32 matrices) — the traffic model must match what actually ran.
-    from p2pmicrogrid_tpu.envs.community import resolve_market_dtype
-
-    bf16_active = (
-        resolve_market_dtype(cfg) == "bfloat16" and resolve_use_pallas(cfg)
+    from p2pmicrogrid_tpu.envs.community import (
+        resolve_market_dtype,
+        resolve_market_impl,
+        resolve_use_pallas,
     )
-    mat = S * A * A * (2 if bf16_active else 4)
-    learn = 10 * 4 * S * A * 64 * 4
+
+    # The factored market (auto on TPU at rounds<=1) never materializes the
+    # [S, A, A] matrices — its clearing is O(A^2) fused VPU compute with
+    # O(S*A) memory, so the matrix stream drops out of the traffic model
+    # entirely. On the matrix path, the bf16 stream only exists with the
+    # Pallas kernels (the jnp fallback carries f32 matrices).
+    if resolve_market_impl(cfg) == "factored":
+        mat = 0
+    else:
+        bf16_active = (
+            resolve_market_dtype(cfg) == "bfloat16" and resolve_use_pallas(cfg)
+        )
+        mat = S * A * A * (2 if bf16_active else 4)
+    # Learn-pass activation traffic scales with the EFFECTIVE update batch
+    # (ddpg_pooled_batch handles the learn_batch_cap); when capped, add the
+    # [B, S, A] slab gather + wraparound pad the stripes slice from
+    # (10 floats/row, modeled as in tools/roofline.py: gather read + pad
+    # write + stripe read).
+    from p2pmicrogrid_tpu.parallel.scenarios import ddpg_pooled_batch
+
+    eff_batch = ddpg_pooled_batch(cfg, S)
+    raw_pool = cfg.ddpg.batch_size * S * A
+    learn = 10 * eff_batch * 64 * 4 + (
+        3 * 10 * raw_pool * 4 if eff_batch < raw_pool else 0
+    )
     bytes_per_slot = 2 * mat + learn
     slot_secs = S / value  # one slot advances S env-steps
     achieved = bytes_per_slot / slot_secs / 1e9
@@ -561,6 +580,8 @@ def bench_cfg4() -> dict:
         "approx_hbm_gb_per_slot": round(bytes_per_slot / 1e9, 2),
         "achieved_hbm_gb_per_s": round(achieved, 1),
         "hbm_peak_fraction_v5e": round(achieved / 820.0, 3),
+        "market_impl": resolve_market_impl(cfg),
+        "learn_batch_cap": cfg.ddpg.learn_batch_cap,
     }
 
 
@@ -624,15 +645,17 @@ def bench_northstar() -> dict:
     """BASELINE.md's north star at full aggregate scale: 1000 agents x
     10,240 Monte-Carlo scenarios per episode.
 
-    A single S=10k program cannot exist at A=1000 (the [S, A, A] negotiation
-    matrix alone would be ~40 TB and the XLA compile is unbuildable), so the
-    scenario axis runs as 80 chunks of 128 through ONE compiled episode
-    program (parallel/scenarios.py:train_scenarios_chunked): each chunk
-    synthesizes a fresh scenario draw on device (device_gen — zero
-    host<->device episode traffic over the tunneled link) and the episode
-    update is the chunk-averaged parameter delta (gradient accumulation /
-    local-SGD). Negotiation matrices are stored bfloat16 (SimConfig.
-    market_dtype) to halve the dominant HBM stream; compute stays f32.
+    A single S=10k program cannot exist at A=1000 (the per-scenario replay
+    rings alone would be ~390 GB; on the matrix market path the [S, A, A]
+    negotiation matrix would add ~40 TB), so the scenario axis runs as 80
+    chunks of 128 through ONE compiled episode program
+    (parallel/scenarios.py:train_scenarios_chunked): each chunk synthesizes
+    a fresh scenario draw on device (device_gen — zero host<->device
+    episode traffic over the tunneled link) and the episode update is the
+    chunk-averaged parameter delta (gradient accumulation / local-SGD).
+    On TPU the defaults resolve to the matrix-free factored market
+    (ops/factored_market.py — no [S, A, A] streams at all) and the capped
+    pooled update (DDPGConfig.learn_batch_cap).
     """
     import jax
 

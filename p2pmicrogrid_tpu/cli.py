@@ -28,6 +28,13 @@ import sys
 import numpy as np
 
 
+def _nonneg_int(value: str) -> int:
+    i = int(value)
+    if i < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {i}")
+    return i
+
+
 def _build_cfg(args) -> "ExperimentConfig":
     from p2pmicrogrid_tpu.config import (
         BatteryConfig,
@@ -45,6 +52,7 @@ def _build_cfg(args) -> "ExperimentConfig":
             n_scenarios=getattr(args, "scenarios", 1),
             trading=not getattr(args, "no_trading", False),
             market_dtype=getattr(args, "market_dtype", "auto"),
+            market_impl=getattr(args, "market_impl", "auto"),
         ),
         battery=BatteryConfig(enabled=args.battery),
         ddpg=DDPGConfig(
@@ -64,6 +72,13 @@ def _build_cfg(args) -> "ExperimentConfig":
                 )
                 if v is not None
             },
+            # --learn-batch-cap 0 disables the cap (full pooled update);
+            # unset keeps the DDPGConfig default.
+            **(
+                {"learn_batch_cap": args.learn_batch_cap or None}
+                if getattr(args, "learn_batch_cap", None) is not None
+                else {}
+            ),
         ),
         train=TrainConfig(
             max_episodes=args.episodes,
@@ -1166,6 +1181,13 @@ def main(argv=None) -> int:
     p.add_argument("--critic-lr", type=float, dest="critic_lr",
                    help="DDPG critic learning rate (default 2e-4; see "
                         "--actor-lr)")
+    p.add_argument("--learn-batch-cap", type=_nonneg_int,
+                   dest="learn_batch_cap",
+                   help="max transitions per agent-shared pooled DDPG update "
+                        "(default 32768): larger pools are subsampled "
+                        "uniformly from the replay rings, cutting the learn "
+                        "phase's HBM traffic while the lr rule keys on the "
+                        "capped batch; 0 disables (full pooled update)")
     p.add_argument("--market-dtype",
                    choices=["auto", "float32", "bfloat16"],
                    default="auto", dest="market_dtype",
@@ -1173,6 +1195,15 @@ def main(argv=None) -> int:
                         "auto (default) = bfloat16 on the fused TPU path at "
                         ">=256 agents (halves their HBM traffic; compute "
                         "stays f32), float32 elsewhere")
+    p.add_argument("--market-impl",
+                   choices=["auto", "matrix", "factored"],
+                   default="auto", dest="market_impl",
+                   help="negotiation/clearing implementation for scenario-"
+                        "batched runs: 'factored' clears the one-round "
+                        "market from O(A) vectors (no [S,A,A] matrices, "
+                        "ops/factored_market.py); auto (default) uses it "
+                        "wherever it applies on the TPU path (trading, "
+                        "rounds<=1), the matrix path elsewhere")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint for this setting and "
                         "continue the episode/decay schedule from there")

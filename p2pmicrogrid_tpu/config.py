@@ -195,6 +195,31 @@ class DDPGConfig:
     # exists as a standard stabilizer for new configurations, not as a
     # default (artifacts/LEARNING_northstar_seeds_r04.json).
     actor_delay_updates: int = 0
+    # Cap on the transition batch consumed by ONE agent-shared scenario-pooled
+    # gradient step (parallel/scenarios.py:_ddpg_update_shared). The pooled
+    # update reads batch_size*S*A transitions per slot — 512k at the north
+    # star — and its HBM traffic (activations of both nets, fwd+bwd) scales
+    # linearly with that pool, making learning half the slot time at A=1000.
+    # When the pool exceeds the cap, the update instead gathers `cap` uniform
+    # (slot, scenario, agent) samples straight from the replay rings — an
+    # unbiased minibatch estimator of the same pooled gradient (the
+    # reference's own update is a 128-transition replay sample,
+    # rl_backup.py:96; the cap keeps ours 256x that). The pooled-batch lr
+    # rule keys on the EFFECTIVE (capped) batch, so capping also raises the
+    # auto-scaled lrs back toward the measured-stable 32k anchor
+    # (artifacts/lr_probe_a100.json). None disables (full pooled update).
+    # Default 32768: measured stable across 3 seeds at the 1000-agent
+    # north-star proxy AND removes the unlucky-seed cost excursion the
+    # uncapped update showed (artifacts/LEARNING_cap_probe_r04.json); 8192
+    # is faster still but showed a late instability on one seed.
+    learn_batch_cap: Optional[int] = 32768
+
+    def __post_init__(self):
+        if self.learn_batch_cap is not None and self.learn_batch_cap <= 0:
+            raise ValueError(
+                f"learn_batch_cap must be positive or None, "
+                f"got {self.learn_batch_cap!r}"
+            )
 
 
 @dataclass(frozen=True)
@@ -261,6 +286,21 @@ class SimConfig:
     # else; compute is always f32 in VMEM. Resolution:
     # envs/community.py:resolve_market_dtype.
     market_dtype: str = "auto"
+    # Negotiation/clearing implementation for the scenario-batched path
+    # (envs/community.py:slot_dynamics_batched):
+    #   "matrix"   — materialize the [S, A, A] proposal matrices (jnp ops or
+    #                the fused Pallas kernels per use_pallas).
+    #   "factored" — matrix-free clearing (ops/factored_market.py): O(A^2)
+    #                fused VPU compute over O(A)-memory vectors, exploiting
+    #                the rank-1 row structure the default one-round
+    #                negotiation guarantees; requires rounds <= 1.
+    #   "auto"     — factored wherever it applies on the fused TPU path
+    #                (trading, rounds <= 1, same condition as the Pallas
+    #                kernels), matrix elsewhere. The CPU/host paths keep the
+    #                matrix implementation so every committed CPU-measured
+    #                artifact (golden traces, convergence metric) stays
+    #                bit-identical.
+    market_impl: str = "auto"
     # lax.scan unroll factor for the 96-slot episode scan. Small communities
     # are bound by per-scan-iteration kernel overheads (~0.1-0.4 ms/slot on
     # TPU), which unrolling amortizes; large batched configs are
@@ -273,6 +313,17 @@ class SimConfig:
             raise ValueError(
                 f"market_dtype must be 'auto', 'float32' or 'bfloat16', "
                 f"got {self.market_dtype!r}"
+            )
+        if self.market_impl not in ("auto", "matrix", "factored"):
+            raise ValueError(
+                f"market_impl must be 'auto', 'matrix' or 'factored', "
+                f"got {self.market_impl!r}"
+            )
+        if self.market_impl == "factored" and self.rounds > 1:
+            raise ValueError(
+                "market_impl='factored' requires rounds <= 1 (the matrix-"
+                "free clearing exploits the rank-1 structure of the one-"
+                "round negotiation); use 'matrix' or 'auto' for more rounds"
             )
 
     @property

@@ -437,6 +437,28 @@ def resolve_market_dtype(cfg: ExperimentConfig) -> str:
     return "float32"
 
 
+def resolve_market_impl(cfg: ExperimentConfig) -> str:
+    """Resolve ``SimConfig.market_impl``'s "auto" default to
+    "matrix" | "factored" for the scenario-batched path.
+
+    The factored clearing (ops/factored_market.py) removes the [S, A, A]
+    negotiation matrices entirely — O(A^2) fused VPU compute over
+    O(A)-memory vectors instead of O(A^2) HBM streams — but only exists
+    for the one-round (or zero-round)
+    negotiation whose rank-1 row structure it exploits. Auto turns it on
+    exactly where the fused Pallas matrix path would otherwise run
+    (trading, TPU backend) and the round count allows; explicit "factored"
+    forces it on any backend (pure jnp — used by the CPU equivalence
+    tests), and config validation already rejected it for rounds > 1.
+    """
+    mi = cfg.sim.market_impl
+    if mi != "auto":
+        return mi
+    if cfg.sim.trading and cfg.sim.rounds <= 1 and resolve_use_pallas(cfg):
+        return "factored"
+    return "matrix"
+
+
 def slot_dynamics_batched(
     cfg: ExperimentConfig,
     policy: Policy,
@@ -519,7 +541,41 @@ def slot_dynamics_batched(
         out_power = balance_w + hp_frac * th.hp_max_power
         return obs, hp_frac, aux, q, ex, out_power
 
-    if cfg.sim.trading and use_pallas:
+    market_impl = resolve_market_impl(cfg) if cfg.sim.trading else "matrix"
+    if cfg.sim.trading and market_impl == "factored":
+        # Matrix-free path (ops/factored_market.py): the one-round
+        # negotiation's final matrix is rank-1 per sign class, so clearing
+        # reduces to fused broadcast-min reductions over [S, A] vectors —
+        # no [S, A, A] materialization (O(A^2) compute, O(A) memory). Key
+        # chain, observations and decisions are IDENTICAL to the matrix
+        # paths (same per-round keys, same closed-form round-0 mean); only
+        # the clearing arithmetic differs, and it is f32-exact where the
+        # bf16 matrix path rounds.
+        from p2pmicrogrid_tpu.ops.factored_market import (
+            clear_factored_rounds0,
+            clear_factored_rounds1,
+        )
+
+        n_rounds = cfg.sim.rounds + 1
+        keys = jax.random.split(key, n_rounds)
+        A = load_w.shape[1]
+        obs, hp_frac, aux, q, ex, out0 = _round_obs_act(
+            jnp.zeros_like(balance_w), phys_s.hp_frac, keys[0], explore_state
+        )
+        hp_power_l = [hp_frac * th.hp_max_power]
+        if n_rounds == 1:
+            p_grid, p_p2p = clear_factored_rounds0(out0)
+        else:
+            tot = jnp.sum(out0, axis=-1, keepdims=True)
+            mean_raw = -(tot - out0) / (A * A)
+            obs, hp_frac, aux, q, ex, out1 = _round_obs_act(
+                mean_raw / ratings.max_in, hp_frac, keys[1], ex
+            )
+            hp_power_l.append(hp_frac * th.hp_max_power)
+            p_grid, p_p2p = clear_factored_rounds1(out0, out1)
+        explore_state = ex
+        hp_power_r = jnp.stack(hp_power_l)  # [rounds+1, S, A]
+    elif cfg.sim.trading and use_pallas:
         # Pallas path: a Python loop over the (static) round count so the
         # first rounds specialize. Round 0 always splits against a zero
         # matrix, making its output exactly rank-1 (out_0/A per row, the

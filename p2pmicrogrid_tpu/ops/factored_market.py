@@ -1,0 +1,143 @@
+"""Matrix-free P2P market clearing for the default one-round negotiation.
+
+The reference's negotiation/clearing (community.py:45-54,75-89 via
+agent.py:186-195) materializes an A x A proposal matrix per scenario; the
+fused Pallas path (ops/pallas_market.py) streams it through VMEM but still
+pays 2+ full [S, A, A] HBM passes per slot — the dominant memory stream at
+1000 agents (artifacts/ROOFLINE_r04.json: 0.59 ms of the 2.57 ms slot).
+
+This module removes the matrix entirely for the shipped default of
+``rounds = 1`` (setup.py:34) by exploiting structure the negotiation chain
+guarantees:
+
+* Round 0 splits against a zero matrix, so every row takes divide_power's
+  equal branch: ``P0[i, j] = b0_i / A`` — rank 1.
+* Round 1 therefore splits against rank-1 "powers" ``-b0_j / A``, making
+  each row of the final matrix a rank-1 profile over one sign class of b0:
+  ``P1[i, j] = b1_i * w_j / opp_i`` with ``w_j = relu(±b0_j)`` chosen by
+  ``sign(b1_i)`` and ``opp_i`` the masked sum of those weights (or the
+  equal branch ``b1_i / A`` when ``opp_i = 0``).
+* Rows are sign-uniform (every entry carries ``sign(b1_i)``), so pairwise
+  sign-opposition matching reduces to buyer x seller class pairs, and each
+  matched block is ``min(a_i * beta_j, delta_i * gamma_j)`` — a rank-1 min
+  whose row/column sums ``rank1_min_sums`` computes as fused broadcast-min
+  reductions, never materializing an A x A block in memory.
+
+Row sums of the final matrix telescope to ``b1`` exactly (both divide
+branches are normalized), so ``p_grid = b1 - p_p2p``.
+
+Cost: O(S * A^2) fused VPU compute but only O(S * A) memory — vs the
+matrix path's O(S * A^2) HBM streams; on TPU the memory is what binds
+(see rank1_min_sums on why the O(A log A) sorted formulation lost).
+Exact to f32 reduction-order tolerance vs clear_market(divide chain)
+(tests/test_factored_market.py proves equivalence on randomized and
+adversarial cases, including equal-branch rows, zero balances, one-sided
+markets, and the diagonal residue of equal rows).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rank1_min_sums(
+    a: jnp.ndarray,
+    delta: jnp.ndarray,
+    beta: jnp.ndarray,
+    gamma: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Row and column sums of ``M[i, j] = min(a_i * beta_j, delta_i * gamma_j)``
+    without materializing M.
+
+    All inputs are nonnegative ``[..., N]`` arrays (leading dims batch).
+    Returns ``(row, col)`` with ``row_i = sum_j M[i, j]`` over the last axis
+    and ``col_j = sum_i M[i, j]``. Entries with a zero factor on either side
+    contribute exactly zero, so class masks are encoded by zeroing weights.
+
+    Method: the entries are formed ON THE FLY inside two fused
+    broadcast-min reductions — O(A^2) VPU compute, O(A) memory, zero sorts.
+    A sorted prefix-sum formulation (O(A log A) compute) was tried first
+    and measured ~7 ms per call inside a v5e slot program at [64, 1000]:
+    XLA TPU sorts and the binary-search searchsorted lowering are
+    millisecond-scale, while the fused reduction never materializes the
+    [A, A] block and vector flops are effectively free at this size. The
+    TPU trade is compute-for-memory, not asymptotics.
+    """
+    lhs = a[..., :, None] * beta[..., None, :]
+    rhs = delta[..., :, None] * gamma[..., None, :]
+    m = jnp.minimum(lhs, rhs)
+    return jnp.sum(m, axis=-1), jnp.sum(m, axis=-2)
+
+
+def clear_factored_rounds1(
+    b0: jnp.ndarray, b1: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(p_grid, p_p2p) of the rounds=1 negotiation chain, matrix-free.
+
+    Args:
+        b0: [..., A] round-0 proposed net powers (equal-split round).
+        b1: [..., A] round-1 proposed net powers (the final decisions).
+
+    Semantically identical (to f32 reduction order) to::
+
+        P0 = equal-split rows of b0           # divide_power vs zero matrix
+        P1 = divide_power(b1, -P0^T o zero_diagonal)
+        p_grid, p_p2p = clear_market(P1)
+
+    which is exactly what the matrix paths compute for ``rounds == 1``.
+    """
+    A = b0.shape[-1]
+    wplus = jnp.maximum(b0, 0.0)      # buyer-row column weights
+    wminus = jnp.maximum(-b0, 0.0)    # seller-row column weights
+    sp = jnp.sum(wplus, axis=-1, keepdims=True)
+    sn = jnp.sum(wminus, axis=-1, keepdims=True)
+
+    buyer = b1 > 0.0
+    seller = b1 < 0.0
+    # opp_i = A * divide_power's |total|: the masked opposite-proposal sum
+    # (self excluded) the proportional branch normalizes by.
+    opp = jnp.where(buyer, sp - wplus, jnp.where(seller, sn - wminus, 0.0))
+    prop = opp > 0.0  # proportional rows; opp == 0 -> equal branch
+
+    absb1 = jnp.abs(b1)
+    safe_opp = jnp.where(prop, opp, 1.0)
+    # Row factors: proportional rows scale by |b1|/opp, equal rows by |b1|/A.
+    a_p = jnp.where(buyer & prop, absb1 / safe_opp, 0.0)
+    a_e = jnp.where(buyer & ~prop, absb1 / A, 0.0)
+    g_p = jnp.where(seller & prop, absb1 / safe_opp, 0.0)
+    g_e = jnp.where(seller & ~prop, absb1 / A, 0.0)
+    ones = jnp.ones_like(b1)
+
+    # Four buyer-type x seller-type blocks of the matched min; each call's
+    # row vector lives on its buyer class, col vector on its seller class.
+    row_pp, col_pp = rank1_min_sums(a_p, wminus, wplus, g_p)
+    row_pe, col_pe = rank1_min_sums(a_p, ones, wplus, g_e)
+    row_ep, col_ep = rank1_min_sums(a_e, wminus, ones, g_p)
+    row_ee, col_ee = rank1_min_sums(a_e, ones, ones, g_e)
+
+    matched_buy = row_pp + row_pe + row_ep + row_ee
+    matched_sell = col_pp + col_pe + col_ep + col_ee
+    p_p2p = jnp.where(
+        buyer, matched_buy, jnp.where(seller, -matched_sell, 0.0)
+    )
+    # Both divide branches are normalized, so row sums telescope to b1.
+    return b1 - p_p2p, p_p2p
+
+
+def clear_factored_rounds0(b0: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(p_grid, p_p2p) for a single decision round (rounds == 0): the final
+    matrix is the equal-split ``b0_i / A`` in every column, i.e. every row is
+    the equal branch — one EE block."""
+    A = b0.shape[-1]
+    buyer = b0 > 0.0
+    seller = b0 < 0.0
+    absb = jnp.abs(b0)
+    a_e = jnp.where(buyer, absb / A, 0.0)
+    g_e = jnp.where(seller, absb / A, 0.0)
+    ones = jnp.ones_like(b0)
+    row, col = rank1_min_sums(a_e, ones, ones, g_e)
+    p_p2p = jnp.where(buyer, row, jnp.where(seller, -col, 0.0))
+    return b0 - p_p2p, p_p2p

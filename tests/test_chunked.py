@@ -287,6 +287,105 @@ class TestLrAutoScale:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+class TestLearnBatchCap:
+    """DDPGConfig.learn_batch_cap: agent-shared pooled updates larger than
+    the cap subsample (slot, scenario, agent) triples straight from the
+    replay rings — an unbiased minibatch estimator whose HBM traffic scales
+    with the cap, not the batch*S*A pool (_ddpg_update_shared)."""
+
+    def _shared_cfg(self, cap, S=20, A=4, B=8):
+        return default_config(
+            sim=SimConfig(n_agents=A, n_scenarios=S),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(
+                buffer_size=16, batch_size=B, share_across_agents=True,
+                learn_batch_cap=cap,
+            ),
+        )
+
+    def _one_episode(self, cfg):
+        from p2pmicrogrid_tpu.parallel import (
+            init_shared_state,
+            stack_scenario_arrays,
+        )
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            make_scenario_traces,
+            train_scenarios_shared,
+        )
+
+        S = cfg.sim.n_scenarios
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        traces = make_scenario_traces(cfg, S)
+        arrays = stack_scenario_arrays(cfg, traces, ratings)
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        out, _, rewards, losses, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, replay_s=scen,
+        )
+        return out, np.asarray(losses), np.asarray(rewards)
+
+    def test_effective_pool_caps_in_shared_mode_only(self):
+        from p2pmicrogrid_tpu.parallel.scenarios import ddpg_pooled_batch
+
+        capped = self._shared_cfg(cap=100)  # pool 8*20*4 = 640
+        assert ddpg_pooled_batch(capped) == 100
+        uncapped = self._shared_cfg(cap=None)
+        assert ddpg_pooled_batch(uncapped) == 640
+        import dataclasses
+
+        per_agent = dataclasses.replace(
+            capped, ddpg=dataclasses.replace(
+                capped.ddpg, share_across_agents=False, learn_batch_cap=100
+            )
+        )
+        # Per-agent pools are batch*S per agent and never capped.
+        assert ddpg_pooled_batch(per_agent) == 8 * 20
+
+    def test_cap_above_pool_is_exact_noop(self):
+        """A cap the pool never reaches must leave the program bit-identical
+        to the uncapped one (the capped branch is static)."""
+        out_none, losses_none, _ = self._one_episode(self._shared_cfg(None))
+        out_big, losses_big, _ = self._one_episode(self._shared_cfg(1 << 30))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(out_none), jax.tree_util.tree_leaves(out_big)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(losses_none, losses_big)
+
+    def test_capped_update_runs_finite_and_differs(self):
+        out_cap, losses_cap, rewards_cap = self._one_episode(
+            self._shared_cfg(100)
+        )
+        out_full, losses_full, _ = self._one_episode(self._shared_cfg(None))
+        assert losses_cap.shape == losses_full.shape  # real per-scenario [S]
+        assert np.isfinite(losses_cap).all()
+        assert np.isfinite(rewards_cap).all()
+        flat = lambda t: np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(t)]
+        )
+        a, b = flat(out_cap), flat(out_full)
+        assert np.isfinite(a).all()
+        assert not np.allclose(a, b)
+
+    def test_cap_raises_the_auto_scaled_lrs(self):
+        """The lr rule keys on the EFFECTIVE (capped) batch: capping a huge
+        pool must leave the lrs at the cap's scale, not the pool's."""
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            DDPG_LR_EXP,
+            DDPG_LR_REF_POOLED,
+            auto_scale_ddpg_lrs,
+        )
+
+        big = self._shared_cfg(cap=None, S=64, A=1000, B=4)  # pool 256k
+        capped = self._shared_cfg(cap=32768, S=64, A=1000, B=4)
+        lr_big = auto_scale_ddpg_lrs(big).ddpg.actor_lr
+        lr_cap = auto_scale_ddpg_lrs(capped).ddpg.actor_lr
+        assert lr_cap > lr_big
+        expect = (DDPG_LR_REF_POOLED / 32768) ** DDPG_LR_EXP
+        assert lr_cap == pytest.approx(capped.ddpg.actor_lr * expect)
+
+
 class TestActorDelay:
     def test_actor_frozen_until_critic_count_then_released(self):
         """Delayed policy updates (DDPGConfig.actor_delay_updates): the

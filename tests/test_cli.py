@@ -351,3 +351,24 @@ def test_ddpg_lr_flags_reach_config(tmp_path):
         episodes=1, implementation="ddpg", seed=0, scenarios=1,
     )
     assert _build_cfg(ns2).ddpg.actor_lr == 1e-4
+
+
+def test_learn_batch_cap_and_market_impl_flags_reach_config():
+    from p2pmicrogrid_tpu.cli import _build_cfg, _nonneg_int
+    import argparse
+
+    base = dict(
+        agents=2, rounds=1, homogeneous=False, no_trading=False, battery=False,
+        episodes=1, implementation="ddpg", seed=0, scenarios=1,
+    )
+    ns = argparse.Namespace(**base, learn_batch_cap=4096, market_impl="matrix")
+    cfg = _build_cfg(ns)
+    assert cfg.ddpg.learn_batch_cap == 4096
+    assert cfg.sim.market_impl == "matrix"
+    # 0 disables the cap; omitted keeps the default.
+    ns0 = argparse.Namespace(**base, learn_batch_cap=0)
+    assert _build_cfg(ns0).ddpg.learn_batch_cap is None
+    assert _build_cfg(argparse.Namespace(**base)).ddpg.learn_batch_cap == 32768
+    # Negative values are rejected at parse time (argparse type).
+    with pytest.raises(Exception):
+        _nonneg_int("-5")

@@ -1,0 +1,237 @@
+"""Matrix-free factored market clearing (ops/factored_market.py): exact
+equivalence with the reference-semantics matrix chain
+(divide_power -> clear_market, microgrid/community.py:45-54 + agent.py:186-195)
+for the one-round negotiation whose rank-1 structure it exploits."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    BatteryConfig,
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.ops.factored_market import (
+    clear_factored_rounds0,
+    clear_factored_rounds1,
+    rank1_min_sums,
+)
+from p2pmicrogrid_tpu.ops.market import (
+    clear_market,
+    divide_power,
+    zero_diagonal,
+)
+
+
+def matrix_chain(b0, b1):
+    """The matrix-path computation the factored clearing must reproduce:
+    equal-split round 0 (divide_power against a zero matrix), one
+    proportional divide, pairwise sign-opposition clearing."""
+    S, A = b0.shape
+    P0 = jnp.broadcast_to((b0 / A)[..., None], (S, A, A))
+    powers = -jnp.swapaxes(zero_diagonal(P0), -1, -2)
+    P1 = divide_power(b1, powers)
+    return clear_market(P1)
+
+
+def assert_clear_equiv(b0, b1):
+    g1, p1 = matrix_chain(jnp.asarray(b0), jnp.asarray(b1))
+    g2, p2 = clear_factored_rounds1(jnp.asarray(b0), jnp.asarray(b1))
+    scale = max(1.0, float(np.abs(np.asarray(p1)).max()))
+    np.testing.assert_allclose(
+        np.asarray(p2), np.asarray(p1), rtol=1e-4, atol=2e-4 * scale
+    )
+    np.testing.assert_allclose(
+        np.asarray(g2), np.asarray(g1), rtol=1e-4, atol=2e-4 * scale
+    )
+
+
+class TestRank1MinSums:
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            a, d, b, g = (
+                jnp.asarray(
+                    np.abs(rng.normal(0, 2, (2, 9))).astype(np.float32)
+                )
+                for _ in range(4)
+            )
+            m = jnp.minimum(
+                a[..., :, None] * b[..., None, :],
+                d[..., :, None] * g[..., None, :],
+            )
+            row, col = rank1_min_sums(a, d, b, g)
+            np.testing.assert_allclose(row, m.sum(-1), rtol=1e-6)
+            np.testing.assert_allclose(col, m.sum(-2), rtol=1e-6)
+
+    def test_zero_weights_contribute_nothing(self):
+        a = jnp.asarray([[1.0, 0.0, 2.0]])
+        d = jnp.asarray([[1.0, 5.0, 0.0]])
+        b = jnp.asarray([[0.0, 3.0, 1.0]])
+        g = jnp.asarray([[4.0, 0.0, 1.0]])
+        row, col = rank1_min_sums(a, d, b, g)
+        # i=1: a=0 -> min(0, ...) = 0 everywhere except where gamma>0 gives
+        # min(0, d*g) = 0 too; all contributions zero.
+        m = jnp.minimum(
+            a[..., :, None] * b[..., None, :],
+            d[..., :, None] * g[..., None, :],
+        )
+        np.testing.assert_allclose(row, m.sum(-1), rtol=1e-6)
+        np.testing.assert_allclose(col, m.sum(-2), rtol=1e-6)
+
+
+class TestClearEquivalence:
+    """Randomized + adversarial equivalence vs the matrix chain, covering
+    every branch: proportional and equal divide rows, one-sided markets,
+    zero balances, and the equal-row diagonal residue."""
+
+    @pytest.mark.parametrize("a_agents", [2, 3, 17, 100])
+    def test_randomized(self, a_agents):
+        rng = np.random.default_rng(a_agents)
+        for trial in range(24):
+            b0 = rng.normal(0, 1000, (2, a_agents)).astype(np.float32)
+            b1 = rng.normal(0, 1000, (2, a_agents)).astype(np.float32)
+            style = trial % 8
+            if style == 1:
+                b0 = np.abs(b0)          # one-sided round 0
+            if style == 2:
+                b1 = np.abs(b1)          # all buyers -> nothing matches
+            if style == 3:
+                b0 = -np.abs(b0)
+            if style == 4:
+                b0[:, : a_agents // 2] = 0.0
+            if style == 5:
+                b1[:, ::2] = 0.0         # zero rows
+            if style == 6:
+                b0[:] = 0.0              # every row takes the equal branch
+            if style == 7:
+                b0 = np.abs(b0)
+                b1 = np.abs(b1)
+                b1[:, 0] = -b1[:, 0]     # single seller
+            assert_clear_equiv(b0, b1)
+
+    def test_all_buyers_nothing_matches(self):
+        b0 = np.abs(np.random.default_rng(0).normal(0, 100, (1, 5))).astype(
+            np.float32
+        )
+        b1 = np.abs(np.random.default_rng(1).normal(0, 100, (1, 5))).astype(
+            np.float32
+        )
+        g, p = clear_factored_rounds1(jnp.asarray(b0), jnp.asarray(b1))
+        np.testing.assert_allclose(np.asarray(p), 0.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g), b1, rtol=1e-6)
+
+    def test_power_balance_invariants(self):
+        """Row sums telescope: grid + p2p = b1 exactly, and matched p2p
+        power nets to ~zero across the community."""
+        rng = np.random.default_rng(7)
+        b0 = rng.normal(0, 1000, (3, 40)).astype(np.float32)
+        b1 = rng.normal(0, 1000, (3, 40)).astype(np.float32)
+        g, p = clear_factored_rounds1(jnp.asarray(b0), jnp.asarray(b1))
+        np.testing.assert_allclose(np.asarray(g + p), b1, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(p).sum(-1), 0.0, atol=1e-2
+        )  # buyers' matched power == sellers'
+
+    def test_rounds0_equivalence(self):
+        rng = np.random.default_rng(3)
+        for trial in range(10):
+            b0 = rng.normal(0, 1000, (2, 11)).astype(np.float32)
+            if trial % 3 == 1:
+                b0[:, ::2] = 0.0
+            A = b0.shape[-1]
+            P = jnp.broadcast_to(
+                (jnp.asarray(b0) / A)[..., None], (2, A, A)
+            )
+            g1, p1 = clear_market(P)
+            g2, p2 = clear_factored_rounds0(jnp.asarray(b0))
+            scale = max(1.0, float(np.abs(np.asarray(p1)).max()))
+            np.testing.assert_allclose(
+                np.asarray(p2), np.asarray(p1), rtol=1e-4, atol=2e-4 * scale
+            )
+            np.testing.assert_allclose(
+                np.asarray(g2), np.asarray(g1), rtol=1e-4, atol=2e-4 * scale
+            )
+
+
+class TestSlotIntegration:
+    """market_impl='factored' must reproduce the matrix path through full
+    training episodes (same keys -> same decisions; only clearing
+    arithmetic differs)."""
+
+    def _run(self, impl, rounds):
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.parallel import (
+            init_shared_state,
+            stack_scenario_arrays,
+        )
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            make_scenario_traces,
+            train_scenarios_shared,
+        )
+        from p2pmicrogrid_tpu.train import make_policy
+
+        cfg = default_config(
+            sim=SimConfig(
+                n_agents=7, n_scenarios=3, rounds=rounds, market_impl=impl
+            ),
+            battery=BatteryConfig(enabled=True),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(
+                buffer_size=16, batch_size=2, share_across_agents=True
+            ),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        traces = make_scenario_traces(cfg, 3)
+        arrays = stack_scenario_arrays(cfg, traces, ratings)
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        out, _, rew, loss, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(1),
+            n_episodes=2, replay_s=scen,
+        )
+        return out, np.asarray(rew), np.asarray(loss)
+
+    @pytest.mark.parametrize("rounds", [0, 1])
+    def test_episode_equivalence(self, rounds):
+        om, rm, lm = self._run("matrix", rounds)
+        of, rf, lf = self._run("factored", rounds)
+        np.testing.assert_allclose(rf, rm, rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(lf, lm, rtol=1e-3, atol=1e-3)
+        fm_ = np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(om)]
+        )
+        ff = np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(of)]
+        )
+        np.testing.assert_allclose(ff, fm_, rtol=1e-3, atol=1e-4)
+
+
+class TestConfigValidation:
+    def test_factored_rejects_multi_round(self):
+        with pytest.raises(ValueError, match="rounds <= 1"):
+            SimConfig(rounds=2, market_impl="factored")
+
+    def test_bad_impl_rejected(self):
+        with pytest.raises(ValueError, match="market_impl"):
+            SimConfig(market_impl="magic")
+
+    def test_auto_resolution(self):
+        from p2pmicrogrid_tpu.envs.community import resolve_market_impl
+
+        # On the CPU test backend, auto must stay on the matrix path so
+        # committed CPU-measured artifacts remain bit-identical.
+        cfg = default_config(sim=SimConfig(n_agents=5, n_scenarios=2))
+        assert resolve_market_impl(cfg) == "matrix"
+        forced = default_config(
+            sim=SimConfig(n_agents=5, n_scenarios=2, market_impl="factored")
+        )
+        assert resolve_market_impl(forced) == "factored"
+        multi_round = default_config(
+            sim=SimConfig(n_agents=5, rounds=2, use_pallas=True)
+        )
+        assert resolve_market_impl(multi_round) == "matrix"

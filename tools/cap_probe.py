@@ -1,0 +1,184 @@
+"""Learning-quality probe for the capped shared-DDPG update
+(DDPGConfig.learn_batch_cap, parallel/scenarios.py:_ddpg_update_shared).
+
+Round-4 throughput work capped the agent-shared pooled update — the 512k-row
+pooled batch at the north star becomes a contiguous random block of `cap`
+rows, and the pooled-batch lr rule keys on the EFFECTIVE (capped) batch, so
+capping also raises the auto-scaled lrs (sqrt(400/cap) vs sqrt(400/512k)).
+That changes the training dynamics, so the throughput win (cfg4 measured
+28.2k -> 39.9k env-steps/s at cap 32768, 54.8k at 8192) must be paired with
+learning evidence. This probe re-runs the K=4-chunk north-star proxy of
+artifacts/LEARNING_northstar_seeds_r04.json (1000 agents, 4 x 128 scenarios
+per episode — the same per-update dynamics as the K=80 flagship at 1/20 the
+cost) across the same 3 seeds at candidate caps, tracking greedy held-out
+community cost.
+
+Comparison anchors (uncapped, from LEARNING_northstar_seeds_r04.json):
+seed 0 falls 3058->1464, seed 2 falls 3159->836, seed 1 peaks ~6.1k at
+episode 60 then recovers to ~3.0k by episode 120.
+
+Writes artifacts/LEARNING_cap_probe_r04.json incrementally.
+
+Usage: PYTHONPATH=/root/repo python tools/cap_probe.py [cap ...]
+       (default caps: 32768 8192)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import (
+    BatteryConfig,
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import init_physical, make_ratings
+from p2pmicrogrid_tpu.envs.community import AgentRatings, slot_dynamics_batched
+from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    auto_scale_ddpg_lrs,
+    ddpg_pooled_batch,
+    make_chunked_episode_runner,
+    make_shared_episode_fn,
+    train_scenarios_chunked,
+)
+from p2pmicrogrid_tpu.train import make_policy
+
+A, S_CHUNK, K = 1000, 128, 4
+EPISODES, EVAL_EVERY = 120, 20
+S_EVAL = 8
+SEEDS = (0, 1, 2)
+OUT = "artifacts/LEARNING_cap_probe_r04.json"
+
+
+def make_cfg(cap):
+    return default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S_CHUNK, market_dtype="bfloat16"),
+        battery=BatteryConfig(enabled=True),
+        train=TrainConfig(implementation="ddpg"),
+        ddpg=DDPGConfig(
+            buffer_size=96, batch_size=4, share_across_agents=True,
+            learn_batch_cap=cap,
+        ),
+    )
+
+
+def main() -> None:
+    caps = [int(x) for x in sys.argv[1:]] or [32768, 8192]
+    doc = {
+        "round": 4,
+        "what": (
+            f"Greedy held-out community cost, K={K}-chunk north-star proxy "
+            f"({A} agents, {K}x{S_CHUNK} scenarios/episode, shared-critic "
+            "DDPG, bf16 market, default lr rule) with the CAPPED pooled "
+            "update at each candidate learn_batch_cap, across the 3 seeds "
+            "of LEARNING_northstar_seeds_r04.json. Uncapped anchors: seed0 "
+            "3058->1464, seed2 3159->836, seed1 excursion to ~6.1k@ep60 "
+            "recovering to ~3.0k@ep120."
+        ),
+        "config": {
+            "n_agents": A, "chunk_scenarios": S_CHUNK, "chunks": K,
+            "episodes": EPISODES, "eval_scenarios": S_EVAL,
+            "uncapped_pool": 4 * S_CHUNK * A,
+        },
+        "by_cap": {},
+    }
+
+    ratings = make_ratings(make_cfg(None), np.random.default_rng(42))
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    policy = make_policy(make_cfg(None))
+
+    for cap in caps:
+        cfg = make_cfg(cap)
+        eff = auto_scale_ddpg_lrs(cfg, S_CHUNK)
+        entry = {
+            "effective_batch": ddpg_pooled_batch(cfg, S_CHUNK),
+            "effective_actor_lr": eff.ddpg.actor_lr,
+            "effective_critic_lr": eff.ddpg.critic_lr,
+            "by_seed": {},
+        }
+        doc["by_cap"][str(cap)] = entry
+
+        eval_arrays = device_episode_arrays(
+            cfg, jax.random.PRNGKey(10_000), ratings, S_EVAL
+        )
+
+        @jax.jit
+        def greedy_cost(params, key):
+            def act_fn(p, obs_s, prev, round_key, ex):
+                frac, q, _ = ddpg_shared_act(
+                    cfg.ddpg, p, obs_s, jnp.zeros(obs_s.shape[:2]),
+                    round_key, explore=False,
+                )
+                return frac, frac, q, ex
+
+            k_phys, k_scan = jax.random.split(key)
+            phys = jax.vmap(lambda k: init_physical(cfg, k))(
+                jax.random.split(k_phys, S_EVAL)
+            )
+            xs = jax.tree_util.tree_map(
+                lambda x: jnp.swapaxes(x, 0, 1), eval_arrays
+            )
+            xs = (xs.time, xs.t_out, xs.load_w, xs.pv_w,
+                  xs.next_time, xs.next_load_w, xs.next_pv_w)
+
+            def slot(carry, xs_t):
+                phys_s, kk = carry
+                kk, k_act = jax.random.split(kk)
+                phys_s, _, out, _, _ = slot_dynamics_batched(
+                    cfg, policy, params, phys_s, xs_t, k_act, ratings_j,
+                    explore=False, act_fn=act_fn,
+                )
+                return (phys_s, kk), out.cost
+
+            (_, _), cost = jax.lax.scan(slot, (phys, k_scan), xs)
+            return jnp.sum(cost, axis=(0, 2)).mean()
+
+        episode_fn = make_shared_episode_fn(
+            cfg, policy, None, ratings,
+            arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S_CHUNK),
+            n_scenarios=S_CHUNK,
+        )
+        runner = make_chunked_episode_runner(cfg, episode_fn, K)
+
+        for seed in SEEDS:
+            params = init_shared_pol_state(cfg, jax.random.PRNGKey(seed))
+            curve = []
+            entry["by_seed"][str(seed)] = curve
+
+            def record(ep):
+                c = float(greedy_cost(params, jax.random.PRNGKey(1)))
+                curve.append({"episode": ep, "greedy_cost_eur": round(c)})
+                print(f"cap={cap} seed={seed} ep={ep}: {c:.0f}",
+                      file=sys.stderr, flush=True)
+                with open(OUT, "w") as f:
+                    json.dump(doc, f, indent=2)
+
+            record(0)
+            # Same key chain as the seeds artifact's probes.
+            key = (
+                jax.random.PRNGKey(7)
+                if seed == 0
+                else jax.random.fold_in(jax.random.PRNGKey(7), seed)
+            )
+            for start in range(0, EPISODES, EVAL_EVERY):
+                params, _, _, _ = train_scenarios_chunked(
+                    cfg, policy, params, ratings, key,
+                    n_episodes=EVAL_EVERY, n_chunks=K, episode0=start,
+                    episode_fn=episode_fn, runner=runner,
+                )
+                record(start + EVAL_EVERY)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

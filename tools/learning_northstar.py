@@ -52,6 +52,12 @@ OUT = "artifacts/LEARNING_northstar_r04.json"
 SEED = 0
 
 
+def _resolved_market_impl(cfg) -> str:
+    from p2pmicrogrid_tpu.envs.community import resolve_market_impl
+
+    return resolve_market_impl(cfg)
+
+
 def main() -> None:
     import sys as _sys
 
@@ -88,7 +94,9 @@ def main() -> None:
             "aggregate_scenarios": K * S_CHUNK, "episodes": EPISODES,
             "eval_scenarios": S_EVAL, "market_dtype": "bfloat16",
             "pooled_batch": ddpg_pooled_batch(cfg),
-            "lr_rule": "auto (sqrt(400/pooled), scenarios.py)",
+            "learn_batch_cap": cfg.ddpg.learn_batch_cap,
+            "market_impl": _resolved_market_impl(cfg),
+            "lr_rule": "auto (sqrt(400/effective pooled), scenarios.py)",
             "effective_actor_lr": eff.ddpg.actor_lr,
             "effective_critic_lr": eff.ddpg.critic_lr,
             "seed": SEED,  # init/training randomness; community + eval fixed

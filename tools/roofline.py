@@ -68,8 +68,12 @@ def main(S: int = 64, A: int = 1000) -> dict:
     from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
     from p2pmicrogrid_tpu.train import make_policy
 
+    # market_impl pinned to "matrix": the matrix-phase rows and ablations
+    # below decompose the MATRIX slot program; the shipped TPU default since
+    # round 4 is the matrix-free factored clearing, measured as its own
+    # full-slot rows at the end.
     cfg = default_config(
-        sim=SimConfig(n_agents=A, n_scenarios=S),
+        sim=SimConfig(n_agents=A, n_scenarios=S, market_impl="matrix"),
         battery=BatteryConfig(enabled=True),
         train=TrainConfig(implementation="ddpg"),
         ddpg=DDPGConfig(buffer_size=256, batch_size=4, share_across_agents=True),
@@ -115,7 +119,12 @@ def main(S: int = 64, A: int = 1000) -> dict:
         "extra matrix read+write, included in the traffic model")
 
     # --- pooled shared-critic learn pass (per slot update)
-    B = d.batch_size * S * A  # pooled batch rows
+    pool = d.batch_size * S * A  # pooled rows in the replay slab sample
+    # The capped update (DDPGConfig.learn_batch_cap) consumes a contiguous
+    # block of `cap` rows of the flattened slab — net passes scale with the
+    # EFFECTIVE batch, plus the slab gather + wraparound pad it slices from
+    # (10 floats per pooled row, read + write).
+    B = pool if d.learn_batch_cap is None else min(pool, d.learn_batch_cap)
     params = ddpg_params_init(d, A, key)
     s_b = jax.random.normal(key, (B, 4))
     a_b = jax.random.normal(key, (B, 1))
@@ -132,11 +141,13 @@ def main(S: int = 64, A: int = 1000) -> dict:
         return s_in + jnp.mean(out[-1])
 
     h = max(d.actor_hidden, d.critic_hidden)
-    # ~10 activation passes (actor/critic fwd+bwd+target) of [B, h] f32.
-    learn_bytes = 10 * B * h * 4
+    # ~10 activation passes (actor/critic fwd+bwd+target) of [B, h] f32,
+    # plus (when capped) the slab gather read + pad write of the pool.
+    learn_bytes = 10 * B * h * 4 + (3 * 10 * pool * 4 if B < pool else 0)
     secs = _timeit(learn, s_b)
-    add("ddpg_learn_batch (pooled)", secs, learn_bytes,
-        f"one shared actor-critic update on the pooled [{B}, obs] batch")
+    add("ddpg_learn_batch (effective batch)", secs, 10 * B * h * 4,
+        f"one shared actor-critic update on the [{B}, obs] update batch "
+        f"(pool {pool}, cap {d.learn_batch_cap})")
 
     # --- full compiled episodes: the authoritative rows -----------------
     # Standalone kernel rows above are dispatch-bound UPPER bounds (each
@@ -253,11 +264,28 @@ def main(S: int = 64, A: int = 1000) -> dict:
     add(f"full slot (unroll=4, {mdt})", unroll4, slot_bytes,
         "slot scan unrolled x4 — measures scan-iteration overhead headroom")
 
+    # --- the shipped TPU default: matrix-free factored clearing ---------
+    cfg_fac = dataclasses.replace(
+        cfg, sim=dataclasses.replace(cfg.sim, market_impl="factored")
+    )
+    fac = episode_secs(cfg_fac) / slots
+    add("full slot (factored market, DEFAULT on TPU)", fac, learn_bytes,
+        "ops/factored_market.py: no [S, A, A] streams at all — clearing is "
+        "O(A^2) fused VPU compute over [S, A] vectors; remaining modeled "
+        "HBM is the learn side only")
+    fac_env = episode_secs(cfg_fac, learn=False) / slots
+    add("env-only slot (factored)", fac_env, 0,
+        "act + factored negotiate/clear/settle + physics, no learn/replay "
+        "— near-zero modeled HBM")
+
     market_ms = full - no_trade
     learn_ms = full - env_only
     fixed_ms = env_only + no_trade - full
     hbm_ms = market_ms + learn_ms
     breakdown = {
+        "factored_full_ms": round(fac * 1e3, 3),
+        "factored_market_side_ms": round((fac - no_trade) * 1e3, 3),
+        "factored_vs_matrix_slot_speedup": round(full / fac, 3),
         "market_side_ms": round(market_ms * 1e3, 3),
         "market_side_gb_per_s": round(2 * mat_stored / market_ms / 1e9, 1),
         "learn_side_ms": round(learn_ms * 1e3, 3),
