@@ -23,8 +23,9 @@ driver parses the LAST line, so the north-star config-4 entry prints last:
    reference's 1000-episode budget (setup.py:30) this represents, as a
    speed-up ratio (1000 / episodes).
 7. ``northstar`` the full BASELINE aggregate: 1000 agents x 10,240 scenarios
-   per episode via 80 chunks of 128 through one compiled program with
-   on-device scenario synthesis and chunk-delta averaging (bench_northstar).
+   per episode via 80 chunks of 128 (run 2 side by side, ``chunk_parallel``)
+   through one compiled program with on-device scenario synthesis and
+   chunk-delta averaging (bench_northstar).
 
 ``vs_baseline`` for throughput lines compares against a sequential NumPy
 re-implementation of the reference's eager per-slot, per-agent loop
@@ -563,7 +564,8 @@ def bench_cfg4() -> dict:
 
     eff_batch = ddpg_pooled_batch(cfg, S)
     raw_pool = cfg.ddpg.batch_size * S * A
-    learn = 10 * eff_batch * 64 * 4 + (
+    h = max(cfg.ddpg.actor_hidden, cfg.ddpg.critic_hidden)
+    learn = 10 * eff_batch * h * 4 + (
         3 * 10 * raw_pool * 4 if eff_batch < raw_pool else 0
     )
     bytes_per_slot = 2 * mat + learn
@@ -704,7 +706,14 @@ def bench_northstar() -> dict:
     # compile inside the measured time.
     from p2pmicrogrid_tpu.parallel.scenarios import make_chunked_episode_runner
 
-    runner = make_chunked_episode_runner(cfg, episode_fn, K)
+    # chunk_parallel=2: two chunks run side by side through the vmapped
+    # episode program. The S=64..512 chunk-size sweep and the C=1/2/4 width
+    # sweep (tools/s_scaling_probe.py, tools/chunk_parallel_probe.py,
+    # artifacts/WIDTH_SWEEP_r04.json) put the throughput optimum at an
+    # effective width of 256 scenarios: C=2 measured 64.5k scenario-steps/s
+    # vs 59.6k at C=1 and 55.9k at C=4 on the v5e chip, with the K-delta
+    # update semantics unchanged (only summation order differs).
+    runner = make_chunked_episode_runner(cfg, episode_fn, K, chunk_parallel=2)
     ps, _, _, _ = train_scenarios_chunked(
         cfg, policy, ps, ratings, key,
         n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,

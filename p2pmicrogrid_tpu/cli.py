@@ -426,6 +426,7 @@ def _cmd_train_scenarios(args) -> int:
             pol_state, rewards, _, seconds = train_scenarios_chunked(
                 cfg, policy, pol_state, ratings, key, n_episodes,
                 n_chunks=chunks, episode0=episode0, episode_cb=episode_cb,
+                chunk_parallel=getattr(args, "chunk_parallel", 1),
             )
         elif args.shared:
             pol_state, _, rewards, _, seconds = train_scenarios_shared(
@@ -1168,6 +1169,14 @@ def main(argv=None) -> int:
                         "N-scenario program with on-device trace synthesis "
                         "and chunk-averaged parameter deltas (the 10k-"
                         "scenario north-star mode)")
+    p.add_argument("--chunk-parallel", type=int, default=1,
+                   dest="chunk_parallel", metavar="C",
+                   help="with --chunks K: run C chunks (C divides K) side by "
+                        "side through one vmapped episode program — same "
+                        "per-chunk trajectories and K-delta mean, wider "
+                        "device program (amortizes per-slot fixed cost; "
+                        "C=2 measured fastest at 1000 agents x 128-scenario "
+                        "chunks)")
     p.add_argument("--share-agents", action="store_true", dest="share_agents",
                    help="ddpg + --shared: ONE actor-critic for the whole "
                         "community (shared-critic MARL) instead of per-agent "

@@ -450,7 +450,11 @@ def _ddpg_update_shared(
     if d.share_across_agents and cap is not None and cap < pool:
         key, koff = jax.random.split(key)
         s, a, r, ns = lockstep_replay_sample(replay_s, key, d.batch_size)
-        n_stripes = 8 if cap % 8 == 0 else 1
+        # Largest stripe count <= 8 that divides the cap: a cap that is not
+        # a multiple of 8 must not silently collapse to ONE contiguous block
+        # (a single block covers only ~cap/A consecutive scenarios — the
+        # correlated-draw failure mode the stripes exist to avoid).
+        n_stripes = next(n for n in range(8, 0, -1) if cap % n == 0)
         length = cap // n_stripes
         starts = jax.random.randint(koff, (n_stripes,), 0, pool)
         def block(x):
@@ -574,9 +578,12 @@ def ddpg_pooled_batch(cfg: ExperimentConfig, n_scenarios: Optional[int] = None) 
     actor-critic is shared across agents (``share_across_agents``) — capped
     at ``learn_batch_cap`` on the agent-shared path, where the update
     subsamples the pool (``_ddpg_update_shared``). The lr rule keys on this
-    EFFECTIVE batch: the capped estimator's gradient variance matches a
-    genuine pool of ``cap`` transitions, which is what the stability
-    anchors were measured against."""
+    EFFECTIVE batch. Note the capped estimator's rows are stripe-correlated,
+    so its gradient variance is NOT identical to a genuine iid pool of
+    ``cap`` transitions (see ``_ddpg_update_shared``'s docstring); keying
+    the rule on the cap is justified by the measured stability evidence at
+    the shipped cap/stripe shape (artifacts/LEARNING_cap_probe_r04.json),
+    not by a variance identity."""
     S = cfg.sim.n_scenarios if n_scenarios is None else n_scenarios
     A = cfg.sim.n_agents if cfg.ddpg.share_across_agents else 1
     pooled = cfg.ddpg.batch_size * S * A
@@ -878,6 +885,7 @@ def make_chunked_episode_runner(
     episode_fn: Callable,
     n_chunks: int,
     warmup_fn: Optional[Callable] = None,
+    chunk_parallel: int = 1,
 ) -> Callable:
     """The jitted K-chunk episode: ONE device call — a ``lax.scan`` over
     chunk keys whose body runs the chunk episode from θ₀ and accumulates its
@@ -897,33 +905,78 @@ def make_chunked_episode_runner(
     rewards [K*S], losses [K*S])``. Built once and reused across
     ``train_scenarios_chunked`` calls (each call would otherwise create a
     fresh jit wrapper and recompile).
+
+    ``chunk_parallel`` (C, must divide K) runs C chunks side by side through
+    a ``vmap`` of the episode program — the outer scan covers K/C groups.
+    Each chunk still trains from θ₀ on its OWN scenario draw with its own
+    key (the per-chunk key chain is identical to C=1: key i drives chunk i
+    either way), so the update semantics — mean over K per-chunk parameter
+    deltas — are unchanged up to float summation order. Why it exists: the
+    S=64..512 chunk-size sweep (tools/s_scaling_probe.py) measured ~0.6 ms
+    of per-slot fixed cost (small-op latency + scan iteration) that a wider
+    program amortizes — S=128 sustains 55.9k scenario-steps/s where
+    S=256-wide execution sustains 63k — but retuning the chunk SIZE changes
+    the local-SGD update structure and its lr rule; running C chunks in
+    parallel widens the program with the update structure intact.
     """
+    C = chunk_parallel
+    if C < 1 or n_chunks % C != 0:
+        raise ValueError(
+            f"chunk_parallel={C} must be >=1 and divide n_chunks={n_chunks}"
+        )
+
+    def _one_chunk(theta0, kc):
+        """Chunk body (C=1 semantics): fresh scen state, optional dqn
+        replay warmup, one episode from theta0. Returns (theta_c, r, l)."""
+        k_scen, k_ep = jax.random.split(kc)
+        scen = init_scen_state_only(cfg, k_scen)
+        if warmup_fn is not None and cfg.dqn.warmup_passes > 0:
+            k_warm = jax.random.split(k_ep, cfg.dqn.warmup_passes + 1)
+
+            def warm(carry, k):
+                carry, _ = warmup_fn(carry, k)
+                return carry, None
+
+            # record_only leaves theta untouched; only scen (replay) fills.
+            (_, scen), _ = jax.lax.scan(warm, (theta0, scen), k_warm[:-1])
+            k_ep = k_warm[-1]
+        (theta_c, _), (r, l) = episode_fn((theta0, scen), k_ep)
+        return theta_c, r, l
 
     @jax.jit
     def run_chunks(theta0, chunk_keys):
-        def body(acc, kc):
-            k_scen, k_ep = jax.random.split(kc)
-            scen = init_scen_state_only(cfg, k_scen)
-            if warmup_fn is not None and cfg.dqn.warmup_passes > 0:
-                k_warm = jax.random.split(k_ep, cfg.dqn.warmup_passes + 1)
+        if C == 1:
 
-                def warm(carry, k):
-                    carry, _ = warmup_fn(carry, k)
-                    return carry, None
-
-                # record_only leaves theta untouched; only scen (replay) fills.
-                (_, scen), _ = jax.lax.scan(
-                    warm, (theta0, scen), k_warm[:-1]
+            def body(acc, kc):
+                theta_c, r, l = _one_chunk(theta0, kc)
+                acc = jax.tree_util.tree_map(
+                    lambda a, n, o: a + (n - o), acc, theta_c, theta0
                 )
-                k_ep = k_warm[-1]
-            (theta_c, _), (r, l) = episode_fn((theta0, scen), k_ep)
-            acc = jax.tree_util.tree_map(
-                lambda a, n, o: a + (n - o), acc, theta_c, theta0
-            )
-            return acc, (r, l)
+                return acc, (r, l)
 
-        acc0 = jax.tree_util.tree_map(jnp.zeros_like, theta0)
-        acc, (rs, ls) = jax.lax.scan(body, acc0, chunk_keys)
+            acc0 = jax.tree_util.tree_map(jnp.zeros_like, theta0)
+            acc, (rs, ls) = jax.lax.scan(body, acc0, chunk_keys)
+        else:
+            grouped = chunk_keys.reshape(
+                (n_chunks // C, C) + chunk_keys.shape[1:]
+            )
+
+            def body(acc, kcs):  # kcs [C, ...]: one group of C chunk keys
+                theta_cs, r, l = jax.vmap(
+                    lambda kc: _one_chunk(theta0, kc)
+                )(kcs)
+                acc = jax.tree_util.tree_map(
+                    lambda a, n, o: a + jnp.sum(n - o[None], axis=0),
+                    acc, theta_cs, theta0,
+                )
+                return acc, (r, l)
+
+            acc0 = jax.tree_util.tree_map(jnp.zeros_like, theta0)
+            acc, (rs, ls) = jax.lax.scan(body, acc0, grouped)
+            # [K/C, C, S] -> [K, S]: group-major flatten matches the C=1
+            # chunk order (chunk i = group i//C, lane i%C).
+            rs = rs.reshape((-1,) + rs.shape[2:])
+            ls = ls.reshape((-1,) + ls.shape[2:])
         new = jax.tree_util.tree_map(
             lambda b, a: (b + a / n_chunks).astype(b.dtype), theta0, acc
         )
@@ -946,6 +999,7 @@ def train_scenarios_chunked(
     episode_cb: Optional[Callable] = None,
     runner: Optional[Callable] = None,
     scenario_sharding=None,
+    chunk_parallel: int = 1,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
     Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
@@ -971,6 +1025,11 @@ def train_scenarios_chunked(
     Returns (pol_state, rewards [episodes, K*S], losses [episodes, K*S],
     seconds). ``chunk_key_fn(key, episode, chunk) -> key`` overrides the
     per-chunk seeding (tests use it to collapse chunks onto one draw).
+    ``chunk_parallel=C`` (C | K) executes C chunks per scan step through a
+    vmapped episode program — same per-chunk keys/trajectories and the same
+    K-delta mean, wider device program (see ``make_chunked_episode_runner``);
+    ignored when a prebuilt ``runner`` is passed (the runner fixes its own
+    width).
 
     Step-size note (measured, artifacts/LEARNING_chunked_r03.json): the
     pooled DDPG batch is ``batch_size * S * A`` transitions per slot — at
@@ -1019,7 +1078,8 @@ def train_scenarios_chunked(
         )
     if runner is None:
         runner = make_chunked_episode_runner(
-            cfg, episode_fn, n_chunks, warmup_fn=warmup_fn
+            cfg, episode_fn, n_chunks, warmup_fn=warmup_fn,
+            chunk_parallel=chunk_parallel,
         )
     run_chunks = runner
 

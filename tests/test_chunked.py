@@ -151,6 +151,45 @@ class TestChunkedTraining:
         )
         assert not np.allclose(one_l, many_l)
 
+    @pytest.mark.parametrize("impl", ["tabular", "ddpg"])
+    def test_chunk_parallel_matches_sequential(self, impl):
+        """chunk_parallel=C runs the SAME per-chunk trajectories (same key
+        chain) through a vmapped episode program — params must match the
+        C=1 runner up to delta-summation order, and the per-chunk reward
+        records must match in chunk order."""
+        cfg = _cfg(impl=impl)
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        seq, r_seq, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(7),
+            n_episodes=1, n_chunks=4,
+        )
+        par, r_par, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(7),
+            n_episodes=1, n_chunks=4, chunk_parallel=2,
+        )
+        np.testing.assert_allclose(r_par, r_seq, rtol=1e-5, atol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(seq), jax.tree_util.tree_leaves(par)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_chunk_parallel_must_divide(self):
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            make_chunked_episode_runner,
+        )
+
+        cfg = _cfg(impl="tabular")
+        with pytest.raises(ValueError, match="chunk_parallel"):
+            make_chunked_episode_runner(
+                cfg, lambda c, k: (c, (None, None)), 3, chunk_parallel=2
+            )
+
     def test_ddpg_adam_count_dtype_preserved(self):
         """Delta averaging must not float-ify Adam's int step counters."""
         cfg = _cfg(impl="ddpg")
@@ -367,6 +406,21 @@ class TestLearnBatchCap:
         a, b = flat(out_cap), flat(out_full)
         assert np.isfinite(a).all()
         assert not np.allclose(a, b)
+
+    def test_stripe_count_degrades_gracefully(self):
+        """A cap that is not a multiple of 8 must keep multiple stripes
+        (largest divisor <= 8), not collapse to one contiguous block — a
+        single block covers only ~cap/A consecutive scenarios, the
+        correlated-draw failure mode the stripes exist to avoid."""
+        pick = lambda cap: next(n for n in range(8, 0, -1) if cap % n == 0)
+        assert pick(32768) == 8
+        assert pick(30000) == 8  # 30000 = 8 * 3750
+        assert pick(100) == 5
+        assert pick(30002) == 7  # 2 * 7 * ...
+        assert pick(97) == 1  # prime: nothing to split evenly
+        # And the update itself still runs finite at such a cap.
+        _, losses, rewards = self._one_episode(self._shared_cfg(90))
+        assert np.isfinite(losses).all() and np.isfinite(rewards).all()
 
     def test_cap_raises_the_auto_scaled_lrs(self):
         """The lr rule keys on the EFFECTIVE (capped) batch: capping a huge

@@ -1,0 +1,86 @@
+"""Measure chunk-parallel width C for the chunked north-star runner.
+
+Same chunk size/update semantics as bench_northstar (A=1000, S_chunk=128,
+capped pooled DDPG, factored market); K is kept small so compile+run stays
+probe-sized — per-scenario-step throughput is width-dependent, not
+K-dependent (the runner is one scan over K/C groups either way).
+
+Usage: PYTHONPATH=/root/repo:$PYTHONPATH python tools/chunk_parallel_probe.py [C ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main(widths) -> list:
+    from p2pmicrogrid_tpu.config import (
+        BatteryConfig,
+        DDPGConfig,
+        SimConfig,
+        TrainConfig,
+        default_config,
+    )
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+    from p2pmicrogrid_tpu.parallel.scenarios import (
+        make_chunked_episode_runner,
+        make_shared_episode_fn,
+    )
+    from p2pmicrogrid_tpu.train import make_policy
+
+    A, S_chunk, K = 1000, 128, 8
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S_chunk),
+        battery=BatteryConfig(enabled=True),
+        train=TrainConfig(implementation="ddpg"),
+        ddpg=DDPGConfig(buffer_size=96, batch_size=4, share_across_agents=True),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    policy = make_policy(cfg)
+    key = jax.random.PRNGKey(0)
+    ps = init_shared_pol_state(cfg, key)
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S_chunk),
+        n_scenarios=S_chunk,
+    )
+    slots = cfg.sim.slots_per_day
+    rows = []
+    for C in widths:
+        runner = make_chunked_episode_runner(
+            cfg, episode_fn, K, chunk_parallel=C
+        )
+        chunk_keys = jax.random.split(jax.random.PRNGKey(1), K)
+        out = runner(ps, chunk_keys)  # compile
+        jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+
+        best = float("inf")
+        for _ in range(3):
+            p = ps
+            t0 = time.time()
+            for i in range(3):  # chained dependent episode calls
+                p, r, _ = runner(p, jax.random.split(jax.random.PRNGKey(i), K))
+            float(jax.tree_util.tree_leaves(p)[0].sum())
+            best = min(best, (time.time() - t0) / 3)
+
+        steps_s = slots * S_chunk * K / best
+        row = {
+            "chunk_parallel": C,
+            "episode_ms": round(best * 1e3, 1),
+            "scenario_env_steps_per_sec": round(steps_s),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    widths = [int(a) for a in sys.argv[1:]] or [1, 2, 4]
+    main(widths)

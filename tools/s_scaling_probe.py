@@ -1,0 +1,97 @@
+"""Scenario-chunk-size scaling probe for the config-4 / north-star slot.
+
+The round-4 roofline (artifacts/ROOFLINE_r04.json) shows the factored slot
+is no longer memory-bound: ~1.4 ms of the S=64 slot is a per-slot fixed
+phase (tiny act matmuls, [S, A] physics vector ops, scan iteration) that
+amortizes over the scenario axis. This probe measures the full shared
+episode (act + factored market + physics + capped pooled learn + replay)
+at A=1000 across chunk sizes S and prints scenario-env-steps/s for each —
+the direct evidence for choosing the north-star chunk shape (K x S with
+K*S = 10,240 fixed).
+
+Usage: PYTHONPATH=/root/repo python tools/s_scaling_probe.py [S ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main(sizes) -> list:
+    from p2pmicrogrid_tpu.config import (
+        BatteryConfig,
+        DDPGConfig,
+        SimConfig,
+        TrainConfig,
+        default_config,
+    )
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel import (
+        init_shared_state,
+        make_scenario_traces,
+        stack_scenario_arrays,
+    )
+    from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+    from p2pmicrogrid_tpu.train import make_policy
+
+    import os
+
+    A = 1000
+    buf = int(os.environ.get("PROBE_BUFFER", "96"))  # bench_northstar's ring
+    key = jax.random.PRNGKey(0)
+    rows = []
+    for S in sizes:
+        cfg = default_config(
+            sim=SimConfig(n_agents=A, n_scenarios=S),
+            battery=BatteryConfig(enabled=True),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(buffer_size=buf, batch_size=4,
+                            share_across_agents=True),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        policy = make_policy(cfg)
+        # On-device trace synthesis (the north-star transport): host-built
+        # arrays at S>=256 are baked into the HLO as constants and blow the
+        # remote compile service's request-size limit (HTTP 413).
+        from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+
+        ep = make_shared_episode_fn(
+            cfg, policy, None, ratings,
+            arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S),
+            n_scenarios=S,
+        )
+        carry = init_shared_state(cfg, key)
+        k = jax.random.PRNGKey(1)
+        carry2, _ = ep(carry, k)  # compile
+        jax.block_until_ready(jax.tree_util.tree_leaves(carry2)[0])
+
+        best = float("inf")
+        for _ in range(3):
+            c = carry
+            t0 = time.time()
+            for i in range(4):  # chained dependent episodes, scalar sync
+                c, _ = ep(c, jax.random.fold_in(k, i))
+            float(jax.tree_util.tree_leaves(c)[0].sum())
+            best = min(best, (time.time() - t0) / 4)
+
+        slots = cfg.sim.slots_per_day
+        steps_s = slots * S / best
+        row = {
+            "S": S,
+            "episode_ms": round(best * 1e3, 1),
+            "slot_ms": round(best * 1e3 / slots, 3),
+            "scenario_env_steps_per_sec": round(steps_s),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    sizes = [int(a) for a in sys.argv[1:]] or [64, 128, 256, 512]
+    main(sizes)
