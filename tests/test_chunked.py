@@ -606,3 +606,32 @@ class TestChunkedOnMesh:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
             )
+
+    def test_sharded_composes_with_chunk_parallel(self):
+        """scenario_sharding (each chunk's scenario axis over the mesh) and
+        chunk_parallel (C chunks vmapped side by side) are orthogonal axes of
+        the same runner — together they must still change placement only."""
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+        from p2pmicrogrid_tpu.parallel.mesh import make_mesh, scenario_sharding
+
+        cfg = _cfg(impl="ddpg", S=8, A=3)
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        sh = scenario_sharding(make_mesh())
+
+        both, r_both, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=4, scenario_sharding=sh, chunk_parallel=2,
+        )
+        plain, r_plain, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=4,
+        )
+        np.testing.assert_allclose(r_both, r_plain, rtol=1e-5, atol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(both), jax.tree_util.tree_leaves(plain)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )

@@ -150,7 +150,14 @@ def main() -> None:
         arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S_CHUNK),
         n_scenarios=S_CHUNK,
     )
-    runner = make_chunked_episode_runner(cfg, episode_fn, K)
+    # NS_CHUNK_PARALLEL widens the runner (bench_northstar ships C=2); the
+    # per-chunk trajectories and K-delta mean are identical either way, so
+    # curves at different widths must agree up to float summation order.
+    import os
+
+    C = int(os.environ.get("NS_CHUNK_PARALLEL", "1"))
+    doc["config"]["chunk_parallel"] = C
+    runner = make_chunked_episode_runner(cfg, episode_fn, K, chunk_parallel=C)
 
     def record(ep, extra=None):
         c, r = greedy_cost(params, jax.random.PRNGKey(1))

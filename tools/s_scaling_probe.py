@@ -31,11 +31,8 @@ def main(sizes) -> list:
         default_config,
     )
     from p2pmicrogrid_tpu.envs import make_ratings
-    from p2pmicrogrid_tpu.parallel import (
-        init_shared_state,
-        make_scenario_traces,
-        stack_scenario_arrays,
-    )
+    from p2pmicrogrid_tpu.parallel import init_shared_state
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
     from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
     from p2pmicrogrid_tpu.train import make_policy
 
@@ -58,8 +55,6 @@ def main(sizes) -> list:
         # On-device trace synthesis (the north-star transport): host-built
         # arrays at S>=256 are baked into the HLO as constants and blow the
         # remote compile service's request-size limit (HTTP 413).
-        from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
-
         ep = make_shared_episode_fn(
             cfg, policy, None, ratings,
             arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S),
