@@ -151,12 +151,13 @@ class TestChunkedTraining:
         )
         assert not np.allclose(one_l, many_l)
 
-    @pytest.mark.parametrize("impl", ["tabular", "ddpg"])
+    @pytest.mark.parametrize("impl", ["tabular", "ddpg", "dqn"])
     def test_chunk_parallel_matches_sequential(self, impl):
         """chunk_parallel=C runs the SAME per-chunk trajectories (same key
         chain) through a vmapped episode program — params must match the
         C=1 runner up to delta-summation order, and the per-chunk reward
-        records must match in chunk order."""
+        records must match in chunk order. dqn additionally exercises the
+        per-chunk record-only replay warmup scan under the vmap."""
         cfg = _cfg(impl=impl)
         ratings = make_ratings(cfg, np.random.default_rng(0))
         policy = make_policy(cfg)
