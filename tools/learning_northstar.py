@@ -70,6 +70,15 @@ def main() -> None:
         OUT = args[1]
     if len(args) >= 3:
         SEED = int(args[2])
+    import os
+
+    # NS_LEARN_CAP overrides DDPGConfig.learn_batch_cap for A/B runs
+    # against the shipped capped default ("0" = uncapped, matching the CLI's
+    # --learn-batch-cap 0 convention).
+    cap_env = os.environ.get("NS_LEARN_CAP")
+    ddpg_kw = {}
+    if cap_env is not None:
+        ddpg_kw["learn_batch_cap"] = int(cap_env) or None
     cfg = default_config(
         sim=SimConfig(
             n_agents=A, n_scenarios=S_CHUNK, market_dtype="bfloat16"
@@ -78,7 +87,8 @@ def main() -> None:
         train=TrainConfig(implementation="ddpg"),
         # bench_northstar's exact learner config; lrs come from the default
         # auto rule, not from hand tuning.
-        ddpg=DDPGConfig(buffer_size=96, batch_size=4, share_across_agents=True),
+        ddpg=DDPGConfig(buffer_size=96, batch_size=4, share_across_agents=True,
+                        **ddpg_kw),
     )
     eff = auto_scale_ddpg_lrs(cfg)
     doc = {
