@@ -79,6 +79,10 @@ def main() -> None:
     ddpg_kw = {}
     if cap_env is not None:
         ddpg_kw["learn_batch_cap"] = int(cap_env) or None
+    # NS_LR_MULT post-multiplies the auto-rule's effective lrs (basin
+    # operating-point probes): the scaled lrs are pinned explicitly and the
+    # auto rule is turned off so the episode builder doesn't rescale.
+    lr_mult = float(os.environ.get("NS_LR_MULT", "1"))
     cfg = default_config(
         sim=SimConfig(
             n_agents=A, n_scenarios=S_CHUNK, market_dtype="bfloat16"
@@ -90,6 +94,19 @@ def main() -> None:
         ddpg=DDPGConfig(buffer_size=96, batch_size=4, share_across_agents=True,
                         **ddpg_kw),
     )
+    if lr_mult != 1.0:
+        import dataclasses
+
+        scaled = auto_scale_ddpg_lrs(cfg)
+        cfg = dataclasses.replace(
+            cfg,
+            ddpg=dataclasses.replace(
+                cfg.ddpg,
+                actor_lr=scaled.ddpg.actor_lr * lr_mult,
+                critic_lr=scaled.ddpg.critic_lr * lr_mult,
+                lr_auto_scale=False,
+            ),
+        )
     eff = auto_scale_ddpg_lrs(cfg)
     doc = {
         "round": 4,
@@ -106,7 +123,11 @@ def main() -> None:
             "pooled_batch": ddpg_pooled_batch(cfg),
             "learn_batch_cap": cfg.ddpg.learn_batch_cap,
             "market_impl": _resolved_market_impl(cfg),
-            "lr_rule": "auto (sqrt(400/effective pooled), scenarios.py)",
+            "lr_rule": (
+                "auto (sqrt(400/effective pooled), scenarios.py)"
+                if lr_mult == 1.0
+                else f"auto x {lr_mult} (NS_LR_MULT, pinned)"
+            ),
             "effective_actor_lr": eff.ddpg.actor_lr,
             "effective_critic_lr": eff.ddpg.critic_lr,
             "seed": SEED,  # init/training randomness; community + eval fixed
