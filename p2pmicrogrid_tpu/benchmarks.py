@@ -739,6 +739,7 @@ def bench_northstar() -> dict:
         "aggregate_scenarios": S_chunk * K,
         "chunk_scenarios": S_chunk,
         "chunks_per_episode": K,
+        "chunk_parallel": 2,
     }
 
 
@@ -1062,6 +1063,7 @@ def main() -> None:
     print(f"bench: backend resolved to {backend}", file=sys.stderr, flush=True)
 
     headline = None  # last successful row in BENCHES order (the north star)
+    last_row = None  # last row actually printed, success or error
     for name in BENCHES:
         if name not in selected:
             continue
@@ -1077,6 +1079,7 @@ def main() -> None:
                 "error": f"{type(err).__name__}: {err}"[:300],
             }
         print(json.dumps(row), flush=True)
+        last_row = row
         # Drop the finished bench's compiled executables and cached buffers:
         # letting them accumulate leaves the last (largest) benches to run
         # under device-memory pressure — a single-session suite run measured
@@ -1096,8 +1099,9 @@ def main() -> None:
                 flush=True,
             )
     # The driver parses the LAST stdout line: when the final bench failed but
-    # earlier ones succeeded, close with the best successful row (a duplicate
-    # line is harmless; a value-0 error row as the round's number is not).
+    # earlier ones succeeded, close with the best successful row. Only reprint
+    # when the last emitted line is NOT already the headline — each metric
+    # should appear exactly once in a clean run.
     if headline is None:
         print(
             json.dumps(
@@ -1110,7 +1114,7 @@ def main() -> None:
             ),
             flush=True,
         )
-    else:
+    elif last_row is not headline:
         print(json.dumps(headline), flush=True)
 
 

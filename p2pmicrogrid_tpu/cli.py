@@ -350,6 +350,14 @@ def _cmd_train_scenarios(args) -> int:
     cfg = _build_cfg(args)
     S = cfg.sim.n_scenarios
     chunks = getattr(args, "chunks", 1)
+    chunk_parallel = getattr(args, "chunk_parallel", 1)
+    if chunk_parallel > 1 and chunks <= 1:
+        # Width only applies to the chunked runner; silently ignoring it
+        # would hand the user sequential behavior they didn't ask for.
+        raise SystemExit(
+            f"--chunk-parallel {chunk_parallel} requires --chunks > 1 "
+            "(the width vmaps chunks of the chunked runner side by side)"
+        )
     setting = _scenario_setting(cfg, args.shared, chunks)
     rng = np.random.default_rng(cfg.train.seed)
     ratings = make_ratings(cfg, rng)
@@ -419,16 +427,70 @@ def _cmd_train_scenarios(args) -> int:
         pol_state, scen_state = warmup_shared_dqn(
             cfg, policy, pol_state, scen_state, arrays, ratings, k_warm
         )
+    health_every = getattr(args, "health_every", 10) if args.shared else 0
+    health_cb = None
+    monitor = None
+    if health_every > 0:
+        from p2pmicrogrid_tpu.train.health import HealthMonitor
+
+        monitor = HealthMonitor(cfg.sim.slots_per_day)
+
+        def health_cb(point):
+            print(
+                f"health episode {point.episode}: greedy cost "
+                f"{point.greedy_cost_eur:.1f} EUR, greedy reward "
+                f"{point.greedy_reward:.1f} [{point.status}]"
+            )
+            if store:
+                store.log_training_health(
+                    setting, cfg.train.implementation, point.episode,
+                    point.greedy_cost_eur, point.greedy_reward, point.status,
+                )
+
     with _profile_ctx(args):
-        if chunks > 1:
+        if chunks > 1 and health_every > 0:
+            from p2pmicrogrid_tpu.train.health import train_chunked_with_health
+
+            pol_state, rewards, _, seconds, monitor = train_chunked_with_health(
+                cfg, policy, pol_state, ratings, key, n_episodes,
+                n_chunks=chunks, eval_every=health_every, episode0=episode0,
+                episode_cb=episode_cb, chunk_parallel=chunk_parallel,
+                mitigate=getattr(args, "basin_mitigate", "warn"),
+                health_cb=health_cb, monitor=monitor,
+            )
+        elif chunks > 1:
             from p2pmicrogrid_tpu.parallel import train_scenarios_chunked
 
             pol_state, rewards, _, seconds = train_scenarios_chunked(
                 cfg, policy, pol_state, ratings, key, n_episodes,
                 n_chunks=chunks, episode0=episode0, episode_cb=episode_cb,
-                chunk_parallel=getattr(args, "chunk_parallel", 1),
+                chunk_parallel=chunk_parallel,
             )
         elif args.shared:
+            if health_every > 0:
+                # Non-chunked shared mode: evaluate from the episode callback
+                # (the carry's pol_state is the shared bundle).
+                from p2pmicrogrid_tpu.train.health import (
+                    make_greedy_eval,
+                    untrained_reference_cost,
+                )
+
+                greedy_eval = make_greedy_eval(cfg, policy, ratings)
+                # Classifier thresholds are fractions of the UNTRAINED
+                # greedy cost; on resume the restored policy can't supply it.
+                monitor.initial_cost = untrained_reference_cost(
+                    cfg, policy, greedy_eval, seed=cfg.train.seed
+                )
+                inner_cb = episode_cb
+
+                def episode_cb(ep, r, l, carry):
+                    if inner_cb:
+                        inner_cb(ep, r, l, carry)
+                    if ep % health_every == 0:
+                        c, rw = greedy_eval(carry[0], jax.random.PRNGKey(1))
+                        monitor.update(ep, c, rw)
+                        health_cb(monitor.points[-1])
+
             pol_state, _, rewards, _, seconds = train_scenarios_shared(
                 cfg, policy, pol_state, arrays, ratings, key, n_episodes,
                 replay_s=scen_state, episode0=episode0, episode_cb=episode_cb,
@@ -438,6 +500,12 @@ def _cmd_train_scenarios(args) -> int:
                 cfg, policy, pol_state, arrays, ratings, key, n_episodes,
                 episode0=episode0, episode_cb=episode_cb,
             )
+    if monitor is not None and monitor.basin_entries:
+        print(
+            f"health summary: basin entered at episodes "
+            f"{monitor.basin_entries}, exits at {monitor.basin_exits or '—'} "
+            f"(see training_health table / README basin notes)"
+        )
     save_checkpoint(ckpt_dir, pol_state, cfg.train.max_episodes - 1)
     if args.timing_json:
         _save_times(args.timing_json, setting, train_time=seconds)
@@ -1181,6 +1249,21 @@ def main(argv=None) -> int:
                    help="ddpg + --shared: ONE actor-critic for the whole "
                         "community (shared-critic MARL) instead of per-agent "
                         "copies")
+    p.add_argument("--health-every", type=int, default=10, dest="health_every",
+                   metavar="N",
+                   help="with --scenarios --shared: run the greedy held-out "
+                        "health eval every N episodes, logging greedy cost "
+                        "AND reward (the don't-heat basin shows as reward "
+                        "collapse while cost falls — cost-only logging is "
+                        "blind to it; train/health.py). 0 disables. "
+                        "Default 10.")
+    p.add_argument("--basin-mitigate", choices=["warn", "lr-boost"],
+                   default="warn", dest="basin_mitigate",
+                   help="on basin detection (chunked mode): 'warn' alerts "
+                        "only (default); 'lr-boost' trains through an "
+                        "episode program with the effective lrs boosted "
+                        "until the greedy policy recovers (measured to cut "
+                        "seed-2's ~140-episode dwell; see README)")
     p.add_argument("--actor-lr", type=float, dest="actor_lr",
                    help="DDPG actor learning rate (default 1e-4, scaled "
                         "automatically with the pooled shared-update batch "

@@ -60,6 +60,15 @@ _DDL = [
        (setting text NOT NULL, implementation text NOT NULL,
         episode integer NOT NULL, reward real, error real,
         PRIMARY KEY (setting, implementation, episode))""",
+    # No reference counterpart: the greedy held-out health surface
+    # (train/health.py). The reference's training_progress logs the noisy
+    # training reward only — blind to the measured don't-heat basin where
+    # cost improves while comfort collapses (README.md, round 4).
+    """CREATE TABLE IF NOT EXISTS training_health
+       (setting text NOT NULL, implementation text NOT NULL,
+        episode integer NOT NULL, greedy_cost real, greedy_reward real,
+        status text NOT NULL,
+        PRIMARY KEY (setting, implementation, episode))""",
 ]
 
 
@@ -106,6 +115,25 @@ class ResultsStore:
             self.con.execute(
                 "INSERT OR REPLACE INTO training_progress VALUES (?,?,?,?,?)",
                 (setting, implementation, episode, float(reward), float(error)),
+            )
+
+    def log_training_health(
+        self,
+        setting: str,
+        implementation: str,
+        episode: int,
+        greedy_cost: float,
+        greedy_reward: float,
+        status: str,
+    ) -> None:
+        """Greedy held-out cost/reward + basin classification per eval
+        period (train/health.py — the live comfort-collapse signal the
+        reference's training_progress cannot express)."""
+        with self.con:
+            self.con.execute(
+                "INSERT OR REPLACE INTO training_health VALUES (?,?,?,?,?,?)",
+                (setting, implementation, episode, float(greedy_cost),
+                 float(greedy_reward), status),
             )
 
     def log_run_results(

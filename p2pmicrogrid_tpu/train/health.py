@@ -1,0 +1,399 @@
+"""Runtime training-health surface for the flagship scenario paths.
+
+The reference's one live health signal is the running training reward logged
+to ``training_progress`` every decay window
+(reference/microgrid/community.py:279-288, database.py:196-209). At the
+chunked north-star scale that signal is noise-dominated AND structurally
+blind: the shipped capped fast path has a measured metastable "don't-heat"
+basin (artifacts/LEARNING_northstar_r04b_seed2_full.json) where the greedy
+policy sells PV instead of heating — community COST goes negative (looks
+great) while greedy REWARD craters to ~-1700 (comfort collapse, the exact
+outcome the reference's reward exists to prevent, agent.py:225-232). Cost-only
+or training-reward-only logging cannot see it.
+
+This module makes the greedy held-out eval (previously only in
+tools/learning_northstar.py) a first-class training surface:
+
+- ``make_greedy_eval``   jitted greedy (explore=False) episode on a FIXED
+                         held-out scenario set -> (community cost, reward).
+- ``classify_health``    the measured basin/slide detector (thresholds
+                         calibrated on the committed r04 seed curves).
+- ``HealthMonitor``      stateful tracker: feeds evals to the classifier,
+                         records alerts, serializes for artifacts/stores.
+- ``train_chunked_with_health``  block-wise wrapper over
+                         ``train_scenarios_chunked`` that evaluates every
+                         ``eval_every`` episodes, logs cost AND reward, warns
+                         on basin entry, and (opt-in) applies the measured
+                         lr-boost mitigation until the policy escapes.
+
+Detector calibration (all numbers from committed artifacts; values are
+per-episode sums over ``slots_per_day`` slots, reward mean over agents, cost
+summed over the community, both averaged over the held-out scenarios):
+
+===========  ==========  ============  ====================================
+state        cost (EUR)   reward        example
+===========  ==========  ============  ====================================
+healthy      ~1000-1700   -1 .. -2      seed 0 episodes 20-240
+untrained    ~2400-4800   -600..-2600   every seed at episode 0 (cost HIGH)
+slide        ~500-700     -50..-200     seed 3 episodes 60-100 (recovered)
+basin        < 0          -1300..-1733  seed 2 episodes 40-200
+===========  ==========  ============  ====================================
+
+The discriminating signature is reward collapse WITH low/negative cost:
+untrained policies also have terrible reward but their cost is high (they
+heat badly AND trade badly), so the cost condition separates "still
+learning" from "profiting by not heating".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.envs import init_physical
+from p2pmicrogrid_tpu.envs.community import (
+    AgentRatings,
+    slot_dynamics_batched,
+)
+
+# Per-slot reward thresholds (reward here is the per-episode sum over slots,
+# so divide by slots_per_day before comparing). Healthy ~-0.01/slot; a deep
+# comfort violation costs ~-10/slot (the x10 offset band penalty,
+# ops/thermal.py); the basin sits at -14..-18/slot.
+BASIN_REWARD_PER_SLOT = -2.0   # >=~20% of agent-slots in deep violation
+SLIDE_REWARD_PER_SLOT = -0.25
+# Cost conditions, relative to the episode-0 (untrained) greedy cost of the
+# same run — scale-free across agent counts and tariffs.
+BASIN_COST_FRAC = 0.10   # cost below 10% of untrained => "earning by not heating"
+SLIDE_COST_FRAC = 0.50
+
+
+def make_greedy_eval(
+    cfg: ExperimentConfig,
+    policy,
+    ratings,
+    s_eval: int = 8,
+    eval_seed: int = 10_000,
+) -> Callable[[object, jax.Array], Tuple[jax.Array, jax.Array]]:
+    """Jitted greedy held-out eval: ``fn(pol_state, key) -> (cost, reward)``.
+
+    One explore=False episode over a FIXED set of ``s_eval`` held-out
+    scenarios (drawn once from ``eval_seed``, never trained on): returns the
+    community cost (EUR, summed over slots+agents, scenario mean) and the
+    greedy reward (summed over slots, mean over agents+scenarios) — the two
+    numbers whose DIVERGENCE is the basin signature. Works for all three
+    shared implementations; DDPG acts through its deterministic actor (no OU
+    state is carried, matching tools/learning_northstar.py's evaluator).
+    """
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+
+    eval_arrays = device_episode_arrays(
+        cfg, jax.random.PRNGKey(eval_seed), ratings, s_eval
+    )
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    impl = cfg.train.implementation
+
+    act_fn = None
+    if impl == "ddpg":
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+
+        def act_fn(p, obs_s, prev, round_key, ex):
+            frac, q, _ = ddpg_shared_act(
+                cfg.ddpg, p, obs_s, jnp.zeros(obs_s.shape[:2]),
+                round_key, explore=False,
+            )
+            return frac, frac, q, ex
+
+    @jax.jit
+    def greedy_eval(pol_state, key):
+        k_phys, k_scan = jax.random.split(key)
+        phys = jax.vmap(lambda k: init_physical(cfg, k))(
+            jax.random.split(k_phys, s_eval)
+        )
+        xs = jax.tree_util.tree_map(
+            lambda x: jnp.swapaxes(x, 0, 1), eval_arrays
+        )
+        xs = (xs.time, xs.t_out, xs.load_w, xs.pv_w,
+              xs.next_time, xs.next_load_w, xs.next_pv_w)
+
+        def slot(carry, xs_t):
+            phys_s, kk = carry
+            kk, k_act = jax.random.split(kk)
+            phys_s, _, out, _, _ = slot_dynamics_batched(
+                cfg, policy, pol_state, phys_s, xs_t, k_act, ratings_j,
+                explore=False, act_fn=act_fn,
+            )
+            return (phys_s, kk), (out.cost, out.reward)
+
+        (_, _), (cost, reward) = jax.lax.scan(slot, (phys, k_scan), xs)
+        return (
+            jnp.sum(cost, axis=(0, 2)).mean(),
+            jnp.sum(jnp.mean(reward, axis=-1), axis=0).mean(),
+        )
+
+    return greedy_eval
+
+
+def classify_health(
+    cost: float, reward: float, slots: int, initial_cost: float
+) -> str:
+    """Classify one greedy eval point: 'healthy' | 'slide' | 'basin'.
+
+    ``initial_cost`` is the same run's episode-0 greedy cost (the untrained
+    reference point); see the module docstring's calibration table.
+    """
+    r_slot = reward / max(slots, 1)
+    ref = abs(initial_cost)
+    if r_slot < BASIN_REWARD_PER_SLOT and cost < BASIN_COST_FRAC * ref:
+        return "basin"
+    if r_slot < SLIDE_REWARD_PER_SLOT and cost < SLIDE_COST_FRAC * ref:
+        return "slide"
+    return "healthy"
+
+
+class HealthPoint(NamedTuple):
+    episode: int
+    greedy_cost_eur: float
+    greedy_reward: float
+    status: str
+
+
+class HealthMonitor:
+    """Tracks greedy held-out evals and flags comfort collapse.
+
+    Feed it one ``update(episode, cost, reward)`` per eval; it classifies
+    against the UNTRAINED-policy greedy cost (``initial_cost`` — taken from
+    the first point when starting fresh, or measured explicitly on a fresh
+    init when resuming, see ``train_chunked_with_health``), remembers basin
+    entry/exit episodes, and prints a loud warning to stderr on every
+    non-healthy point (an alert the user sees within one eval period of
+    entry — the committed seed-2 curve enters between episodes 20 and 40
+    and is flagged at the first in-basin eval).
+    """
+
+    def __init__(self, slots: int, warn_stream=None, initial_cost=None):
+        self.slots = slots
+        self.warn_stream = warn_stream if warn_stream is not None else sys.stderr
+        self.points: list[HealthPoint] = []
+        self.initial_cost: Optional[float] = (
+            None if initial_cost is None else float(initial_cost)
+        )
+        self.basin_entries: list[int] = []   # first flagged episode per entry
+        self.basin_exits: list[int] = []     # first healthy episode after one
+
+    @property
+    def in_basin(self) -> bool:
+        return len(self.basin_entries) > len(self.basin_exits)
+
+    def update(self, episode: int, cost: float, reward: float) -> str:
+        cost, reward = float(cost), float(reward)
+        if self.initial_cost is None:
+            self.initial_cost = cost
+        status = classify_health(cost, reward, self.slots, self.initial_cost)
+        was_in_basin = self.in_basin
+        if status == "basin" and not was_in_basin:
+            self.basin_entries.append(episode)
+            print(
+                f"HEALTH ALERT (episode {episode}): greedy reward "
+                f"{reward:.0f} with community cost {cost:.0f} EUR — the "
+                "policy is profiting by NOT heating (comfort collapse, the "
+                "metastable don't-heat basin). Mitigation: re-run with "
+                "--learn-batch-cap 0 (uncapped low-lr rule, measured "
+                "basin-free) or enable --basin-mitigate lr-boost.",
+                file=self.warn_stream, flush=True,
+            )
+        elif status == "slide" and not was_in_basin:
+            print(
+                f"health warning (episode {episode}): greedy reward "
+                f"{reward:.0f} at cost {cost:.0f} EUR — comfort degrading "
+                "while cost falls; watching for basin entry.",
+                file=self.warn_stream, flush=True,
+            )
+        elif status == "healthy" and was_in_basin:
+            self.basin_exits.append(episode)
+            print(
+                f"health: recovered at episode {episode} (greedy reward "
+                f"{reward:.0f}, cost {cost:.0f} EUR).",
+                file=self.warn_stream, flush=True,
+            )
+        self.points.append(HealthPoint(episode, cost, reward, status))
+        return status
+
+    def to_dict(self) -> dict:
+        return {
+            "slots": self.slots,
+            "initial_cost": self.initial_cost,
+            "basin_entries": self.basin_entries,
+            "basin_exits": self.basin_exits,
+            "points": [p._asdict() for p in self.points],
+        }
+
+
+def untrained_reference_cost(
+    cfg: ExperimentConfig, policy, greedy_eval, seed: int = 0
+) -> float:
+    """Greedy cost of a FRESHLY-initialized shared policy — the classifier's
+    calibration reference. Needed when resuming: the restored policy's first
+    eval reflects training already done, and seeding ``initial_cost`` from
+    it would shrink the slide/basin cost thresholds by ~2-3x (they are
+    fractions of the UNTRAINED cost)."""
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+
+    ref_ps = init_shared_pol_state(cfg, jax.random.PRNGKey(seed))
+    c, _ = greedy_eval(ref_ps, jax.random.PRNGKey(1))
+    return float(c)
+
+
+def _lr_boosted_cfg(cfg: ExperimentConfig, mult: float) -> ExperimentConfig:
+    """Pin the auto-rule's effective lrs x ``mult`` (mitigation program).
+
+    Same mechanism as tools/learning_northstar.py's NS_LR_MULT probes: scale
+    the EFFECTIVE (pooled-batch-rule) lrs and disable the auto rule so the
+    episode builder does not rescale them again.
+    """
+    from p2pmicrogrid_tpu.parallel.scenarios import auto_scale_ddpg_lrs
+
+    scaled = auto_scale_ddpg_lrs(cfg)
+    return dataclasses.replace(
+        cfg,
+        ddpg=dataclasses.replace(
+            cfg.ddpg,
+            actor_lr=scaled.ddpg.actor_lr * mult,
+            critic_lr=scaled.ddpg.critic_lr * mult,
+            lr_auto_scale=False,
+        ),
+    )
+
+
+def train_chunked_with_health(
+    cfg: ExperimentConfig,
+    policy,
+    pol_state,
+    ratings,
+    key: jax.Array,
+    n_episodes: int,
+    n_chunks: int,
+    eval_every: int = 10,
+    episode0: int = 0,
+    episode_cb: Optional[Callable] = None,
+    chunk_parallel: int = 1,
+    mitigate: str = "warn",
+    lr_boost: float = 3.0,
+    monitor: Optional[HealthMonitor] = None,
+    health_cb: Optional[Callable] = None,
+    s_eval: int = 8,
+) -> Tuple[object, np.ndarray, np.ndarray, float, HealthMonitor]:
+    """``train_scenarios_chunked`` with the health surface on.
+
+    Runs the chunked trainer in blocks of ``eval_every`` episodes; between
+    blocks the greedy held-out eval runs (cheap: ``s_eval`` scenarios vs
+    n_chunks x S trained per episode — <1% overhead at the north star) and
+    the monitor classifies it. ``mitigate``:
+
+    - ``"warn"``  (default): alert on basin entry, keep training unchanged.
+    - ``"lr-boost"``: while in the basin, train through an episode program
+      with the effective lrs x ``lr_boost``. Rationale (measured, round 4):
+      basin ENTRY time scales inversely with step size
+      (artifacts/LEARNING_northstar_r04b_seed2_lr0.5.json), i.e. traversal
+      of the flat don't-heat region is lr-limited — boosting lr while
+      inside accelerates the same traversal outward; the normal program is
+      restored at the first healthy eval, so steady-state semantics are
+      unchanged for runs that never enter.
+
+    ``health_cb(point: HealthPoint)`` fires after every eval (CLI uses it to
+    log to the results store). Returns (pol_state, rewards, losses, seconds,
+    monitor); rewards/losses concatenate the per-block outputs.
+    """
+    from p2pmicrogrid_tpu.parallel.scenarios import (
+        make_chunked_episode_runner,
+        make_shared_episode_fn,
+        train_scenarios_chunked,
+    )
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+
+    if mitigate not in ("warn", "lr-boost"):
+        raise ValueError(f"mitigate must be 'warn' or 'lr-boost', got {mitigate!r}")
+    if mitigate == "lr-boost" and cfg.train.implementation != "ddpg":
+        # _lr_boosted_cfg scales the DDPG lrs; a "boosted" dqn/tabular
+        # program would silently train with unchanged hyperparameters.
+        raise ValueError(
+            "basin mitigation 'lr-boost' is only implemented for ddpg "
+            f"(got {cfg.train.implementation!r}); use 'warn'"
+        )
+    S = cfg.sim.n_scenarios
+
+    def build_runner(run_cfg):
+        episode_fn = make_shared_episode_fn(
+            run_cfg, policy, None, ratings,
+            arrays_fn=lambda k: device_episode_arrays(
+                run_cfg, k, ratings, S
+            ),
+            n_scenarios=S,
+        )
+        warmup_fn = None
+        if run_cfg.train.implementation == "dqn" and run_cfg.dqn.warmup_passes > 0:
+            warmup_fn = make_shared_episode_fn(
+                run_cfg, policy, None, ratings,
+                arrays_fn=lambda k: device_episode_arrays(
+                    run_cfg, k, ratings, S
+                ),
+                n_scenarios=S, record_only=True,
+            )
+        runner = make_chunked_episode_runner(
+            run_cfg, episode_fn, n_chunks, warmup_fn=warmup_fn,
+            chunk_parallel=chunk_parallel,
+        )
+        return runner, episode_fn
+
+    normal_runner, normal_episode_fn = build_runner(cfg)
+    boosted = None  # (runner, episode_fn), built lazily on first basin entry
+
+    greedy_eval = make_greedy_eval(cfg, policy, ratings, s_eval=s_eval)
+    monitor = monitor or HealthMonitor(cfg.sim.slots_per_day)
+    if monitor.initial_cost is None and episode0 > 0:
+        # Resuming: calibrate against a fresh init, not the restored policy.
+        monitor.initial_cost = untrained_reference_cost(
+            cfg, policy, greedy_eval, seed=cfg.train.seed
+        )
+
+    def do_eval(ep):
+        c, r = greedy_eval(pol_state, jax.random.PRNGKey(1))
+        monitor.update(ep, c, r)
+        if health_cb:
+            health_cb(monitor.points[-1])
+
+    do_eval(episode0)
+    rewards, losses = [], []
+    seconds = 0.0
+    done = 0
+    while done < n_episodes:
+        block = min(eval_every, n_episodes - done)
+        runner, episode_fn = normal_runner, normal_episode_fn
+        if mitigate == "lr-boost" and monitor.in_basin:
+            if boosted is None:
+                boosted = build_runner(_lr_boosted_cfg(cfg, lr_boost))
+            runner, episode_fn = boosted
+        pol_state, r, l, secs = train_scenarios_chunked(
+            cfg, policy, pol_state, ratings, key,
+            n_episodes=block, n_chunks=n_chunks,
+            episode0=episode0 + done, episode_cb=episode_cb,
+            episode_fn=episode_fn, runner=runner,
+        )
+        rewards.append(r)
+        losses.append(l)
+        seconds += secs
+        done += block
+        do_eval(episode0 + done)
+    return (
+        pol_state,
+        np.concatenate(rewards, axis=0),
+        np.concatenate(losses, axis=0),
+        seconds,
+        monitor,
+    )

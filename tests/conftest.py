@@ -19,3 +19,15 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Everything not explicitly marked ``slow`` is ``fast``: ``-m fast``
+    selects a ~2-minute subset (compile-light unit/property tests), so
+    iteration does not pay the full suite's ~15-minute compile bill."""
+    for item in items:
+        if item.get_closest_marker("slow") is None:
+            item.add_marker(pytest.mark.fast)

@@ -75,6 +75,7 @@ def test_probe_backend_kill_switch(monkeypatch):
     assert probe_backend() is None
 
 
+@pytest.mark.slow
 def test_bench_survives_simulated_backend_outage():
     """End-to-end rc=0 + parseable final line under a dead accelerator backend
     (the exact failure that zeroed out BENCH_r02.json)."""

@@ -23,6 +23,9 @@ from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
 from p2pmicrogrid_tpu.train import make_policy
 
 
+# Whole module is compile-heavy (chunked-trainer episode compiles (multi-second each)).
+pytestmark = pytest.mark.slow
+
 def _cfg(impl="tabular", S=2, A=3, **kw):
     return default_config(
         sim=SimConfig(n_agents=A, n_scenarios=S),

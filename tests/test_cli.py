@@ -10,6 +10,9 @@ import pytest
 from p2pmicrogrid_tpu.cli import main
 
 
+# Whole module is compile-heavy (end-to-end CLI runs: subprocess + full train/eval compiles).
+pytestmark = pytest.mark.slow
+
 def _progress_rows(db_path):
     with sqlite3.connect(db_path) as conn:
         return conn.execute(

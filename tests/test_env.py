@@ -143,6 +143,7 @@ class TestEpisode:
         )
 
 
+@pytest.mark.slow
 class TestNegotiationEquivalence:
     """Vectorized negotiation vs a sequential NumPy replay of the reference's
     agent loop (community.py:75-93, agent.py:178-213, rl.py:89-117 greedy)."""
@@ -243,6 +244,7 @@ class TestNegotiationEquivalence:
             t_in, t_bm = np.asarray(t_in_new), np.asarray(t_bm_new)
 
 
+@pytest.mark.slow
 class TestTraining:
     @pytest.mark.parametrize("impl", ["tabular", "dqn", "ddpg"])
     def test_two_episodes_run(self, day_traces, impl):

@@ -27,6 +27,9 @@ from p2pmicrogrid_tpu.ops.market import (
 )
 
 
+# Whole module is compile-heavy (episode-level factored/matrix equivalence).
+pytestmark = pytest.mark.slow
+
 def matrix_chain(b0, b1):
     """The matrix-path computation the factored clearing must reproduce:
     equal-split round 0 (divide_power against a zero matrix), one

@@ -16,6 +16,9 @@ from p2pmicrogrid_tpu.models.forecast import (
 )
 
 
+# Whole module is compile-heavy (LSTM training epochs).
+pytestmark = pytest.mark.slow
+
 class TestWindows:
     def test_shapes(self):
         data = np.arange(40, dtype=np.float32).reshape(10, 4)

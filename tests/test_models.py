@@ -89,6 +89,7 @@ class TestTabular:
         st = tabular_decay(cfg, st)
         assert float(st.epsilon) == pytest.approx(0.1)  # floor (rl.py:132)
 
+    @pytest.mark.slow
     def test_explore_rate_statistical(self):
         cfg = QLearningConfig()
         st = tabular_init(cfg, n_agents=1000)._replace(epsilon=jnp.asarray(0.5))
@@ -163,6 +164,7 @@ class TestDQN:
         k0 = self.st.online["Dense_0"]["kernel"]
         assert not np.allclose(np.asarray(k0[0]), np.asarray(k0[1]))
 
+    @pytest.mark.slow
     def test_update_moves_online_and_target(self):
         obs = jnp.ones((2, 4)) * 0.1
         st2, loss = dqn_update(

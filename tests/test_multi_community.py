@@ -21,6 +21,9 @@ from p2pmicrogrid_tpu.train import init_policy_state, make_policy
 C, A = 4, 3
 
 
+# Whole module is compile-heavy (multi-community episode compiles).
+pytestmark = pytest.mark.slow
+
 class TestTradedFraction:
     def test_opposite_residuals_fully_match(self):
         # Two communities with exactly opposite residuals trade fully.

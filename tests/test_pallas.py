@@ -30,6 +30,9 @@ from p2pmicrogrid_tpu.train import init_policy_state, make_policy
 S, A = 4, 6
 
 
+# Whole module is compile-heavy (episode-level Pallas/bf16 parity runs).
+pytestmark = pytest.mark.slow
+
 @pytest.fixture(scope="module")
 def p2p():
     rng = np.random.default_rng(0)

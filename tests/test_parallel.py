@@ -21,6 +21,9 @@ from p2pmicrogrid_tpu.parallel import (
 from p2pmicrogrid_tpu.parallel.mesh import replicate, shard_leading_axis, shard_scen_state
 from p2pmicrogrid_tpu.train import init_policy_state, make_policy
 
+# Whole module is compile-heavy (sharded-vs-single episode equivalence compiles).
+pytestmark = pytest.mark.slow
+
 S = 8
 
 
