@@ -706,14 +706,16 @@ def bench_northstar() -> dict:
     # compile inside the measured time.
     from p2pmicrogrid_tpu.parallel.scenarios import make_chunked_episode_runner
 
-    # chunk_parallel=2: two chunks run side by side through the vmapped
-    # episode program. The S=64..512 chunk-size sweep and the C=1/2/4 width
-    # sweep (tools/s_scaling_probe.py, tools/chunk_parallel_probe.py,
-    # artifacts/WIDTH_SWEEP_r04.json) put the throughput optimum at an
-    # effective width of 256 scenarios: C=2 measured 64.5k scenario-steps/s
-    # vs 59.6k at C=1 and 55.9k at C=4 on the v5e chip, with the K-delta
-    # update semantics unchanged (only summation order differs).
-    runner = make_chunked_episode_runner(cfg, episode_fn, K, chunk_parallel=2)
+    # chunk_parallel=1: round 4 shipped C=2 (the 0.6 ms/slot fixed phase
+    # amortized across two vmapped chunks, WIDTH_SWEEP_r04), but round 5's
+    # slot rewrite — slab-slice replay sampling, scatter-free segment means,
+    # merged factored market (artifacts/SLOT_PROFILE_r05.json: 2110 -> 625
+    # us/slot device time) — removed most of what C=2 amortized, and the
+    # vmapped program re-pessimizes the new patterns (the batch dim turns
+    # the replay slab slices back into gathers). Re-measured on the K=8
+    # probe: C=1 206k scenario-steps/s vs C=2 80.8k, C=4 76.7k
+    # (tools/chunk_parallel_probe.py, artifacts/WIDTH_SWEEP_r05.json).
+    runner = make_chunked_episode_runner(cfg, episode_fn, K, chunk_parallel=1)
     ps, _, _, _ = train_scenarios_chunked(
         cfg, policy, ps, ratings, key,
         n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,
@@ -739,7 +741,7 @@ def bench_northstar() -> dict:
         "aggregate_scenarios": S_chunk * K,
         "chunk_scenarios": S_chunk,
         "chunks_per_episode": K,
-        "chunk_parallel": 2,
+        "chunk_parallel": 1,
     }
 
 
@@ -767,7 +769,8 @@ def converged_episode(
 
 
 def _convergence_prices(
-    cfg, episodes: int = 1000, block: int = 10, decay_every: "int | None" = None
+    cfg, episodes: int = 1000, block: int = 10,
+    decay_every: "int | None" = None, seed: int = 0,
 ) -> np.ndarray:
     """Per-episode trade-weighted mean P2P price over a training run.
 
@@ -776,7 +779,9 @@ def _convergence_prices(
     move their heat-pump load across tariff slots. Episodes are fused
     ``block``-per-device-call; the epsilon decay runs inside the block on the
     ``decay_every`` cadence (default: the reference's
-    ``min_episodes_criterion``) exactly as train_community does.
+    ``min_episodes_criterion``) exactly as train_community does. ``seed``
+    drives BOTH the table init and the episode key stream (seed 0 is the
+    bench's pinned configuration; the convergence-floor seed sweeps vary it).
     """
     import jax
     import jax.numpy as jnp
@@ -796,7 +801,7 @@ def _convergence_prices(
     ratings = make_ratings(cfg, np.random.default_rng(42))
     arrays = build_episode_arrays(cfg, traces, ratings)
     policy = make_policy(cfg)
-    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
 
     @jax.jit
     def price_block(ps, episode0, key):
@@ -817,7 +822,12 @@ def _convergence_prices(
 
         return jax.lax.scan(body, ps, (jnp.arange(block), jax.random.split(key, block)))
 
-    key = jax.random.PRNGKey(42)
+    # seed 0 keeps the exact pinned key chain of rounds 1-4.
+    key = (
+        jax.random.PRNGKey(42)
+        if seed == 0
+        else jax.random.fold_in(jax.random.PRNGKey(42), seed)
+    )
     prices = np.empty(episodes)
     for b in range(0, episodes, block):
         key, k = jax.random.split(key)

@@ -358,6 +358,24 @@ def _cmd_train_scenarios(args) -> int:
             f"--chunk-parallel {chunk_parallel} requires --chunks > 1 "
             "(the width vmaps chunks of the chunked runner side by side)"
         )
+    basin_mitigate = getattr(args, "basin_mitigate", "warn")
+    if basin_mitigate != "warn":
+        # Same clean-error principle as --chunk-parallel: reject the
+        # configurations where the mitigation would crash mid-build
+        # (lr-boost scales DDPG lrs only) or silently degrade to 'warn'
+        # (the non-chunked path has no program switch to apply).
+        if cfg.train.implementation != "ddpg":
+            raise SystemExit(
+                f"--basin-mitigate {basin_mitigate} requires "
+                f"--implementation ddpg (got {cfg.train.implementation}); "
+                "the mitigation switches to an lr-boosted DDPG program"
+            )
+        if chunks <= 1:
+            raise SystemExit(
+                f"--basin-mitigate {basin_mitigate} requires --chunks > 1 "
+                "(mitigation swaps the chunked episode program; the "
+                "non-chunked path only supports 'warn')"
+            )
     setting = _scenario_setting(cfg, args.shared, chunks)
     rng = np.random.default_rng(cfg.train.seed)
     ratings = make_ratings(cfg, rng)
@@ -1242,9 +1260,9 @@ def main(argv=None) -> int:
                    help="with --chunks K: run C chunks (C divides K) side by "
                         "side through one vmapped episode program — same "
                         "per-chunk trajectories and K-delta mean, wider "
-                        "device program (amortizes per-slot fixed cost; "
-                        "C=2 measured fastest at 1000 agents x 128-scenario "
-                        "chunks)")
+                        "device program (round 5: C=1 measured fastest — "
+                        "the slot rewrite removed what C=2 amortized; "
+                        "artifacts/WIDTH_SWEEP_r05.json)")
     p.add_argument("--share-agents", action="store_true", dest="share_agents",
                    help="ddpg + --shared: ONE actor-critic for the whole "
                         "community (shared-critic MARL) instead of per-agent "

@@ -168,7 +168,23 @@ def lockstep_replay_sample(
     """
     hi = jnp.maximum(state.count, 1)
     idx = jax.random.randint(key, (batch_size,), 0, hi)
-    take = lambda buf: jnp.take(buf, idx, axis=0)
+    if batch_size <= 16:
+        # B explicit dynamic slices, not jnp.take: the TPU backend lowers a
+        # B-of-capacity gather on a [cap, S, A, d] operand as full-ring
+        # "mini-gather" passes — at the north-star scale that read the
+        # ENTIRE 196 MB obs+next_obs rings every slot (~525 us/slot, 25% of
+        # the slot program; artifacts/SLOT_PROFILE_r05.json). Slices read
+        # only the B addressed slabs.
+        def take(buf):
+            return jnp.concatenate(
+                [
+                    jax.lax.dynamic_index_in_dim(buf, idx[b], 0, keepdims=True)
+                    for b in range(batch_size)
+                ],
+                axis=0,
+            )
+    else:
+        take = lambda buf: jnp.take(buf, idx, axis=0)
     return (
         take(state.obs),
         take(state.action),

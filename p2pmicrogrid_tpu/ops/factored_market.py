@@ -109,17 +109,43 @@ def clear_factored_rounds1(
     a_e = jnp.where(buyer & ~prop, absb1 / A, 0.0)
     g_p = jnp.where(seller & prop, absb1 / safe_opp, 0.0)
     g_e = jnp.where(seller & ~prop, absb1 / A, 0.0)
-    ones = jnp.ones_like(b1)
 
-    # Four buyer-type x seller-type blocks of the matched min; each call's
-    # row vector lives on its buyer class, col vector on its seller class.
-    row_pp, col_pp = rank1_min_sums(a_p, wminus, wplus, g_p)
-    row_pe, col_pe = rank1_min_sums(a_p, ones, wplus, g_e)
-    row_ep, col_ep = rank1_min_sums(a_e, wminus, ones, g_p)
-    row_ee, col_ee = rank1_min_sums(a_e, ones, ones, g_e)
-
-    matched_buy = row_pp + row_pe + row_ep + row_ee
-    matched_sell = col_pp + col_pe + col_ep + col_ee
+    # Four buyer-type x seller-type blocks of the matched min, merged into
+    # ONE fused [.., A, A] pass. The four blocks
+    #     pp: min(a_p_i * wplus_j, wminus_i * g_p_j)
+    #     pe: min(a_p_i * wplus_j, 1       * g_e_j)
+    #     ep: min(a_e_i * 1,       wminus_i * g_p_j)
+    #     ee: min(a_e_i * 1,       1       * g_e_j)
+    # have pairwise-disjoint supports (every i is buyer-prop, buyer-equal or
+    # neither; every j seller-prop, seller-equal or neither), the lhs factor
+    # depends only on i's class and the rhs factor only on j's class — so
+    # per (i, j) exactly one block is nonzero and a class-select reproduces
+    # it: alpha_i = a_p_i + a_e_i, gamma_j = g_p_j + g_e_j (disjoint sums),
+    # lhs = alpha_i * (wplus_j if i is prop else 1), rhs = (wminus_i if j is
+    # prop else 1) * gamma_j. Zero alpha/gamma rows/cols still contribute
+    # exactly 0.0 (min against a nonnegative side). Identical entries to
+    # the 4-block sum; row/col sums differ only in f32 summation order.
+    # Why merged: the 4-block fusion was the largest op in the north-star
+    # slot profile — 666 us/slot, 64% of the slot program after the replay
+    # and segment-sum fixes (artifacts/SLOT_PROFILE_r05.json) — and the
+    # merge cuts the fused VPU op count ~3x for the same outputs.
+    propB = buyer & prop
+    propS = seller & prop
+    alpha = a_p + a_e
+    gamma = g_p + g_e
+    lhs = jnp.where(
+        propB[..., :, None],
+        alpha[..., :, None] * wplus[..., None, :],
+        alpha[..., :, None],
+    )
+    rhs = jnp.where(
+        propS[..., None, :],
+        wminus[..., :, None] * gamma[..., None, :],
+        gamma[..., None, :],
+    )
+    m = jnp.minimum(lhs, rhs)
+    matched_buy = jnp.sum(m, axis=-1)
+    matched_sell = jnp.sum(m, axis=-2)
     p_p2p = jnp.where(
         buyer, matched_buy, jnp.where(seller, -matched_sell, 0.0)
     )

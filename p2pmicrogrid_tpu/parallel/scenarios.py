@@ -485,13 +485,22 @@ def _ddpg_update_shared(
         s_idx = (
             ((starts[:, None] + jnp.arange(length)[None, :]) // A) % S
         ).reshape(-1)
-        hit = jax.ops.segment_sum(jnp.ones_like(sq), s_idx, num_segments=S)
+        # Scatter-free segment mean: jax.ops.segment_sum lowers to a
+        # serialized scatter-add on TPU — two of them measured 2 x 286
+        # us/slot at cap 32768 (artifacts/SLOT_PROFILE_r05.json, the
+        # second-largest slot cost). The one-hot matvec form runs the same
+        # reduction on the MXU: [cap, S] 0/1 matrix x [cap] residuals.
+        one_hot = (s_idx[:, None] == jnp.arange(S)[None, :]).astype(sq.dtype)
+        hit = jnp.sum(one_hot, axis=0)
         # Scenarios no stripe covered this slot get the covered mean, not a
         # fake 0.0 — the [S] loss feeds recorded curves and their aggregate
         # must stay honest (~cap/A scenarios are covered per update).
+        # HIGHEST precision: the default MXU matmul truncates the f32
+        # residuals to bf16 pre-accumulation (~0.4% relative error), which
+        # would skew recorded curves vs the segment_sum they replace.
         loss = jnp.where(
             hit > 0.0,
-            jax.ops.segment_sum(sq, s_idx, num_segments=S)
+            jnp.matmul(sq, one_hot, precision=jax.lax.Precision.HIGHEST)
             / jnp.maximum(hit, 1.0),
             jnp.mean(sq),
         )
@@ -912,12 +921,14 @@ def make_chunked_episode_runner(
     key (the per-chunk key chain is identical to C=1: key i drives chunk i
     either way), so the update semantics — mean over K per-chunk parameter
     deltas — are unchanged up to float summation order. Why it exists: the
-    S=64..512 chunk-size sweep (tools/s_scaling_probe.py) measured ~0.6 ms
-    of per-slot fixed cost (small-op latency + scan iteration) that a wider
-    program amortizes — S=128 sustains 55.9k scenario-steps/s where
-    S=256-wide execution sustains 63k — but retuning the chunk SIZE changes
-    the local-SGD update structure and its lr rule; running C chunks in
-    parallel widens the program with the update structure intact.
+    round-4 sweeps measured ~0.6 ms of per-slot fixed cost that a wider
+    program amortized (C=2 shipped that round). The round-5 slot rewrite
+    (slab-slice replay sampling, scatter-free segment means, merged
+    factored market — artifacts/SLOT_PROFILE_r05.json) halved the fixed
+    phase and the vmapped C>1 program re-pessimizes the new patterns, so
+    C=1 is the measured optimum again (206k vs 80.8k scenario-steps/s on
+    the K=8 probe, artifacts/WIDTH_SWEEP_r05.json); C>1 remains available
+    for shapes where width wins.
     """
     C = chunk_parallel
     if C < 1 or n_chunks % C != 0:

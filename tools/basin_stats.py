@@ -1,0 +1,204 @@
+"""Basin entry/dwell statistics via the validated K=4 chunk proxy
+(round-5 VERDICT #4).
+
+Round 4 established the don't-heat basin narrative on n=4 seeds at the full
+K=80 north star — too few to estimate entry probability. This sweep runs
+>=10 seeds x {capped default, uncapped, half-lr} through the K=4 proxy
+(4 chunks x 128 = 512 aggregate scenarios/episode), which round 4 validated
+to <=0.1% against full K=80 runs (the chunk-delta mean is converged in K;
+README round-4 notes), and classifies every 10th episode's greedy held-out
+eval with the shipped detector (train/health.py). Output: per-run curves +
+entry probability and dwell-time distributions per variant.
+
+Usage: ``PYTHONPATH=/root/repo:$PYTHONPATH python tools/basin_stats.py
+[EPISODES] [OUT]`` — env: BS_SEEDS (comma list, default 0-9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+from p2pmicrogrid_tpu.config import (
+    BatteryConfig,
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    auto_scale_ddpg_lrs,
+    make_chunked_episode_runner,
+    make_shared_episode_fn,
+    train_scenarios_chunked,
+)
+from p2pmicrogrid_tpu.train import make_policy
+from p2pmicrogrid_tpu.train.health import HealthMonitor, make_greedy_eval
+
+A, S_CHUNK, K = 1000, 128, 4          # the validated K=4 proxy
+EPISODES, EVAL_EVERY, S_EVAL = 240, 10, 8
+OUT = "artifacts/BASIN_STATS_r05.json"
+
+
+def variant_cfg(name: str):
+    base = dict(
+        sim=SimConfig(n_agents=A, n_scenarios=S_CHUNK, market_dtype="bfloat16"),
+        battery=BatteryConfig(enabled=True),
+        train=TrainConfig(implementation="ddpg"),
+    )
+    if name == "capped_default":
+        return default_config(
+            ddpg=DDPGConfig(buffer_size=96, batch_size=4,
+                            share_across_agents=True),
+            **base,
+        )
+    if name == "uncapped":
+        return default_config(
+            ddpg=DDPGConfig(buffer_size=96, batch_size=4,
+                            share_across_agents=True, learn_batch_cap=None),
+            **base,
+        )
+    if name == "half_lr":
+        cfg = default_config(
+            ddpg=DDPGConfig(buffer_size=96, batch_size=4,
+                            share_across_agents=True),
+            **base,
+        )
+        scaled = auto_scale_ddpg_lrs(cfg)
+        return dataclasses.replace(
+            cfg,
+            ddpg=dataclasses.replace(
+                cfg.ddpg,
+                actor_lr=scaled.ddpg.actor_lr * 0.5,
+                critic_lr=scaled.ddpg.critic_lr * 0.5,
+                lr_auto_scale=False,
+            ),
+        )
+    raise ValueError(name)
+
+
+def run_one(cfg, policy, ratings, episode_fn, runner, greedy_eval, seed):
+    params = init_shared_pol_state(cfg, jax.random.PRNGKey(seed))
+    mon = HealthMonitor(cfg.sim.slots_per_day,
+                        warn_stream=open(os.devnull, "w"))
+    curve = []
+
+    def ev(ep):
+        c, r = greedy_eval(params, jax.random.PRNGKey(1))
+        status = mon.update(ep, c, r)
+        curve.append({"episode": ep, "greedy_cost_eur": round(float(c), 2),
+                      "greedy_reward": round(float(r), 1), "status": status})
+
+    ev(0)
+    key = (
+        jax.random.PRNGKey(7)
+        if seed == 0
+        else jax.random.fold_in(jax.random.PRNGKey(7), seed)
+    )
+    for start in range(0, EPISODES, EVAL_EVERY):
+        params, _, _, _ = train_scenarios_chunked(
+            cfg, policy, params, ratings, key,
+            n_episodes=EVAL_EVERY, n_chunks=K, episode0=start,
+            episode_fn=episode_fn, runner=runner,
+        )
+        ev(start + EVAL_EVERY)
+    dwell = None
+    if mon.basin_entries:
+        exit_ep = mon.basin_exits[0] if mon.basin_exits else EPISODES
+        dwell = exit_ep - mon.basin_entries[0]
+    return {
+        "seed": seed,
+        "entries": mon.basin_entries,
+        "exits": mon.basin_exits,
+        "entered": bool(mon.basin_entries),
+        "dwell_episodes": dwell,
+        "slides": sum(1 for p in curve if p["status"] == "slide"),
+        "final": curve[-1],
+        "curve": curve,
+    }
+
+
+def main() -> None:
+    global EPISODES, OUT
+    args = sys.argv[1:]
+    if len(args) >= 1:
+        EPISODES = int(args[0])
+    if len(args) >= 2:
+        OUT = args[1]
+    seeds = [int(s) for s in
+             os.environ.get("BS_SEEDS", ",".join(map(str, range(10)))).split(",")]
+    doc = {
+        "round": 5,
+        "what": (
+            f"Basin statistics on the K={K} chunk proxy (validated <=0.1% "
+            f"vs K=80, round 4): {len(seeds)} seeds x 3 lr/cap variants, "
+            f"{EPISODES} episodes each, greedy held-out eval every "
+            f"{EVAL_EVERY} episodes classified by train/health.py. Note: "
+            "round-5 slot rewrite changes f32 summation order vs the "
+            "round-4 curves; trajectories are statistically comparable, "
+            "not bit-identical."
+        ),
+        "config": {"n_agents": A, "chunk_scenarios": S_CHUNK, "chunks": K,
+                   "episodes": EPISODES, "eval_scenarios": S_EVAL,
+                   "seeds": seeds,
+                   "device": jax.devices()[0].device_kind},
+        "variants": {},
+    }
+    ratings = make_ratings(cfg_ref := variant_cfg("capped_default"),
+                           np.random.default_rng(42))
+    policy = make_policy(cfg_ref)
+
+    for name in ("capped_default", "uncapped", "half_lr"):
+        cfg = variant_cfg(name)
+        eff = auto_scale_ddpg_lrs(cfg)
+        episode_fn = make_shared_episode_fn(
+            cfg, policy, None, ratings,
+            arrays_fn=lambda k, c=cfg: device_episode_arrays(
+                c, k, ratings, S_CHUNK
+            ),
+            n_scenarios=S_CHUNK,
+        )
+        runner = make_chunked_episode_runner(cfg, episode_fn, K)
+        greedy_eval = make_greedy_eval(cfg, policy, ratings, s_eval=S_EVAL)
+        runs = []
+        for seed in seeds:
+            t0 = time.time()
+            r = run_one(cfg, policy, ratings, episode_fn, runner,
+                        greedy_eval, seed)
+            r["wall_s"] = round(time.time() - t0, 1)
+            runs.append(r)
+            print(f"{name} seed {seed}: entered={r['entered']} "
+                  f"dwell={r['dwell_episodes']} final={r['final']['status']} "
+                  f"({r['wall_s']}s)", file=sys.stderr, flush=True)
+            dwells = [x["dwell_episodes"] for x in runs if x["entered"]]
+            doc["variants"][name] = {
+                "effective_actor_lr": eff.ddpg.actor_lr,
+                "effective_critic_lr": eff.ddpg.critic_lr,
+                "learn_batch_cap": cfg.ddpg.learn_batch_cap,
+                "n_runs": len(runs),
+                "n_entered": sum(x["entered"] for x in runs),
+                "entry_probability": round(
+                    sum(x["entered"] for x in runs) / len(runs), 3
+                ),
+                "dwell_episodes": dwells,
+                "n_ended_unhealthy": sum(
+                    x["final"]["status"] != "healthy" for x in runs
+                ),
+                "runs": runs,
+            }
+            with open(OUT, "w") as f:
+                json.dump(doc, f, indent=2)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

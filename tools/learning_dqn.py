@@ -1,0 +1,142 @@
+"""At-scale DQN learning evidence (round-5 VERDICT #5).
+
+Tabular has the 50x256 monotone curve (round 2), DDPG has the full
+north-star curves — this closes the set: community-shared DQN trained
+through the CHUNKED path (which exercises the per-chunk record-only replay
+warmup, the reference's init_buffers at community.py:125-147) at 50 agents
+x 2 chunks x 64 = 128 aggregate scenarios, with the greedy held-out
+community cost tracked every 10 episodes. Claim: greedy held-out cost
+falls below the episode-0 (untrained) cost and stays there.
+
+Usage: ``PYTHONPATH=/root/repo:$PYTHONPATH python tools/learning_dqn.py
+[EPISODES] [OUT] [SEED]``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from p2pmicrogrid_tpu.config import (
+    DQNConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    make_chunked_episode_runner,
+    make_shared_episode_fn,
+    train_scenarios_chunked,
+)
+from p2pmicrogrid_tpu.train import make_policy
+from p2pmicrogrid_tpu.train.health import HealthMonitor, make_greedy_eval
+
+A, S_CHUNK, K = 50, 64, 2
+EPISODES, EVAL_EVERY, S_EVAL = 200, 10, 8
+OUT = "artifacts/LEARNING_dqn_r05.json"
+SEED = 0
+
+
+def main() -> None:
+    global EPISODES, OUT, SEED
+    args = sys.argv[1:]
+    if len(args) >= 1:
+        EPISODES = int(args[0])
+    if len(args) >= 2:
+        OUT = args[1]
+    if len(args) >= 3:
+        SEED = int(args[2])
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S_CHUNK),
+        train=TrainConfig(implementation="dqn"),
+        dqn=DQNConfig(),
+    )
+    doc = {
+        "round": 5,
+        "what": (
+            f"Greedy held-out community cost while training community-shared "
+            f"DQN through the chunked path ({A} agents, {K} chunks x "
+            f"{S_CHUNK} = {K * S_CHUNK} scenarios/episode) incl. the "
+            "per-chunk record-only replay warmup."
+        ),
+        "config": {
+            "n_agents": A, "chunk_scenarios": S_CHUNK, "chunks": K,
+            "episodes": EPISODES, "eval_scenarios": S_EVAL, "seed": SEED,
+            "warmup_passes": cfg.dqn.warmup_passes,
+            "device": jax.devices()[0].device_kind,
+        },
+        "curve": [],
+    }
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    policy = make_policy(cfg)
+    params = init_shared_pol_state(cfg, jax.random.PRNGKey(SEED))
+    greedy_eval = make_greedy_eval(cfg, policy, ratings, s_eval=S_EVAL)
+    monitor = HealthMonitor(cfg.sim.slots_per_day)
+    t0 = time.time()
+
+    def record(ep, extra=None):
+        c, r = greedy_eval(params, jax.random.PRNGKey(1))
+        status = monitor.update(ep, c, r)
+        row = {"episode": ep, "greedy_cost_eur": round(float(c), 2),
+               "greedy_reward": round(float(r), 1), "status": status,
+               "wall_s": round(time.time() - t0, 1)}
+        row.update(extra or {})
+        doc["curve"].append(row)
+        print(row, file=sys.stderr, flush=True)
+        with open(OUT, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    # Prebuilt programs (one compile, reused across eval blocks), incl. the
+    # record-only warmup program the default path would build per call.
+    arrays_fn = lambda k: device_episode_arrays(cfg, k, ratings, S_CHUNK)
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings, arrays_fn=arrays_fn, n_scenarios=S_CHUNK
+    )
+    warmup_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings, arrays_fn=arrays_fn,
+        n_scenarios=S_CHUNK, record_only=True,
+    )
+    runner = make_chunked_episode_runner(
+        cfg, episode_fn, K, warmup_fn=warmup_fn
+    )
+
+    record(0)
+    key = (
+        jax.random.PRNGKey(7)
+        if SEED == 0
+        else jax.random.fold_in(jax.random.PRNGKey(7), SEED)
+    )
+    for start in range(0, EPISODES, EVAL_EVERY):
+        params, rewards, _, secs = train_scenarios_chunked(
+            cfg, policy, params, ratings, key,
+            n_episodes=EVAL_EVERY, n_chunks=K, episode0=start,
+            episode_fn=episode_fn, runner=runner,
+        )
+        record(start + EVAL_EVERY, {
+            "train_reward_mean": round(float(np.mean(rewards[-2:])), 1),
+            "train_secs": round(secs, 1),
+        })
+    costs = [p["greedy_cost_eur"] for p in doc["curve"]]
+    doc["summary"] = {
+        "initial_cost": costs[0],
+        "final_cost": costs[-1],
+        "min_cost": min(costs),
+        "improved": costs[-1] < costs[0],
+        "stable_tail": all(
+            c < costs[0] for c in costs[-5:]
+        ),
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"wrote {OUT}: {doc['summary']}")
+
+
+if __name__ == "__main__":
+    main()

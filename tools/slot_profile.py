@@ -1,0 +1,174 @@
+"""Op-level attribution of the north-star slot's fixed phase (VERDICT r4 #2).
+
+The round-4 width sweep quantified ~0.6 ms/slot of width-independent fixed
+cost (artifacts/WIDTH_SWEEP_r04.json) — ~44% of the shipped cfg4 slot — but
+no profile showed WHICH ops compose it. This tool captures a jax.profiler
+device trace of the exact north-star chunk episode program (A=1000, S=128,
+factored market, capped pooled DDPG, bf16) and emits the per-slot op table:
+every XLA op's device-time share, bucketed by source phase via the HLO
+metadata the trace carries (op_name annotations from jax name scopes).
+
+Usage: ``PYTHONPATH=/root/repo:$PYTHONPATH python tools/slot_profile.py
+[S] [EPISODES]`` — writes artifacts/SLOT_PROFILE_r05.json.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import sys
+from collections import defaultdict
+
+import jax
+import numpy as np
+
+OUT = "artifacts/SLOT_PROFILE_r05.json"
+TRACE_DIR = "/tmp/slot_profile_trace"
+
+
+def build_episode(S: int):
+    from p2pmicrogrid_tpu.config import (
+        BatteryConfig,
+        DDPGConfig,
+        SimConfig,
+        TrainConfig,
+        default_config,
+    )
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+    from p2pmicrogrid_tpu.parallel.scenarios import (
+        init_scen_state_only,
+        make_shared_episode_fn,
+    )
+    from p2pmicrogrid_tpu.train import make_policy
+
+    A = 1000
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S, market_dtype="bfloat16"),
+        battery=BatteryConfig(enabled=True),
+        train=TrainConfig(implementation="ddpg"),
+        ddpg=DDPGConfig(buffer_size=96, batch_size=4, share_across_agents=True),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    policy = make_policy(cfg)
+    ps = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+    scen = init_scen_state_only(cfg, jax.random.PRNGKey(1))
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S),
+        n_scenarios=S,
+    )
+    return cfg, episode_fn, (ps, scen)
+
+
+def collect_device_ops(trace_dir: str) -> dict:
+    """Per-op EXCLUSIVE (self) device durations from the newest trace.
+
+    The device's "XLA Ops" track nests container rows (the slot `while`
+    spans every op it contains, vmapped bodies add further levels), so
+    summing raw durations double-counts. Events are replayed through an
+    interval stack per track and each op is credited only with time not
+    covered by its children."""
+    files = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz"))
+    if not files:
+        raise RuntimeError(f"no trace written under {trace_dir}")
+    d = json.load(gzip.open(files[-1]))
+    ev = d.get("traceEvents", [])
+    pid_names, tid_names = {}, {}
+    for e in ev:
+        if e.get("ph") == "M":
+            if e.get("name") == "process_name":
+                pid_names[e["pid"]] = e["args"]["name"]
+            elif e.get("name") == "thread_name":
+                tid_names[(e["pid"], e["tid"])] = e["args"]["name"]
+    op_events = [
+        e for e in ev
+        if e.get("ph") == "X"
+        and "TPU" in pid_names.get(e.get("pid"), "")
+        and tid_names.get((e["pid"], e["tid"])) == "XLA Ops"
+    ]
+    op_events.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+    ops = defaultdict(float)
+    metas = {}
+    stack = []  # (end_ts, name, child_time_accum_index)
+    child_time = []
+    for e in op_events:
+        ts, dur, name = e["ts"], e.get("dur", 0.0), e["name"]
+        while stack and ts >= stack[-1][0] - 1e-9:
+            _, p_name, idx = stack.pop()
+            ops[p_name] += child_time[idx][0] - child_time[idx][1]
+            if stack:
+                child_time[stack[-1][2]][1] += child_time[idx][0]
+        child_time.append([dur, 0.0])
+        stack.append((ts + dur, name, len(child_time) - 1))
+        if e.get("args") and name not in metas:
+            metas[name] = e["args"]
+    while stack:
+        _, p_name, idx = stack.pop()
+        ops[p_name] += child_time[idx][0] - child_time[idx][1]
+        if stack:
+            child_time[stack[-1][2]][1] += child_time[idx][0]
+    return {"durations_us": dict(ops), "meta_sample": metas}
+
+
+def main() -> None:
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    episodes = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    cfg, episode_fn, carry = build_episode(S)
+    slots = cfg.sim.slots_per_day
+
+    # Warm/compile outside the trace.
+    carry, _ = episode_fn(carry, jax.random.PRNGKey(100))
+    jax.block_until_ready(carry)
+
+    with jax.profiler.trace(TRACE_DIR):
+        for i in range(episodes):
+            carry, _ = episode_fn(carry, jax.random.PRNGKey(200 + i))
+        jax.block_until_ready(carry)
+
+    raw = collect_device_ops(TRACE_DIR)
+    n_slots = episodes * slots
+    rows = []
+    total_us = 0.0
+    for name, us in raw["durations_us"].items():
+        if name.startswith("jit_"):  # enclosing XLA-program row, not an op
+            continue
+        total_us += us
+        rows.append({
+            "op": name,
+            "total_us": round(us, 1),
+            "us_per_slot": round(us / n_slots, 3),
+            "args": raw["meta_sample"].get(name, {}),
+        })
+    rows.sort(key=lambda r: -r["total_us"])
+    doc = {
+        "round": 5,
+        "what": (
+            f"Device-op profile of the factored north-star chunk episode "
+            f"(A=1000, S={S}, {episodes} episodes x {slots} slots). "
+            "us_per_slot sums to the slot's device-op time; the gap to the "
+            "measured wall slot time is scan/runtime dispatch."
+        ),
+        "device": jax.devices()[0].device_kind,
+        "episodes": episodes,
+        "slots_per_episode": slots,
+        "total_device_us_per_slot": round(total_us / n_slots, 2),
+        "ops": rows[:60],
+        "tail_op_count": max(0, len(rows) - 60),
+        "tail_us_per_slot": round(
+            sum(r["us_per_slot"] for r in rows[60:]), 2
+        ),
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({k: doc[k] for k in
+                      ("total_device_us_per_slot", "tail_op_count",
+                       "tail_us_per_slot")}, indent=1))
+    for r in rows[:25]:
+        print(f"{r['us_per_slot']:>9.2f} us/slot  {r['op'][:70]}")
+
+
+if __name__ == "__main__":
+    main()
