@@ -857,12 +857,13 @@ def bench_convergence() -> dict:
         "unit": "episodes",
         # Fraction of the reference's 1000-episode budget, as a speed-up.
         "vs_baseline": round(1000.0 / max(converged_ep, 1), 2),
-        # Measured floor (round 4): with the reference schedule intact the
-        # detector is noise-limited — the NO-LEARNING ablation (alpha=0)
-        # "converges" at 988 and every schedule-preserving variant lands
-        # ~940-990 because the 50-episode-window price noise is the size of
-        # the 0.002 band (tools/convergence_floor.py).
-        "schedule_floor_note": "artifacts/CONVERGENCE_FLOOR_r04.json",
+        # Measured floor (rounds 4-5, now 3 seeds per variant): with the
+        # reference schedule intact the detector is noise-limited — the
+        # NO-LEARNING ablation (alpha=0) "converges" at 896-991 and the
+        # defaults land 923-977 across seeds because the 50-episode-window
+        # price noise is the size of the 0.002 band
+        # (tools/convergence_floor.py).
+        "schedule_floor_note": "artifacts/CONVERGENCE_FLOOR_r05.json",
     }
 
 

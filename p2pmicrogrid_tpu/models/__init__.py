@@ -38,6 +38,14 @@ from p2pmicrogrid_tpu.models.ddpg import (
     ddpg_shared_act,
     ddpg_learn_batch,
 )
+from p2pmicrogrid_tpu.models.ddpg_recurrent import (
+    RecurrentActor,
+    RecurrentCritic,
+    RecurrentDDPGState,
+    recurrent_ddpg_act,
+    recurrent_ddpg_init,
+    recurrent_ddpg_learn,
+)
 from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES
 
 # Discrete heat-pump power fractions (rl.py:153, agent.py:268); single source
@@ -70,4 +78,10 @@ __all__ = [
     "ddpg_params_init",
     "ddpg_shared_act",
     "ddpg_learn_batch",
+    "RecurrentActor",
+    "RecurrentCritic",
+    "RecurrentDDPGState",
+    "recurrent_ddpg_init",
+    "recurrent_ddpg_act",
+    "recurrent_ddpg_learn",
 ]

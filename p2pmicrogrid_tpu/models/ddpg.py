@@ -29,6 +29,15 @@ from p2pmicrogrid_tpu.models.replay import (
 OBS_DIM = 4
 
 
+def polyak(tau: float, target, online):
+    """Soft target update ``(1 - tau) * target + tau * online`` over a
+    param tree (rl.py:170-175's update_target; shared with the recurrent
+    variant in ddpg_recurrent.py)."""
+    return jax.tree_util.tree_map(
+        lambda t, o: (1.0 - tau) * t + tau * o, target, online
+    )
+
+
 class DDPGState(NamedTuple):
     """Per-agent actor/critic params, targets, optimizers, replay, OU noise."""
 
@@ -171,10 +180,10 @@ def ddpg_learn_batch(
     pa = pa_new
     oa = oa_new
 
-    polyak = lambda t, o: jax.tree_util.tree_map(
-        lambda x, y: (1.0 - cfg.tau) * x + cfg.tau * y, t, o
+    return (
+        pa, pc, polyak(cfg.tau, pat, pa), polyak(cfg.tau, pct, pc),
+        oa, oc, c_loss, c_sq,
     )
-    return pa, pc, polyak(pat, pa), polyak(pct, pc), oa, oc, c_loss, c_sq
 
 
 def _params_init_per_agent(

@@ -283,3 +283,58 @@ class TestDDPG:
                 np.asarray(new["Dense_0"]["kernel"]) - np.asarray(old["Dense_0"]["kernel"])
             ).max()
             assert delta > 0, name
+
+
+class TestRecurrentDDPG:
+    """The reference's stale LSTM iteration, architecture-faithful
+    (rl_backup.py:14-62): shared-weights double-LSTM trunk, sigmoid actor
+    head, sequence-summed critic head, episodic DDPG step."""
+
+    def _cfg(self):
+        return DDPGConfig(actor_lr=1e-3, critic_lr=1e-3)
+
+    def test_shapes_and_ranges(self):
+        from p2pmicrogrid_tpu.models import (
+            recurrent_ddpg_act,
+            recurrent_ddpg_init,
+        )
+
+        st = recurrent_ddpg_init(self._cfg(), jax.random.PRNGKey(0), seq_len=8)
+        obs = jax.random.uniform(jax.random.PRNGKey(1), (3, 8, 4))
+        a = recurrent_ddpg_act(self._cfg(), st, obs)
+        assert a.shape == (3, 8, 1)
+        assert float(a.min()) >= 0.0 and float(a.max()) <= 1.0
+        # OU-noised action stays clipped.
+        ou = 10.0 * jnp.ones((3, 8, 1))
+        an = recurrent_ddpg_act(self._cfg(), st, obs, ou)
+        assert float(an.max()) <= 1.0
+
+    def test_lstm_weights_shared_across_double_pass(self):
+        """The Keras model lists self.lstm twice — ONE weight set does two
+        passes. The param tree must contain exactly one RNN scope per net."""
+        from p2pmicrogrid_tpu.models import recurrent_ddpg_init
+
+        st = recurrent_ddpg_init(self._cfg(), jax.random.PRNGKey(0), seq_len=8)
+        rnn_scopes = [k for k in st.actor if "RNN" in k or "LSTM" in k]
+        assert len(rnn_scopes) == 1, st.actor.keys()
+
+    @pytest.mark.slow
+    def test_learn_step_reduces_critic_loss(self):
+        from p2pmicrogrid_tpu.models import (
+            recurrent_ddpg_init,
+            recurrent_ddpg_learn,
+        )
+
+        cfg = self._cfg()
+        st = recurrent_ddpg_init(cfg, jax.random.PRNGKey(0), seq_len=8)
+        k = jax.random.PRNGKey(1)
+        obs = jax.random.uniform(k, (16, 8, 4))
+        act = jax.random.uniform(jax.random.fold_in(k, 1), (16, 8, 1))
+        rew = jax.random.uniform(jax.random.fold_in(k, 2), (16,))
+        nobs = jax.random.uniform(jax.random.fold_in(k, 3), (16, 8, 4))
+        learn = jax.jit(lambda s: recurrent_ddpg_learn(cfg, s, obs, act, rew, nobs))
+        _, first = learn(st)
+        for _ in range(30):
+            st, loss = learn(st)
+        assert float(loss) < float(first)
+        assert np.isfinite(float(loss))

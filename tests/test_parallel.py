@@ -12,6 +12,7 @@ import pytest
 from p2pmicrogrid_tpu.config import DQNConfig, SimConfig, TrainConfig, default_config
 from p2pmicrogrid_tpu.envs import make_ratings
 from p2pmicrogrid_tpu.parallel import (
+    init_shared_state,
     make_mesh,
     make_scenario_traces,
     stack_scenario_arrays,
@@ -348,3 +349,34 @@ def test_shared_tabular_reports_real_td_error(setup):
     )
     assert losses.shape == (1, S)
     assert float(np.abs(losses).max()) > 0.0
+
+
+def test_shared_params_stay_replicated_on_mesh():
+    """Intended placement for shared policy state on a mesh: REPLICATED —
+    every device applies the identical all-reduced update to its local copy
+    so no slot moves the shared table/nets over ICI. Left unplaced, XLA
+    parks the updated tabular state on ONE device (round-4 dryruns showed
+    'params over 1 devices'), which on a real pod becomes a per-slot
+    broadcast of the whole Q-table (__graft_entry__.dryrun_multichip
+    asserts the same invariant across all shared modes)."""
+    n_dev = len(jax.devices())
+    assert n_dev == 8, "conftest forces the 8-device virtual CPU mesh"
+    mesh = make_mesh(n_dev)
+    cfg = default_config(
+        sim=SimConfig(n_agents=2, n_scenarios=n_dev),
+        train=TrainConfig(implementation="tabular"),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    arrays = stack_scenario_arrays(
+        cfg, make_scenario_traces(cfg, n_dev), ratings
+    )
+    arrays = jax.tree_util.tree_map(lambda x: x[:, :4], arrays)
+    policy = make_policy(cfg)
+    ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+    ps_out, _, _, _, _ = train_scenarios_shared(
+        cfg, policy, replicate(ps, mesh), shard_leading_axis(arrays, mesh),
+        ratings, jax.random.PRNGKey(1), n_episodes=1,
+        replay_s=shard_scen_state(scen, mesh),
+    )
+    for leaf in jax.tree_util.tree_leaves(ps_out):
+        assert len(leaf.sharding.device_set) == n_dev, leaf.sharding

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-5 serialized TPU measurement queue (one chip — jobs must not overlap).
+cd /root/repo
+export PYTHONPATH=/root/repo:$PYTHONPATH
+echo "[queue] basin_mitigation start $(date)" >> artifacts/r05_queue.log
+python tools/basin_mitigation.py 200 artifacts/BASIN_MITIGATION_r05.json 2 >> artifacts/r05_queue.log 2>&1
+echo "[queue] basin_mitigation rc=$? $(date)" >> artifacts/r05_queue.log
+echo "[queue] basin_stats start $(date)" >> artifacts/r05_queue.log
+python tools/basin_stats.py 240 artifacts/BASIN_STATS_r05.json >> artifacts/r05_queue.log 2>&1
+echo "[queue] basin_stats rc=$? $(date)" >> artifacts/r05_queue.log
+echo "[queue] learning_dqn start $(date)" >> artifacts/r05_queue.log
+python tools/learning_dqn.py 200 artifacts/LEARNING_dqn_r05.json 0 >> artifacts/r05_queue.log 2>&1
+echo "[queue] learning_dqn rc=$? $(date)" >> artifacts/r05_queue.log
+echo "[queue] ALL DONE $(date)" >> artifacts/r05_queue.log

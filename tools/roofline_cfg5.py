@@ -1,0 +1,216 @@
+"""Config-5 (multi-community inter-trading) roofline + op attribution
+(round-5 VERDICT #3).
+
+Round 4 shipped one README sentence for cfg5's 366x ratio ("per-op-overhead
+bound") with no committed artifact. This tool gives the 8x128 inter-trading
+program the same rigor config 4 got in rounds 4-5:
+
+1. device-op profile of the full episode program (top ops, us/slot) via the
+   shared trace parser (tools/slot_profile.py);
+2. in-program compile-time ablations: full vs no-inter-trading (plain
+   shared episode over the community axis) vs env-only (act + physics +
+   market + inter-settlement, no learning);
+3. slot-unroll and episode-block sweeps on the full program.
+
+Writes artifacts/ROOFLINE_cfg5_r05.json.
+
+Usage: ``PYTHONPATH=/root/repo:$PYTHONPATH python tools/roofline_cfg5.py``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo/tools")
+from slot_profile import collect_device_ops  # noqa: E402
+
+OUT = "artifacts/ROOFLINE_cfg5_r05.json"
+TRACE_DIR = "/tmp/cfg5_trace"
+C, A = 8, 128
+
+
+def build(unroll: int = 8):
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.envs.multi_community import (
+        make_multi_community_episode_fn,
+    )
+    from p2pmicrogrid_tpu.parallel import (
+        init_shared_state,
+        make_scenario_traces,
+        stack_scenario_arrays,
+    )
+    from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+    from p2pmicrogrid_tpu.train import make_policy
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=C, slot_unroll=unroll),
+        train=TrainConfig(implementation="tabular"),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    traces = make_scenario_traces(cfg)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+    full = make_multi_community_episode_fn(cfg, policy, arrays, ratings)
+    no_inter = make_shared_episode_fn(cfg, policy, arrays, ratings)
+    return cfg, policy, arrays, ratings, (ps, scen), full, no_inter
+
+
+def env_only_fn(cfg, policy, arrays, ratings):
+    """Act + negotiate + market + inter-community settlement + physics,
+    NO learning — the ablation isolating the learn side."""
+    from p2pmicrogrid_tpu.envs import init_physical
+    from p2pmicrogrid_tpu.envs.community import (
+        AgentRatings,
+        slot_dynamics_batched,
+    )
+    from p2pmicrogrid_tpu.envs.multi_community import (
+        make_inter_community_settlement,
+    )
+
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    hook = make_inter_community_settlement(cfg)
+
+    @jax.jit
+    def episode(carry, key):
+        ps, scen = carry
+        k_phys, k_scan = jax.random.split(key)
+        phys = jax.vmap(lambda k: init_physical(cfg, k))(
+            jax.random.split(k_phys, C)
+        )
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrays)
+        xs = (xs.time, xs.t_out, xs.load_w, xs.pv_w,
+              xs.next_time, xs.next_load_w, xs.next_pv_w)
+
+        def slot(inner, xs_t):
+            phys_s, kk = inner
+            kk, k_act = jax.random.split(kk)
+            phys_s, _, out, _, _ = slot_dynamics_batched(
+                cfg, policy, ps, phys_s, xs_t, k_act, ratings_j,
+                explore=True, settlement_hook=hook,
+            )
+            return (phys_s, kk), jnp.mean(out.reward, axis=-1)
+
+        (_, _), r = jax.lax.scan(
+            slot, (phys, k_scan), xs, unroll=cfg.sim.slot_unroll
+        )
+        return carry, (jnp.sum(r, axis=0), jnp.zeros(C))
+
+    return episode
+
+
+def timed_block(episode_fn, carry, block: int = 10, repeats: int = 3):
+    blocked = jax.jit(
+        lambda c, k: jax.lax.scan(episode_fn, c, jax.random.split(k, block))
+    )
+    c, _ = blocked(carry, jax.random.PRNGKey(0))
+    jax.block_until_ready(jax.tree_util.tree_leaves(c)[0])
+    best = np.inf
+    for i in range(repeats):
+        t0 = time.time()
+        c2, _ = blocked(c, jax.random.PRNGKey(1 + i))
+        float(jax.tree_util.tree_leaves(c2)[0].sum())
+        best = min(best, time.time() - t0)
+    return best, blocked, c
+
+
+def main() -> None:
+    cfg, policy, arrays, ratings, carry, full, no_inter = build(unroll=8)
+    slots = int(arrays.time.shape[1])
+    doc = {
+        "round": 5,
+        "what": (
+            f"Config-5 rigor: device-op profile + ablations + sweeps for "
+            f"the {C}x{A} multi-community inter-trading episode program."
+        ),
+        "device": jax.devices()[0].device_kind,
+        "config": {"communities": C, "agents": A, "slots": slots,
+                   "implementation": "tabular", "slot_unroll": 8},
+    }
+
+    # --- ablations at block 10 (the bench's own measurement shape) -------
+    rows = {}
+    for name, fn in [
+        ("full", full),
+        ("no_inter_trading", no_inter),
+        ("env_only", env_only_fn(cfg, policy, arrays, ratings)),
+    ]:
+        secs, blocked, warm = timed_block(fn, carry, block=10)
+        rate = 10 * slots * C * A / secs
+        rows[name] = {
+            "block10_secs": round(secs, 4),
+            "env_steps_per_sec": round(rate, 1),
+            "slot_ms": round(1e3 * secs / (10 * slots), 4),
+        }
+        print(name, rows[name], flush=True)
+        if name == "full":
+            with jax.profiler.trace(TRACE_DIR):
+                c2, _ = blocked(warm, jax.random.PRNGKey(99))
+                jax.block_until_ready(jax.tree_util.tree_leaves(c2)[0])
+            raw = collect_device_ops(TRACE_DIR)
+            n_slots = 10 * slots
+            ops = []
+            for op, us in raw["durations_us"].items():
+                if op.startswith("jit_"):
+                    continue
+                meta = raw["meta_sample"].get(op, {})
+                src = meta.get("source", "")
+                ops.append({
+                    "op": op,
+                    "us_per_slot": round(us / n_slots, 3),
+                    "source": src,
+                    "category": meta.get("hlo_category", ""),
+                })
+            ops.sort(key=lambda r: -r["us_per_slot"])
+            doc["device_op_profile_top"] = ops[:30]
+            doc["device_total_us_per_slot"] = round(
+                sum(r["us_per_slot"] for r in ops), 2
+            )
+    doc["ablations_block10"] = rows
+    f = rows["full"]["slot_ms"]
+    doc["attribution_ms_per_slot"] = {
+        "inter_trading_side": round(
+            f - rows["no_inter_trading"]["slot_ms"], 4
+        ),
+        "learn_side": round(f - rows["env_only"]["slot_ms"], 4),
+        "env_only": rows["env_only"]["slot_ms"],
+    }
+
+    # --- unroll sweep on the full program --------------------------------
+    sweep = []
+    for unroll in (1, 4, 8, 16):
+        cfg_u, policy_u, arrays_u, ratings_u, carry_u, full_u, _ = build(unroll)
+        secs, _, _ = timed_block(full_u, carry_u, block=10)
+        sweep.append({
+            "slot_unroll": unroll,
+            "env_steps_per_sec": round(10 * slots * C * A / secs, 1),
+        })
+        print(sweep[-1], flush=True)
+    doc["unroll_sweep_block10"] = sweep
+
+    # --- episode-block sweep at unroll 8 ---------------------------------
+    bsweep = []
+    for block in (1, 10, 40):
+        secs, _, _ = timed_block(full, carry, block=block)
+        bsweep.append({
+            "episode_block": block,
+            "env_steps_per_sec": round(block * slots * C * A / secs, 1),
+        })
+        print(bsweep[-1], flush=True)
+    doc["episode_block_sweep"] = bsweep
+
+    with open(OUT, "w") as fjson:
+        json.dump(doc, fjson, indent=2)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
