@@ -596,12 +596,19 @@ def bench_cfg5() -> dict:
 
     C, A = 8, 128
     cfg = default_config(
-        # 8 communities of [128, 128] matrices leave the chip per-op-overhead
-        # bound; unrolling the slot scan recovers ~23% (measured round 2).
-        sim=SimConfig(n_agents=A, n_scenarios=C, slot_unroll=8),
+        # Round-5 re-tune on the rewritten slot (artifacts/
+        # ROOFLINE_cfg5_r05.json): the round-2 unroll=8 choice now LOSES to
+        # low unroll (3.92M agent-steps/s at u=1 vs 3.46M at u=8, block 10)
+        # and deeper episode fusion wins (block 40: 4.62M vs 4.05M) —
+        # unroll=2 x block=40 measured best at 4.70M agent-steps/s. The
+        # measured composition at 0.2 ms/slot: Q-table bin scatter-add 50
+        # us (bandwidth-bound on the touched bin), delta one-hot + Q
+        # gathers ~54 us, ~90 us diffuse env/market small ops — per-op
+        # bound, as the round-4 claim said, now with the numbers.
+        sim=SimConfig(n_agents=A, n_scenarios=C, slot_unroll=2),
         train=TrainConfig(implementation="tabular"),
     )
-    value = scenario_steps_per_sec(cfg, A, C, multi_community=True, episode_block=10)
+    value = scenario_steps_per_sec(cfg, A, C, multi_community=True, episode_block=40)
     b = _baseline_info(A, max_slots=24)
     return {
         "metric": f"multi_community_env_steps_per_sec_{C}x{A}_inter_trading",

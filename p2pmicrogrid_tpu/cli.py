@@ -358,8 +358,20 @@ def _cmd_train_scenarios(args) -> int:
             f"--chunk-parallel {chunk_parallel} requires --chunks > 1 "
             "(the width vmaps chunks of the chunked runner side by side)"
         )
-    basin_mitigate = getattr(args, "basin_mitigate", "warn")
-    if basin_mitigate != "warn":
+    basin_mitigate = getattr(args, "basin_mitigate", "auto")
+    if basin_mitigate == "auto":
+        # Default: mitigate where the program switch exists. The round-5
+        # 10-seed sweep (artifacts/BASIN_STATS_r05.json) measured ~50%
+        # basin entry at the capped chunked-ddpg defaults, and the lr-boost
+        # program cut seed-2's dwell 4.25x with non-entering seeds
+        # untouched (mitigation only engages on basin classification) — so
+        # auto resolves to lr-boost there and to warn-only elsewhere.
+        basin_mitigate = (
+            "lr-boost"
+            if cfg.train.implementation == "ddpg" and chunks > 1
+            else "warn"
+        )
+    elif basin_mitigate != "warn":
         # Same clean-error principle as --chunk-parallel: reject the
         # configurations where the mitigation would crash mid-build
         # (lr-boost scales DDPG lrs only) or silently degrade to 'warn'
@@ -473,7 +485,7 @@ def _cmd_train_scenarios(args) -> int:
                 cfg, policy, pol_state, ratings, key, n_episodes,
                 n_chunks=chunks, eval_every=health_every, episode0=episode0,
                 episode_cb=episode_cb, chunk_parallel=chunk_parallel,
-                mitigate=getattr(args, "basin_mitigate", "warn"),
+                mitigate=basin_mitigate,
                 health_cb=health_cb, monitor=monitor,
             )
         elif chunks > 1:
@@ -1275,13 +1287,14 @@ def main(argv=None) -> int:
                         "collapse while cost falls — cost-only logging is "
                         "blind to it; train/health.py). 0 disables. "
                         "Default 10.")
-    p.add_argument("--basin-mitigate", choices=["warn", "lr-boost"],
-                   default="warn", dest="basin_mitigate",
-                   help="on basin detection (chunked mode): 'warn' alerts "
-                        "only (default); 'lr-boost' trains through an "
+    p.add_argument("--basin-mitigate", choices=["auto", "warn", "lr-boost"],
+                   default="auto", dest="basin_mitigate",
+                   help="on basin detection: 'lr-boost' trains through an "
                         "episode program with the effective lrs boosted "
-                        "until the greedy policy recovers (measured to cut "
-                        "seed-2's ~140-episode dwell; see README)")
+                        "until the greedy policy recovers (measured 4.25x "
+                        "dwell cut at the north star); 'warn' alerts only; "
+                        "'auto' (default) is lr-boost for chunked ddpg "
+                        "and warn elsewhere (see README basin notes)")
     p.add_argument("--actor-lr", type=float, dest="actor_lr",
                    help="DDPG actor learning rate (default 1e-4, scaled "
                         "automatically with the pooled shared-update batch "

@@ -401,13 +401,25 @@ def resolve_use_pallas(cfg: ExperimentConfig) -> bool:
             use_pallas = False
         else:
             use_pallas = jax.default_backend() == "tpu"
-    if cfg.sim.market_dtype == "bfloat16" and not use_pallas:
+    if (
+        cfg.sim.market_dtype == "bfloat16"
+        and not use_pallas
+        # Raw field, not resolve_market_impl (which calls back here):
+        # "auto" never resolves to factored when use_pallas is False, so
+        # only an EXPLICIT factored choice makes bf16 effective off-TPU.
+        and cfg.sim.market_impl != "factored"
+    ):
+        # Since round 5 the factored path honors market_dtype on ANY
+        # backend (the fused min pass computes in bf16 with f32
+        # accumulation), so the inert-setting warning only applies to the
+        # jnp MATRIX path, which stores f32 matrices regardless.
         import warnings
 
         warnings.warn(
             "market_dtype='bfloat16' has no effect: the jnp (non-Pallas) "
-            "market path stores float32 matrices. It only applies when "
-            "use_pallas resolves True (TPU backend, or use_pallas=True).",
+            "MATRIX market path stores float32 matrices. It applies when "
+            "use_pallas resolves True (TPU backend, or use_pallas=True) "
+            "or with market_impl='factored'.",
             stacklevel=2,
         )
     return use_pallas
@@ -549,13 +561,22 @@ def slot_dynamics_batched(
         # no [S, A, A] materialization (O(A^2) compute, O(A) memory). Key
         # chain, observations and decisions are IDENTICAL to the matrix
         # paths (same per-round keys, same closed-form round-0 mean); only
-        # the clearing arithmetic differs, and it is f32-exact where the
-        # bf16 matrix path rounds.
+        # the clearing arithmetic differs. The fused min pass follows the
+        # same resolved market dtype as the matrix paths' storage
+        # (bf16 at large A, f32 accumulation — resolve_market_dtype): the
+        # O(A^2) VPU pass is the slot's largest op after the round-5
+        # rewrite (artifacts/SLOT_PROFILE_r05.json) and bf16 compute is
+        # the shipped tolerance class already.
         from p2pmicrogrid_tpu.ops.factored_market import (
             clear_factored_rounds0,
             clear_factored_rounds1,
         )
 
+        f_dtype = (
+            jnp.bfloat16
+            if resolve_market_dtype(cfg) == "bfloat16"
+            else None
+        )
         n_rounds = cfg.sim.rounds + 1
         keys = jax.random.split(key, n_rounds)
         A = load_w.shape[1]
@@ -564,7 +585,7 @@ def slot_dynamics_batched(
         )
         hp_power_l = [hp_frac * th.hp_max_power]
         if n_rounds == 1:
-            p_grid, p_p2p = clear_factored_rounds0(out0)
+            p_grid, p_p2p = clear_factored_rounds0(out0, compute_dtype=f_dtype)
         else:
             tot = jnp.sum(out0, axis=-1, keepdims=True)
             mean_raw = -(tot - out0) / (A * A)
@@ -572,7 +593,9 @@ def slot_dynamics_batched(
                 mean_raw / ratings.max_in, hp_frac, keys[1], ex
             )
             hp_power_l.append(hp_frac * th.hp_max_power)
-            p_grid, p_p2p = clear_factored_rounds1(out0, out1)
+            p_grid, p_p2p = clear_factored_rounds1(
+                out0, out1, compute_dtype=f_dtype
+            )
         explore_state = ex
         hp_power_r = jnp.stack(hp_power_l)  # [rounds+1, S, A]
     elif cfg.sim.trading and use_pallas:

@@ -20,8 +20,10 @@ guarantees:
 * Rows are sign-uniform (every entry carries ``sign(b1_i)``), so pairwise
   sign-opposition matching reduces to buyer x seller class pairs, and each
   matched block is ``min(a_i * beta_j, delta_i * gamma_j)`` — a rank-1 min
-  whose row/column sums ``rank1_min_sums`` computes as fused broadcast-min
-  reductions, never materializing an A x A block in memory.
+  whose row/column sums are fused broadcast-min reductions, never
+  materializing an A x A block in memory (``rank1_min_sums`` is the
+  reference form; the shipped clearing inlines a merged single-pass
+  variant — see ``clear_factored_rounds1``).
 
 Row sums of the final matrix telescope to ``b1`` exactly (both divide
 branches are normalized), so ``p_grid = b1 - p_p2p``.
@@ -52,6 +54,13 @@ def rank1_min_sums(
     """Row and column sums of ``M[i, j] = min(a_i * beta_j, delta_i * gamma_j)``
     without materializing M.
 
+    REFERENCE IMPLEMENTATION: the production clearing inlines a merged
+    (round 5: single-pass, class-select, optional narrow-dtype) variant of
+    this computation — see ``clear_factored_rounds1`` — and this helper is
+    kept as the spec the tests verify against. Note its sums accumulate in
+    the INPUT dtype; callers wanting f32 accumulation from narrow inputs
+    should follow the inlined pattern instead.
+
     All inputs are nonnegative ``[..., N]`` arrays (leading dims batch).
     Returns ``(row, col)`` with ``row_i = sum_j M[i, j]`` over the last axis
     and ``col_j = sum_i M[i, j]``. Entries with a zero factor on either side
@@ -73,7 +82,7 @@ def rank1_min_sums(
 
 
 def clear_factored_rounds1(
-    b0: jnp.ndarray, b1: jnp.ndarray
+    b0: jnp.ndarray, b1: jnp.ndarray, compute_dtype=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(p_grid, p_p2p) of the rounds=1 negotiation chain, matrix-free.
 
@@ -88,6 +97,16 @@ def clear_factored_rounds1(
         p_grid, p_p2p = clear_market(P1)
 
     which is exactly what the matrix paths compute for ``rounds == 1``.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) optionally carries the fused
+    O(A^2) min pass in a narrower dtype with f32 accumulation — the
+    factored counterpart of the matrix paths' ``market_dtype='bfloat16'``
+    storage (same ~1e-2 relative tolerance class, community.py:417-436).
+    The row/column factor VECTORS (alpha, wplus, wminus, gamma) are cast
+    before the products, so entries take up to two roundings (cast +
+    product) vs the matrix path's one storage rounding; the class masks,
+    the f32 accumulation of the row/col sums, and the final
+    ``p_grid = b1 - p_p2p`` identity are unaffected.
     """
     A = b0.shape[-1]
     wplus = jnp.maximum(b0, 0.0)      # buyer-row column weights
@@ -133,19 +152,28 @@ def clear_factored_rounds1(
     propS = seller & prop
     alpha = a_p + a_e
     gamma = g_p + g_e
+    if compute_dtype is not None:
+        alpha, wplus_c, wminus_c, gamma_c = (
+            alpha.astype(compute_dtype),
+            wplus.astype(compute_dtype),
+            wminus.astype(compute_dtype),
+            gamma.astype(compute_dtype),
+        )
+    else:
+        wplus_c, wminus_c, gamma_c = wplus, wminus, gamma
     lhs = jnp.where(
         propB[..., :, None],
-        alpha[..., :, None] * wplus[..., None, :],
+        alpha[..., :, None] * wplus_c[..., None, :],
         alpha[..., :, None],
     )
     rhs = jnp.where(
         propS[..., None, :],
-        wminus[..., :, None] * gamma[..., None, :],
-        gamma[..., None, :],
+        wminus_c[..., :, None] * gamma_c[..., None, :],
+        gamma_c[..., None, :],
     )
     m = jnp.minimum(lhs, rhs)
-    matched_buy = jnp.sum(m, axis=-1)
-    matched_sell = jnp.sum(m, axis=-2)
+    matched_buy = jnp.sum(m, axis=-1, dtype=jnp.float32)
+    matched_sell = jnp.sum(m, axis=-2, dtype=jnp.float32)
     p_p2p = jnp.where(
         buyer, matched_buy, jnp.where(seller, -matched_sell, 0.0)
     )
@@ -153,17 +181,25 @@ def clear_factored_rounds1(
     return b1 - p_p2p, p_p2p
 
 
-def clear_factored_rounds0(b0: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def clear_factored_rounds0(
+    b0: jnp.ndarray, compute_dtype=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(p_grid, p_p2p) for a single decision round (rounds == 0): the final
     matrix is the equal-split ``b0_i / A`` in every column, i.e. every row is
-    the equal branch — one EE block."""
+    the equal branch — one EE block. ``compute_dtype`` as in
+    ``clear_factored_rounds1``."""
     A = b0.shape[-1]
     buyer = b0 > 0.0
     seller = b0 < 0.0
     absb = jnp.abs(b0)
     a_e = jnp.where(buyer, absb / A, 0.0)
     g_e = jnp.where(seller, absb / A, 0.0)
-    ones = jnp.ones_like(b0)
-    row, col = rank1_min_sums(a_e, ones, ones, g_e)
+    if compute_dtype is not None:
+        a_e, g_e = a_e.astype(compute_dtype), g_e.astype(compute_dtype)
+    # min(a_e_i, g_e_j) block without the rank-1 helper so the reduction
+    # can accumulate in f32 regardless of compute dtype.
+    m = jnp.minimum(a_e[..., :, None], g_e[..., None, :])
+    row = jnp.sum(m, axis=-1, dtype=jnp.float32)
+    col = jnp.sum(m, axis=-2, dtype=jnp.float32)
     p_p2p = jnp.where(buyer, row, jnp.where(seller, -col, 0.0))
     return b0 - p_p2p, p_p2p

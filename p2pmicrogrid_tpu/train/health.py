@@ -203,9 +203,14 @@ class HealthMonitor:
                 f"HEALTH ALERT (episode {episode}): greedy reward "
                 f"{reward:.0f} with community cost {cost:.0f} EUR — the "
                 "policy is profiting by NOT heating (comfort collapse, the "
-                "metastable don't-heat basin). Mitigation: re-run with "
-                "--learn-batch-cap 0 (uncapped low-lr rule, measured "
-                "basin-free) or enable --basin-mitigate lr-boost.",
+                "metastable don't-heat basin). Mitigation: --basin-mitigate "
+                "lr-boost (default for chunked ddpg; requires --chunks > 1 "
+                "— non-chunked runs should rerun chunked to mitigate; "
+                "measured 4.25x dwell cut). Do NOT switch to lower lrs: "
+                "the 10-seed sweep "
+                "(artifacts/BASIN_STATS_r05.json) measured uncapped/half-lr "
+                "runs entering MORE often and staying captured at the "
+                "240-episode horizon — escape is lr-limited too.",
                 file=self.warn_stream, flush=True,
             )
         elif status == "slide" and not was_in_basin:

@@ -238,3 +238,72 @@ class TestConfigValidation:
             sim=SimConfig(n_agents=5, rounds=2, use_pallas=True)
         )
         assert resolve_market_impl(multi_round) == "matrix"
+
+
+    def test_bf16_factored_episode_close_to_f32(self):
+        """Explicit market_dtype='bfloat16' + factored now carries the fused
+        min pass in bf16 (community.py wires resolve_market_dtype through);
+        episode rewards must stay within the same tolerance class as the
+        bf16 matrix storage (test_pallas.py's 2%)."""
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.parallel import (
+            init_shared_state,
+            stack_scenario_arrays,
+        )
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            make_scenario_traces,
+            train_scenarios_shared,
+        )
+        from p2pmicrogrid_tpu.train import make_policy
+
+        def run(dtype):
+            cfg = default_config(
+                sim=SimConfig(
+                    n_agents=7, n_scenarios=3, market_impl="factored",
+                    market_dtype=dtype,
+                ),
+                battery=BatteryConfig(enabled=True),
+                train=TrainConfig(implementation="ddpg"),
+                ddpg=DDPGConfig(
+                    buffer_size=16, batch_size=2, share_across_agents=True
+                ),
+            )
+            ratings = make_ratings(cfg, np.random.default_rng(0))
+            policy = make_policy(cfg)
+            traces = make_scenario_traces(cfg, 3)
+            arrays = stack_scenario_arrays(cfg, traces, ratings)
+            ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+            _, _, rew, _, _ = train_scenarios_shared(
+                cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(1),
+                n_episodes=2, replay_s=scen,
+            )
+            return np.asarray(rew)
+
+        r32, r16 = run("float32"), run("bfloat16")
+        scale = np.abs(r32).max()
+        np.testing.assert_allclose(r16, r32, atol=0.02 * scale)
+
+
+class TestBf16Compute:
+    def test_bf16_min_pass_close_to_f32(self):
+        """compute_dtype=bfloat16 carries the O(A^2) min pass in bf16 with
+        f32 accumulation — the factored counterpart of market_dtype
+        'bfloat16' storage, same tolerance class (community.py:417-436)."""
+        import jax.numpy as jnp
+
+        from p2pmicrogrid_tpu.ops.factored_market import clear_factored_rounds1
+
+        k = jax.random.PRNGKey(3)
+        b0 = jax.random.normal(k, (4, 200)) * 1500.0
+        b1 = jax.random.normal(jax.random.fold_in(k, 1), (4, 200)) * 1500.0
+        g32, p32 = clear_factored_rounds1(b0, b1)
+        g16, p16 = clear_factored_rounds1(b0, b1, compute_dtype=jnp.bfloat16)
+        assert g16.dtype == jnp.float32 and p16.dtype == jnp.float32
+        scale = float(jnp.abs(p32).max())
+        np.testing.assert_allclose(
+            np.asarray(p16), np.asarray(p32), atol=2e-2 * scale
+        )
+        # Conservation is structural: p_grid + p_p2p == b1 in BOTH dtypes.
+        np.testing.assert_allclose(
+            np.asarray(g16 + p16), np.asarray(b1), rtol=1e-5, atol=1e-3
+        )

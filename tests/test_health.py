@@ -235,3 +235,24 @@ class TestCLIWiring:
                 "train", "--agents", "2", "--scenarios", "2", "--shared",
                 "--chunk-parallel", "2", "--episodes", "1",
             ])
+
+    def test_auto_mitigation_resolution(self, tmp_path):
+        """--basin-mitigate auto resolves to lr-boost for chunked ddpg
+        (valid, runs) and warn for dqn/non-chunked (no usage error)."""
+        from p2pmicrogrid_tpu.cli import main
+
+        # dqn + chunks + auto must NOT error (resolves to warn).
+        rc = main([
+            "train", "--agents", "2", "--scenarios", "2", "--shared",
+            "--chunks", "2", "--implementation", "dqn",
+            "--episodes", "1", "--health-every", "1",
+            "--model-dir", str(tmp_path / "m1"),
+        ])
+        assert rc == 0
+        # explicit lr-boost for dqn still errors.
+        with pytest.raises(SystemExit, match="implementation ddpg"):
+            main([
+                "train", "--agents", "2", "--scenarios", "2", "--shared",
+                "--chunks", "2", "--implementation", "dqn",
+                "--basin-mitigate", "lr-boost", "--episodes", "1",
+            ])

@@ -83,10 +83,18 @@ def variant_cfg(name: str):
                 lr_auto_scale=False,
             ),
         )
+    if name == "capped_lrboost":
+        # Same training as capped_default; the MITIGATED arm's boost
+        # program is built separately in main().
+        return variant_cfg("capped_default")
     raise ValueError(name)
 
 
-def run_one(cfg, policy, ratings, episode_fn, runner, greedy_eval, seed):
+def run_one(cfg, policy, ratings, episode_fn, runner, greedy_eval, seed,
+            boosted=None):
+    """One seeded proxy run. ``boosted`` = (runner, episode_fn) built from
+    the lr-boosted config: while the monitor reports basin, training goes
+    through it (the shipped --basin-mitigate lr-boost behavior)."""
     params = init_shared_pol_state(cfg, jax.random.PRNGKey(seed))
     mon = HealthMonitor(cfg.sim.slots_per_day,
                         warn_stream=open(os.devnull, "w"))
@@ -105,10 +113,13 @@ def run_one(cfg, policy, ratings, episode_fn, runner, greedy_eval, seed):
         else jax.random.fold_in(jax.random.PRNGKey(7), seed)
     )
     for start in range(0, EPISODES, EVAL_EVERY):
+        use_runner, use_fn = runner, episode_fn
+        if boosted is not None and mon.in_basin:
+            use_runner, use_fn = boosted
         params, _, _, _ = train_scenarios_chunked(
             cfg, policy, params, ratings, key,
             n_episodes=EVAL_EVERY, n_chunks=K, episode0=start,
-            episode_fn=episode_fn, runner=runner,
+            episode_fn=use_fn, runner=use_runner,
         )
         ev(start + EVAL_EVERY)
     dwell = None
@@ -157,23 +168,35 @@ def main() -> None:
                            np.random.default_rng(42))
     policy = make_policy(cfg_ref)
 
-    for name in ("capped_default", "uncapped", "half_lr"):
+    variants = os.environ.get(
+        "BS_VARIANTS", "capped_default,uncapped,half_lr"
+    ).split(",")
+    for name in variants:
         cfg = variant_cfg(name)
         eff = auto_scale_ddpg_lrs(cfg)
-        episode_fn = make_shared_episode_fn(
-            cfg, policy, None, ratings,
-            arrays_fn=lambda k, c=cfg: device_episode_arrays(
-                c, k, ratings, S_CHUNK
-            ),
-            n_scenarios=S_CHUNK,
-        )
-        runner = make_chunked_episode_runner(cfg, episode_fn, K)
+
+        def build(c):
+            fn = make_shared_episode_fn(
+                c, policy, None, ratings,
+                arrays_fn=lambda k, cc=c: device_episode_arrays(
+                    cc, k, ratings, S_CHUNK
+                ),
+                n_scenarios=S_CHUNK,
+            )
+            return make_chunked_episode_runner(c, fn, K), fn
+
+        runner, episode_fn = build(cfg)
+        boosted = None
+        if name == "capped_lrboost":
+            from p2pmicrogrid_tpu.train.health import _lr_boosted_cfg
+
+            boosted = build(_lr_boosted_cfg(cfg, 3.0))
         greedy_eval = make_greedy_eval(cfg, policy, ratings, s_eval=S_EVAL)
         runs = []
         for seed in seeds:
             t0 = time.time()
             r = run_one(cfg, policy, ratings, episode_fn, runner,
-                        greedy_eval, seed)
+                        greedy_eval, seed, boosted=boosted)
             r["wall_s"] = round(time.time() - t0, 1)
             runs.append(r)
             print(f"{name} seed {seed}: entered={r['entered']} "

@@ -44,6 +44,30 @@ OUT = "artifacts/LEARNING_dqn_r05.json"
 SEED = 0
 
 
+def summarize(curve) -> dict:
+    """Cost AND reward endpoints + basin-transit bookkeeping — cost alone
+    would call a don't-heat basin point (cost < 0, reward ~-1400) the best
+    of the run; the health surface exists to prevent exactly that read."""
+    costs = [p["greedy_cost_eur"] for p in curve]
+    rewards = [p["greedy_reward"] for p in curve]
+    statuses = [p["status"] for p in curve]
+    return {
+        "initial_cost": costs[0],
+        "final_cost": costs[-1],
+        "initial_reward": rewards[0],
+        "final_reward": rewards[-1],
+        "improved_cost": costs[-1] < costs[0],
+        "improved_reward": rewards[-1] > rewards[0],
+        "stable_tail": all(c < costs[0] for c in costs[-5:]),
+        "basin_evals": statuses.count("basin"),
+        "final_status": statuses[-1],
+        "note": (
+            "min(cost) is NOT the best point when its status is basin — "
+            "judge by (cost, reward) jointly"
+        ),
+    }
+
+
 def main() -> None:
     global EPISODES, OUT, SEED
     args = sys.argv[1:]
@@ -123,16 +147,7 @@ def main() -> None:
             "train_reward_mean": round(float(np.mean(rewards[-2:])), 1),
             "train_secs": round(secs, 1),
         })
-    costs = [p["greedy_cost_eur"] for p in doc["curve"]]
-    doc["summary"] = {
-        "initial_cost": costs[0],
-        "final_cost": costs[-1],
-        "min_cost": min(costs),
-        "improved": costs[-1] < costs[0],
-        "stable_tail": all(
-            c < costs[0] for c in costs[-5:]
-        ),
-    }
+    doc["summary"] = summarize(doc["curve"])
     with open(OUT, "w") as f:
         json.dump(doc, f, indent=2)
     print(f"wrote {OUT}: {doc['summary']}")

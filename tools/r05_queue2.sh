@@ -1,0 +1,12 @@
+#!/bin/bash
+# Round-5 queue 2: waits for queue 1, then mitigated basin arm + cfg5 roofline.
+cd /root/repo
+export PYTHONPATH=/root/repo:$PYTHONPATH
+while ! grep -q "ALL DONE" artifacts/r05_queue.log 2>/dev/null; do sleep 30; done
+echo "[queue2] lrboost arm start $(date)" >> artifacts/r05_queue.log
+BS_VARIANTS=capped_lrboost python tools/basin_stats.py 240 artifacts/BASIN_STATS_lrboost_r05.json >> artifacts/r05_queue.log 2>&1
+echo "[queue2] lrboost arm rc=$? $(date)" >> artifacts/r05_queue.log
+echo "[queue2] roofline_cfg5 start $(date)" >> artifacts/r05_queue.log
+python tools/roofline_cfg5.py >> artifacts/r05_queue.log 2>&1
+echo "[queue2] roofline_cfg5 rc=$? $(date)" >> artifacts/r05_queue.log
+echo "[queue2] ALL DONE $(date)" >> artifacts/r05_queue.log
