@@ -19,6 +19,7 @@ from p2pmicrogrid_tpu.analysis.plots import (
     plot_cost_vs_community_size,
     plot_forecast,
     plot_learning_curves,
+    plot_training_health,
     plot_pv_drop_comparison,
     plot_scaling,
     plot_cost_comparison,
@@ -38,6 +39,7 @@ __all__ = [
     "plot_cost_vs_community_size",
     "plot_forecast",
     "plot_learning_curves",
+    "plot_training_health",
     "plot_pv_drop_comparison",
     "plot_scaling",
     "plot_cost_comparison",

@@ -202,6 +202,41 @@ def plot_learning_curves(progress_df, settings: Optional[Sequence[str]] = None):
     return fig
 
 
+def plot_training_health(health_df, settings: Optional[Sequence[str]] = None):
+    """Greedy held-out cost AND reward per eval period, with basin/slide
+    points flagged — the figure form of the training_health table
+    (train/health.py). No reference counterpart: the reference's
+    training_progress curves (data_analysis.py:697-772) show training
+    reward only, which cannot display the don't-heat basin's signature
+    (cost improving while comfort collapses)."""
+    plt = _plt()
+    df = health_df
+    if settings is not None:
+        df = df[df["setting"].isin(list(settings))]
+    fig, axes = plt.subplots(1, 2, figsize=(12, 4))
+    for (setting, impl), g in df.groupby(["setting", "implementation"]):
+        g = g.sort_values("episode")
+        label = f"{setting} ({impl})"
+        axes[0].plot(g["episode"], g["greedy_cost"], label=label)
+        axes[1].plot(g["episode"], g["greedy_reward"], label=label)
+        basin = g[g["status"] == "basin"]
+        slide = g[g["status"] == "slide"]
+        for ax, col in ((axes[0], "greedy_cost"), (axes[1], "greedy_reward")):
+            ax.scatter(slide["episode"], slide[col], marker="^",
+                       color="tab:orange", zorder=3, s=24)
+            ax.scatter(basin["episode"], basin[col], marker="x",
+                       color="tab:red", zorder=3, s=32)
+    axes[0].set_xlabel("Episode")
+    axes[0].set_ylabel("Greedy held-out cost (EUR)")
+    axes[0].set_title("Greedy cost (x = basin, ^ = slide)")
+    axes[1].set_xlabel("Episode")
+    axes[1].set_ylabel("Greedy held-out reward")
+    axes[1].set_title("Greedy reward (the comfort-collapse signal)")
+    axes[0].legend(fontsize=7)
+    fig.tight_layout()
+    return fig
+
+
 def plot_cost_comparison(test_df, settings: Optional[Sequence[str]] = None):
     """Average daily cost per setting, with per-day spread
     (data_analysis.py:324-417)."""

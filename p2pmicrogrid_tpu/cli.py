@@ -1121,6 +1121,7 @@ def cmd_analyse(args) -> int:
         plot_rounds_decisions,
         plot_scaling,
         plot_sweep_curves,
+        plot_training_health,
         statistical_tests,
     )
     from p2pmicrogrid_tpu.data import ResultsStore
@@ -1141,6 +1142,9 @@ def cmd_analyse(args) -> int:
         progress = store.get_training_progress()
         if not progress.empty:
             save(plot_learning_curves(progress), "learning_curves.png")
+        health = store.get_training_health()
+        if not health.empty:
+            save(plot_training_health(health), "training_health.png")
         results = store.get_test_results()
         if results.empty:
             results = store.get_validation_results()

@@ -327,6 +327,9 @@ class ResultsStore:
     def get_training_progress(self):
         return self._read("training_progress")
 
+    def get_training_health(self):
+        return self._read("training_health")
+
     def get_validation_results(self):
         return self._read("validation_results")
 

@@ -233,3 +233,32 @@ class TestPlots:
         assert fig is not None
         # Both settings plotted on each panel.
         assert all(len(ax.lines) == 2 for ax in fig.axes)
+
+
+class TestTrainingHealth:
+    def test_health_roundtrip_and_figure(self, tmp_path):
+        """training_health rows round-trip and render as the two-panel
+        cost/reward figure with basin/slide markers (plot_training_health)."""
+        from p2pmicrogrid_tpu.analysis import plot_training_health
+
+        store = ResultsStore(":memory:")
+        rows = [
+            (0, 3100.0, -1350.0, "healthy"),
+            (10, 1500.0, -30.0, "slide"),
+            (20, -400.0, -1400.0, "basin"),
+            (30, 1200.0, -1.2, "healthy"),
+        ]
+        for ep, c, r, s in rows:
+            store.log_training_health("s1", "ddpg", ep, c, r, s)
+        df = store.get_training_health()
+        assert len(df) == 4
+        assert set(df.columns) >= {
+            "setting", "implementation", "episode",
+            "greedy_cost", "greedy_reward", "status",
+        }
+        assert (df.sort_values("episode")["status"].tolist()
+                == [r[3] for r in rows])
+        fig = plot_training_health(df)
+        out = tmp_path / "training_health.png"
+        fig.savefig(out)
+        assert out.stat().st_size > 0
