@@ -39,6 +39,7 @@ markets, and the diagonal residue of equal rows).
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import jax
@@ -152,6 +153,32 @@ def clear_factored_rounds1(
     propS = seller & prop
     alpha = a_p + a_e
     gamma = g_p + g_e
+    if os.environ.get("P2P_FACTORED_PALLAS", "") not in ("", "0"):
+        # Measured-negative probe switch (see artifacts/SLOT_PROFILE_r05):
+        # the explicit Pallas kernel for this pass — kept behind an env
+        # flag for A/B runs; the XLA fusion won in-program. Read at TRACE
+        # time: flipping the env var after the episode program compiled has
+        # no effect in-process. The kernel computes in f32; under a narrow
+        # compute_dtype the vectors are pre-rounded through it so both
+        # paths see the same storage rounding (the kernel's accumulation
+        # stays f32 either way).
+        from p2pmicrogrid_tpu.ops.pallas_factored import (
+            merged_min_sums_pallas,
+        )
+
+        if compute_dtype is not None:
+            alpha, wplus, wminus, gamma = (
+                x.astype(compute_dtype).astype(jnp.float32)
+                for x in (alpha, wplus, wminus, gamma)
+            )
+        matched_buy, matched_sell = merged_min_sums_pallas(
+            alpha, wplus, wminus, gamma,
+            propB.astype(jnp.float32), propS.astype(jnp.float32),
+        )
+        p_p2p = jnp.where(
+            buyer, matched_buy, jnp.where(seller, -matched_sell, 0.0)
+        )
+        return b1 - p_p2p, p_p2p
     if compute_dtype is not None:
         alpha, wplus_c, wminus_c, gamma_c = (
             alpha.astype(compute_dtype),

@@ -1,0 +1,119 @@
+"""Pallas TPU kernel for the factored market's merged min pass —
+a MEASURED NEGATIVE, kept behind ``P2P_FACTORED_PALLAS=1``.
+
+After the round-5 merge, the fused O(S*A^2) broadcast-min row/col
+reduction is the single largest op in the north-star slot program
+(242-257 us/slot at [128, 1000], ~40% of the slot). In an isolated
+dependent-chain harness this kernel beats the equivalent standalone XLA
+fusion 1409 vs 2022 us/call — but in the REAL slot program it LOSES
+(1.117 vs 0.855 ms/slot, tools/s_scaling_probe.py S=128): XLA fuses the
+min pass with the surrounding class-mask/row-factor computation and its
+in-context code generation runs the pass at ~3.5 VPU Tops/s, which the
+kernel-boundary version cannot match. Kept as the committed record of the
+attempt (with its interpret-mode equivalence test), not as a path anyone
+should enable for speed. The kernel computes, with explicit [I-tile, A]
+blocking in VMEM:
+
+    m[i, j] = min(alpha_i * (propB_i ? wplus_j : 1),
+                  (propS_j ? wminus_i : 1) * gamma_j)
+    row_i = sum_j m[i, j];  col_j = sum_i m[i, j]
+
+Entries are identical to ops/factored_market.clear_factored_rounds1's
+inline computation (same products, same min); row/col sums differ only in
+f32 accumulation order. Reached ONLY via the ``P2P_FACTORED_PALLAS=1``
+probe flag in clear_factored_rounds1 — it is NOT on the
+``SimConfig.use_pallas`` switch (that selects the fused MATRIX path).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _merged_min_kernel(alpha_ref, wplus_ref, wminus_ref, gamma_ref,
+                       pb_ref, ps_ref, row_ref, col_ref, *, i_tile: int):
+    """One scenario per grid step; i-tiled accumulation over the A axis."""
+    a = alpha_ref.shape[-1]
+    alpha = alpha_ref[0]     # [1, A]
+    wplus = wplus_ref[0]
+    wminus = wminus_ref[0]
+    gamma = gamma_ref[0]
+    pb = pb_ref[0]
+    ps = ps_ref[0]
+
+    n_tiles = (a + i_tile - 1) // i_tile
+    col_acc = jnp.zeros((1, a), jnp.float32)
+    for t in range(n_tiles):  # static python loop -> unrolled in Mosaic
+        lo = t * i_tile
+        hi = min(lo + i_tile, a)
+        # Static slices (lo/hi are Python ints): [size, A] block with i down
+        # the sublanes, j across the lanes.
+        al = alpha[0, lo:hi]
+        wm = wminus[0, lo:hi]
+        pbt = pb[0, lo:hi]
+        lhs = jnp.where(
+            pbt[:, None] > 0.0,
+            al[:, None] * wplus[0][None, :],
+            al[:, None],
+        )
+        rhs = jnp.where(
+            ps[0][None, :] > 0.0,
+            wm[:, None] * gamma[0][None, :],
+            gamma[0][None, :],
+        )
+        m = jnp.minimum(lhs, rhs)
+        row_ref[0, 0, lo:hi] = jnp.sum(m, axis=1)
+        col_acc = col_acc + jnp.sum(m, axis=0)[None, :]
+    col_ref[0] = col_acc
+
+
+@partial(jax.jit, static_argnames=("i_tile",))
+def merged_min_sums_pallas(
+    alpha: jnp.ndarray,
+    wplus: jnp.ndarray,
+    wminus: jnp.ndarray,
+    gamma: jnp.ndarray,
+    prop_b: jnp.ndarray,
+    prop_s: jnp.ndarray,
+    i_tile: int = 256,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(row, col) sums of the merged min matrix; inputs [S, A] f32 (masks
+    as 0/1 floats). Returns two [S, A] f32 arrays."""
+    if alpha.ndim != 2:
+        # The probe path only exercises the scenario-batched [S, A] shape;
+        # the inline jnp computation handles arbitrary [..., A] batching.
+        raise ValueError(
+            f"merged_min_sums_pallas needs [S, A] inputs, got {alpha.shape}"
+        )
+    s, a = alpha.shape
+    vec = pl.BlockSpec((1, 1, a), lambda i: (i, 0, 0),
+                       memory_space=pltpu.VMEM)
+    args = [
+        x.astype(jnp.float32).reshape(s, 1, a)
+        for x in (alpha, wplus, wminus, gamma, prop_b, prop_s)
+    ]
+    row, col = pl.pallas_call(
+        partial(_merged_min_kernel, i_tile=i_tile),
+        out_shape=(
+            jax.ShapeDtypeStruct((s, 1, a), jnp.float32),
+            jax.ShapeDtypeStruct((s, 1, a), jnp.float32),
+        ),
+        grid=(s,),
+        in_specs=[vec] * 6,
+        out_specs=(vec, vec),
+        interpret=_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024
+        ),
+    )(*args)
+    return row[:, 0, :], col[:, 0, :]

@@ -179,3 +179,34 @@ def test_resolve_market_dtype_auto():
         sim=SimConfig(n_agents=2, use_pallas=True, market_dtype="bfloat16")
     )
     assert resolve_market_dtype(explicit) == "bfloat16"
+
+
+def test_merged_min_sums_pallas_matches_inline():
+    """The measured-negative factored-market kernel (pallas_factored.py,
+    P2P_FACTORED_PALLAS=1) must still be CORRECT: row/col sums match the
+    shipped inline computation (interpret mode on CPU)."""
+    from p2pmicrogrid_tpu.ops.pallas_factored import merged_min_sums_pallas
+
+    k = jax.random.PRNGKey(0)
+    S, A = 3, 50
+    mk = lambda i: jax.random.uniform(jax.random.fold_in(k, i), (S, A))
+    alpha, wplus, wminus, gamma = mk(0), mk(1), mk(2), mk(3)
+    pb = (mk(4) > 0.5).astype(jnp.float32)
+    ps = (mk(5) > 0.5).astype(jnp.float32)
+    lhs = jnp.where(
+        pb[..., :, None] > 0,
+        alpha[..., :, None] * wplus[..., None, :],
+        alpha[..., :, None],
+    )
+    rhs = jnp.where(
+        ps[..., None, :] > 0,
+        wminus[..., :, None] * gamma[..., None, :],
+        gamma[..., None, :],
+    )
+    m = jnp.minimum(lhs, rhs)
+    row, col = merged_min_sums_pallas(alpha, wplus, wminus, gamma, pb, ps,
+                                      i_tile=16)
+    np.testing.assert_allclose(np.asarray(row), np.asarray(jnp.sum(m, -1)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(col), np.asarray(jnp.sum(m, -2)),
+                               rtol=1e-6)
