@@ -35,6 +35,13 @@ SAME community size — the reference's own execution model minus TF overhead
 
 ``BENCH_CONFIGS`` (env var, comma-separated subset like ``cfg3,cfg4``)
 restricts the run; default runs everything.
+
+Emission goes through the telemetry stdout sink behind an fd-level guard
+(telemetry/registry.py:guarded_stdout_sink): stdout carries strictly one
+JSON object per line (stray prints and raw C++ runtime writes are rerouted
+to stderr), and the measurement helpers record compile/execute spans whose
+durations ride the rows as ``compile_s``/``execute_s`` (cfg1/cfg2/cfg4 and
+the north star).
 """
 
 from __future__ import annotations
@@ -253,13 +260,21 @@ def single_community_steps_per_sec(
         key = jax.random.PRNGKey(0)
         ps = init_policy_state(cfg, key)
 
+        from p2pmicrogrid_tpu.telemetry import current as _tel
+
+        label = device.platform if device is not None else jax.default_backend()
         block = MEASURE_EPISODES_SMALL
         step = make_train_step(cfg, policy, arrays, ratings, block=block)
-        ps, _, rewards, _ = step(ps, 0, key)  # compile + warm
-        jax.block_until_ready(rewards)
+        # Span boundaries at block_until_ready: the first call's span covers
+        # compile + first run, the second covers pure device execution —
+        # the per-phase decomposition the bench rows report.
+        with _tel().span(f"compile:{label}", n_agents=n_agents):
+            ps, _, rewards, _ = step(ps, 0, key)  # compile + warm
+            jax.block_until_ready(rewards)
         start = time.time()
-        ps, _, rewards, _ = step(ps, block, jax.random.PRNGKey(1))
-        jax.block_until_ready(rewards)
+        with _tel().span(f"execute:{label}", n_agents=n_agents):
+            ps, _, rewards, _ = step(ps, block, jax.random.PRNGKey(1))
+            jax.block_until_ready(rewards)
         secs = time.time() - start
         return block * arrays.n_slots / secs
 
@@ -344,30 +359,36 @@ def scenario_steps_per_sec(
         episode_fn = make_shared_episode_fn(cfg, policy, arrays, ratings)
     slots = int(arrays.time.shape[1])
 
+    from p2pmicrogrid_tpu.telemetry import current as _tel
+
     if episode_block > 1:
         blocked = jax.jit(
             lambda carry, k: jax.lax.scan(
                 episode_fn, carry, jax.random.split(k, episode_block)
             )
         )
-        carry, _ = blocked((ps, scen), key)  # compile + warm
-        jax.block_until_ready(carry[0])
+        with _tel().span("compile:batched", n_agents=n_agents, S=n_scenarios):
+            carry, _ = blocked((ps, scen), key)  # compile + warm
+            jax.block_until_ready(carry[0])
         start = time.time()
-        carry, _ = blocked(carry, jax.random.PRNGKey(1))
-        jax.block_until_ready(carry[0])
+        with _tel().span("execute:batched", n_agents=n_agents, S=n_scenarios):
+            carry, _ = blocked(carry, jax.random.PRNGKey(1))
+            jax.block_until_ready(carry[0])
         secs = time.time() - start
         return episode_block * slots * n_scenarios / secs
 
     # One episode fn -> one compiled program reused by warmup and measurement.
-    ps, scen, _, _, _ = train_scenarios_shared(
-        cfg, policy, ps, arrays, ratings, key, n_episodes=1,
-        replay_s=scen, episode_fn=episode_fn,
-    )
-    _, _, _, _, secs = train_scenarios_shared(
-        cfg, policy, ps, arrays, ratings, key,
-        n_episodes=MEASURE_EPISODES, replay_s=scen,
-        episode_fn=episode_fn, episode0=1,
-    )
+    with _tel().span("compile:batched", n_agents=n_agents, S=n_scenarios):
+        ps, scen, _, _, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, key, n_episodes=1,
+            replay_s=scen, episode_fn=episode_fn,
+        )
+    with _tel().span("execute:batched", n_agents=n_agents, S=n_scenarios):
+        _, _, _, _, secs = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, key,
+            n_episodes=MEASURE_EPISODES, replay_s=scen,
+            episode_fn=episode_fn, episode0=1,
+        )
     return MEASURE_EPISODES * slots * n_scenarios / secs
 
 
@@ -444,6 +465,22 @@ def ensure_backend() -> str:
 # --- the 6 benchmark entries ------------------------------------------------
 
 
+def _phase_timings(label: str) -> dict:
+    """Most recent compile/execute span durations for ``label`` (recorded by
+    the measurement helpers), as bench-row fields."""
+    from p2pmicrogrid_tpu.telemetry import current
+
+    rec = current().spans
+    out = {}
+    c = rec.duration(f"compile:{label}")
+    e = rec.duration(f"execute:{label}")
+    if c is not None:
+        out["compile_s"] = round(c, 3)
+    if e is not None:
+        out["execute_s"] = round(e, 3)
+    return out
+
+
 def _device_unit(device: str) -> str:
     # A host-CPU-placed measurement must not masquerade as chip throughput.
     return "env-steps/sec/chip" if device != "cpu" else "env-steps/sec/host"
@@ -464,6 +501,7 @@ def bench_cfg1() -> dict:
         "unit": _device_unit(device),
         "vs_baseline": round(value / _baseline(2), 2),
         "device": device,
+        **_phase_timings(device),
     }
 
 
@@ -475,6 +513,7 @@ def bench_cfg2() -> dict:
         "unit": _device_unit(device),
         "vs_baseline": round(value / _baseline(10), 2),
         "device": device,
+        **_phase_timings(device),
     }
 
 
@@ -584,6 +623,7 @@ def bench_cfg4() -> dict:
         "hbm_peak_fraction_v5e": round(achieved / 820.0, 3),
         "market_impl": resolve_market_impl(cfg),
         "learn_batch_cap": cfg.ddpg.learn_batch_cap,
+        **_phase_timings("batched"),
     }
 
 
@@ -723,15 +763,21 @@ def bench_northstar() -> dict:
     # probe: C=1 206k scenario-steps/s vs C=2 80.8k, C=4 76.7k
     # (tools/chunk_parallel_probe.py, artifacts/WIDTH_SWEEP_r05.json).
     runner = make_chunked_episode_runner(cfg, episode_fn, K, chunk_parallel=1)
-    ps, _, _, _ = train_scenarios_chunked(
-        cfg, policy, ps, ratings, key,
-        n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,
-    )
-    ps, _, _, secs = train_scenarios_chunked(
-        cfg, policy, ps, ratings, jax.random.PRNGKey(1),
-        n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,
-        episode0=1,
-    )
+    from p2pmicrogrid_tpu.telemetry import current as _tel
+
+    # train_scenarios_chunked already blocks on the final state, so the span
+    # boundaries separate compile+first-run from pure execution.
+    with _tel().span("compile:northstar", n_agents=A, chunks=K):
+        ps, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, key,
+            n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,
+        )
+    with _tel().span("execute:northstar", n_agents=A, chunks=K):
+        ps, _, _, secs = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,
+            episode0=1,
+        )
     slots = cfg.sim.slots_per_day
     value = slots * S_chunk * K / secs
     b = _baseline_info(A, max_slots=2)
@@ -749,6 +795,7 @@ def bench_northstar() -> dict:
         "chunk_scenarios": S_chunk,
         "chunks_per_episode": K,
         "chunk_parallel": 1,
+        **_phase_timings("northstar"),
     }
 
 
@@ -1080,60 +1127,78 @@ def main() -> None:
     backend = ensure_backend()
     print(f"bench: backend resolved to {backend}", file=sys.stderr, flush=True)
 
-    headline = None  # last successful row in BENCHES order (the north star)
-    last_row = None  # last row actually printed, success or error
-    for name in BENCHES:
-        if name not in selected:
-            continue
-        try:
-            row = _run_one(name)
-            headline = row
-        except Exception as err:  # noqa: BLE001
-            row = {
-                "metric": f"{name}_failed",
-                "value": 0.0,
-                "unit": "error",
-                "vs_baseline": 0.0,
-                "error": f"{type(err).__name__}: {err}"[:300],
-            }
-        print(json.dumps(row), flush=True)
-        last_row = row
-        # Drop the finished bench's compiled executables and cached buffers:
-        # letting them accumulate leaves the last (largest) benches to run
-        # under device-memory pressure — a single-session suite run measured
-        # the 1000-agent north star 3.7x slower than the same program in a
-        # fresh process until this was added.
-        try:
-            import jax
+    # All metric emission goes through the telemetry stdout sink behind the
+    # fd-level guard: while the benches run, fd 1 points at stderr, so stray
+    # noise — Python prints from training code AND raw C++ writes from the
+    # tunneled runtime (the "d!" fragments interleaved into BENCH_r05.json's
+    # capture) — cannot corrupt the metric stream. stdout carries strictly
+    # one JSON object per line, and the LAST line stays the headline row.
+    from p2pmicrogrid_tpu.telemetry import (
+        Telemetry,
+        guarded_stdout_sink,
+        set_current,
+    )
 
-            jax.clear_caches()
-        except Exception as err:  # noqa: BLE001
-            # A failed clear re-introduces the documented memory-pressure
-            # regression — make a degraded capture detectable.
-            print(
-                f"bench: jax.clear_caches() failed ({type(err).__name__}: "
-                f"{err}); later benches may run under cache pressure",
-                file=sys.stderr,
-                flush=True,
-            )
-    # The driver parses the LAST stdout line: when the final bench failed but
-    # earlier ones succeeded, close with the best successful row. Only reprint
-    # when the last emitted line is NOT already the headline — each metric
-    # should appear exactly once in a clean run.
-    if headline is None:
-        print(
-            json.dumps(
-                {
-                    "metric": "bench_suite_failed",
-                    "value": 0.0,
-                    "unit": "error",
-                    "vs_baseline": 0.0,
-                }
-            ),
-            flush=True,
-        )
-    elif last_row is not headline:
-        print(json.dumps(headline), flush=True)
+    with guarded_stdout_sink() as sink:
+        tel = Telemetry(run_id="bench", sinks=[sink])
+        set_current(tel)
+        try:
+            headline = None  # last successful row (the north star)
+            last_row = None  # last row actually emitted, success or error
+            for name in BENCHES:
+                if name not in selected:
+                    continue
+                try:
+                    row = _run_one(name)
+                    headline = row
+                except Exception as err:  # noqa: BLE001
+                    row = {
+                        "metric": f"{name}_failed",
+                        "value": 0.0,
+                        "unit": "error",
+                        "vs_baseline": 0.0,
+                        "error": f"{type(err).__name__}: {err}"[:300],
+                    }
+                tel.emit(row)
+                last_row = row
+                # Drop the finished bench's compiled executables and cached
+                # buffers: letting them accumulate leaves the last (largest)
+                # benches to run under device-memory pressure — a
+                # single-session suite run measured the 1000-agent north star
+                # 3.7x slower than the same program in a fresh process until
+                # this was added.
+                try:
+                    import jax
+
+                    jax.clear_caches()
+                except Exception as err:  # noqa: BLE001
+                    # A failed clear re-introduces the documented
+                    # memory-pressure regression — make a degraded capture
+                    # detectable.
+                    print(
+                        f"bench: jax.clear_caches() failed "
+                        f"({type(err).__name__}: {err}); later benches may "
+                        "run under cache pressure",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+            # The driver parses the LAST stdout line: when the final bench
+            # failed but earlier ones succeeded, close with the best
+            # successful row. Only re-emit when the last line is NOT already
+            # the headline — each metric appears exactly once in a clean run.
+            if headline is None:
+                tel.emit(
+                    {
+                        "metric": "bench_suite_failed",
+                        "value": 0.0,
+                        "unit": "error",
+                        "vs_baseline": 0.0,
+                    }
+                )
+            elif last_row is not headline:
+                tel.emit(headline)
+        finally:
+            set_current(None)
 
 
 if __name__ == "__main__":

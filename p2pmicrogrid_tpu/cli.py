@@ -17,6 +17,10 @@ Subcommands:
   sweep     DDPG hyperparameter sweep
   bench     run the benchmark and print its JSON line
   analyse   render figures + run the statistics battery from a results DB
+  telemetry-report
+            render a telemetry run directory (artifacts/runs/<run_id>/ —
+            manifest, metric events, device counters, spans) into a
+            human-readable summary
 """
 
 from __future__ import annotations
@@ -268,11 +272,22 @@ def cmd_train(args) -> int:
         device_ctx = _cpu_placement_ctx()
 
     print(f"setting: {cfg.setting} ({cfg.train.implementation})")
-    with _profile_ctx(args), device_ctx:
-        result = train_community(
-            cfg, policy, pol_state, train_traces, ratings, key,
-            progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
-        )
+    from p2pmicrogrid_tpu.telemetry import Telemetry
+
+    tel = Telemetry.maybe_create("train", cfg=cfg)
+    if tel is not None:
+        print(f"telemetry run: {tel.run_dir}")
+    try:
+        with _profile_ctx(args), device_ctx:
+            result = train_community(
+                cfg, policy, pol_state, train_traces, ratings, key,
+                progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
+                telemetry=tel,
+            )
+    finally:
+        # Close even on a crashed run: the partial record is the evidence.
+        if tel is not None:
+            tel.close()
     save_checkpoint(ckpt_dir, result.pol_state, cfg.train.max_episodes - 1)
     if args.timing_json:
         _save_times(args.timing_json, cfg.setting, train_time=result.train_seconds)
@@ -1110,6 +1125,32 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_telemetry_report(args) -> int:
+    """Render a telemetry run directory (see telemetry/registry.py for the
+    layout) into a plain-text summary: manifest provenance, event counts,
+    health trajectory, device-counter totals and span timings."""
+    import os
+
+    from p2pmicrogrid_tpu.telemetry.report import latest_run_dir, render_run
+
+    run_dir = args.run
+    if run_dir is None:
+        root = (
+            args.runs_root
+            or os.environ.get("P2P_TELEMETRY_DIR")
+            or os.path.join("artifacts", "runs")
+        )
+        run_dir = latest_run_dir(root)
+        if run_dir is None:
+            print(f"no telemetry runs found under {root}", file=sys.stderr)
+            return 1
+    if not os.path.isdir(run_dir):
+        print(f"not a telemetry run directory: {run_dir}", file=sys.stderr)
+        return 1
+    print(render_run(run_dir), end="")
+    return 0
+
+
 def cmd_analyse(args) -> int:
     from p2pmicrogrid_tpu.analysis import (
         plot_cost_comparison,
@@ -1425,6 +1466,19 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("bench", help="run the benchmark")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "telemetry-report",
+        help="render a telemetry run directory into a summary "
+             "(default: the latest under artifacts/runs)",
+    )
+    p.add_argument("run", nargs="?",
+                   help="run directory (artifacts/runs/<run_id>); omit for "
+                        "the most recent run")
+    p.add_argument("--runs-root", dest="runs_root",
+                   help="root containing run directories (default "
+                        "artifacts/runs, or $P2P_TELEMETRY_DIR)")
+    p.set_defaults(fn=cmd_telemetry_report)
 
     p = sub.add_parser("analyse", help="statistics + figures from a results DB")
     p.add_argument("--results-db", required=True)

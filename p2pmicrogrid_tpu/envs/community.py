@@ -770,12 +770,16 @@ def run_episode(
     ratings: AgentRatings,
     key: jax.Array,
     training: bool = True,
+    collect_device_metrics: bool = False,
 ) -> Tuple[PhysState, object, SlotOutputs]:
     """One full episode as a single ``lax.scan`` (community.py:149-182 for
     training, :95-123 for greedy evaluation).
 
     Returns (final physical state, final policy state, per-slot outputs with a
-    leading time axis).
+    leading time axis). With ``collect_device_metrics`` a
+    ``telemetry.DeviceCounters`` total rides the scan carry — per-slot NaN/
+    comfort/market counters accumulated in-program and reduced once per
+    device call — and a 4th element is returned (the episode-total counters).
     """
     xs = (
         arrays.time,
@@ -788,12 +792,28 @@ def run_episode(
     )
     ratings = AgentRatings(*(jnp.asarray(a) for a in ratings))
 
-    def step(carry, x):
-        return community_slot(cfg, policy, carry, x, training, ratings)
+    if collect_device_metrics:
+        from p2pmicrogrid_tpu.telemetry.device_metrics import (
+            dc_add,
+            dc_from_slot,
+            dc_zero,
+        )
 
-    (phys, pol_state, key), outputs = jax.lax.scan(
-        step, (phys, pol_state, key), xs, unroll=cfg.sim.slot_unroll
+    # One scan for both modes: the counter slot carries None (an empty
+    # pytree) when disabled, so the program is unchanged.
+    def step(carry, x):
+        inner, dc = carry
+        inner, outputs = community_slot(cfg, policy, inner, x, training, ratings)
+        if collect_device_metrics:
+            dc = dc_add(dc, dc_from_slot(cfg, outputs))
+        return (inner, dc), outputs
+
+    dc0 = dc_zero() if collect_device_metrics else None
+    ((phys, pol_state, key), dc), outputs = jax.lax.scan(
+        step, ((phys, pol_state, key), dc0), xs, unroll=cfg.sim.slot_unroll
     )
+    if collect_device_metrics:
+        return phys, pol_state, outputs, dc
     return phys, pol_state, outputs
 
 

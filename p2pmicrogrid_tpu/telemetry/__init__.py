@@ -1,0 +1,43 @@
+"""Unified telemetry: run-scoped metric sinks, trace spans, device counters.
+
+See ``registry.py`` for the design; README "Observability" for usage.
+"""
+
+from p2pmicrogrid_tpu.telemetry.device_metrics import (
+    DeviceCounters,
+    dc_add,
+    dc_from_slot,
+    dc_to_dict,
+    dc_zero,
+)
+from p2pmicrogrid_tpu.telemetry.registry import (
+    JsonlSink,
+    MemorySink,
+    StdoutSink,
+    Telemetry,
+    config_hash,
+    current,
+    guarded_stdout_sink,
+    run_manifest,
+    set_current,
+)
+from p2pmicrogrid_tpu.telemetry.spans import Span, SpanRecorder
+
+__all__ = [
+    "DeviceCounters",
+    "dc_add",
+    "dc_from_slot",
+    "dc_to_dict",
+    "dc_zero",
+    "JsonlSink",
+    "MemorySink",
+    "StdoutSink",
+    "Telemetry",
+    "config_hash",
+    "current",
+    "guarded_stdout_sink",
+    "run_manifest",
+    "set_current",
+    "Span",
+    "SpanRecorder",
+]

@@ -1,0 +1,92 @@
+"""In-program device counters: observability INSIDE the jitted episode scan.
+
+Nothing host-side can see into a ``lax.scan`` episode: a NaN blowing up the
+critic, agents sitting below the comfort band for a whole episode, or a
+market round leaving most energy unmatched are all invisible until they
+surface (or don't) in the episode-level reward. ``DeviceCounters`` is a tiny
+pytree of scalar counters computed from each slot's outputs and accumulated
+through the scan carry, then reduced to host Python numbers ONCE per device
+call — the fast path stays jitted and the transfer is a handful of scalars.
+
+Counters (all per-episode totals; batched shapes sum over every axis):
+
+* ``nonfinite_q``        NaN/Inf entries in the actor's value estimates.
+* ``nonfinite_loss``     NaN/Inf entries in the per-slot learn loss.
+* ``comfort_violations`` agent-slots with the pre-step indoor temperature
+                         outside the comfort band (the don't-heat basin's
+                         physical signature; train/health.py).
+* ``market_residual_wh`` |energy| settled with the grid after P2P clearing
+                         (the unmatched residual of the negotiation).
+* ``trade_wh``           P2P-matched energy actually traded.
+
+Wired through ``envs.community.run_episode(collect_device_metrics=True)``
+and ``train.health.make_greedy_eval(collect_device_metrics=True)``; totals
+land in telemetry as ``device.*`` counters and in run summaries.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeviceCounters(NamedTuple):
+    """Scalar counter pytree threaded through episode scans."""
+
+    nonfinite_q: jnp.ndarray         # i32
+    nonfinite_loss: jnp.ndarray      # i32
+    comfort_violations: jnp.ndarray  # i32 agent-slots outside the band
+    market_residual_wh: jnp.ndarray  # f32 grid-settled |energy|, Wh
+    trade_wh: jnp.ndarray            # f32 P2P-matched energy, Wh
+
+
+def dc_zero() -> DeviceCounters:
+    zi = jnp.zeros((), jnp.int32)
+    zf = jnp.zeros((), jnp.float32)
+    return DeviceCounters(zi, zi, zi, zf, zf)
+
+
+def dc_add(a: DeviceCounters, b: DeviceCounters) -> DeviceCounters:
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def dc_from_slot(cfg, outputs, loss=None) -> DeviceCounters:
+    """One slot's counter contribution from its ``SlotOutputs``.
+
+    Shape-agnostic: works for the single-community ([A]) and the
+    scenario-batched ([S, A]) slot alike — every reduction sums all axes.
+    ``loss`` overrides ``outputs.loss`` when the learn step runs after the
+    dynamics (community_slot fills it in post hoc).
+    """
+    th = cfg.thermal
+    l = outputs.loss if loss is None else loss
+    t = outputs.t_in
+    hours = cfg.sim.slot_hours
+    return DeviceCounters(
+        nonfinite_q=jnp.sum(~jnp.isfinite(outputs.q)).astype(jnp.int32),
+        nonfinite_loss=jnp.sum(~jnp.isfinite(l)).astype(jnp.int32),
+        comfort_violations=jnp.sum(
+            (t < th.lower_bound) | (t > th.upper_bound)
+        ).astype(jnp.int32),
+        market_residual_wh=(
+            jnp.sum(jnp.abs(outputs.p_grid)) * hours
+        ).astype(jnp.float32),
+        trade_wh=(
+            jnp.sum(jnp.maximum(outputs.p_p2p, 0.0)) * hours
+        ).astype(jnp.float32),
+    )
+
+
+def dc_to_dict(dc: DeviceCounters) -> dict:
+    """Reduce a (possibly still device-resident) counter pytree to host
+    Python numbers — the once-per-device-call transfer."""
+    out = {}
+    for name, v in dc._asdict().items():
+        a = np.asarray(v)
+        # A counter pytree that rode a vmap/scan axis sums over it here.
+        total = a.sum()
+        out[name] = int(total) if np.issubdtype(a.dtype, np.integer) else float(total)
+    return out

@@ -1,0 +1,381 @@
+"""Run-scoped telemetry registry: counters/gauges/histograms + pluggable sinks.
+
+The reference's only live signal is a running training reward written to
+SQLite every decay window (reference community.py:279-288); every other
+surface in this repo grew its own print/JSON-dump format. This module is the
+single funnel all of them route through:
+
+* ``Telemetry``    one object per run: aggregates (counters, gauges,
+                   histograms), nested timing spans (``spans.SpanRecorder``),
+                   a run manifest, and a list of sinks every event reaches.
+* sinks            ``JsonlSink`` (one JSON object per line, append),
+                   ``StdoutSink`` (single-line JSON on stdout — the bench
+                   contract), ``MemorySink`` (tests), and
+                   ``guarded_stdout_sink`` (fd-level stdout hygiene: stray
+                   writes from C++ runtimes/libraries are rerouted to stderr
+                   so ONLY metric lines reach stdout — the fix for the
+                   ``BENCH_r05.json`` interleaved-noise fragments).
+
+Run directories live under ``artifacts/runs/<run_id>/`` and contain:
+
+* ``manifest.json``   backend, device kind/count, config hash, git rev,
+                      argv, versions (written at creation).
+* ``metrics.jsonl``   every event, one JSON object per line, each with
+                      ``ts`` (epoch seconds) and ``kind``.
+* ``summary.json``    counter totals, last gauges, histogram stats and span
+                      totals (written by ``close()``).
+* ``trace.json``      Chrome-trace export of the spans (``chrome://tracing``
+                      / Perfetto loadable; written by ``close()``).
+
+Environment knobs: ``P2P_TELEMETRY=0`` disables ``maybe_create`` (tests),
+``P2P_TELEMETRY_DIR`` overrides the default ``artifacts/runs`` root.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+from p2pmicrogrid_tpu.telemetry.spans import SpanRecorder
+
+DEFAULT_ROOT = os.path.join("artifacts", "runs")
+
+# Events may carry numpy/jax scalars; the encoder must not crash the run.
+def _json_default(o):
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return repr(o)
+
+
+def _dumps(record: dict) -> str:
+    return json.dumps(record, default=_json_default)
+
+
+class JsonlSink:
+    """Append one JSON object per line to ``path`` (created on first emit)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def emit(self, record: dict) -> None:
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "a", buffering=1)
+        self._f.write(_dumps(record) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class StdoutSink:
+    """Single-line JSON records on stdout (the bench driver contract).
+
+    ``write`` overrides the destination with any ``str -> None`` callable —
+    ``guarded_stdout_sink`` binds it to a duplicated stdout fd so metric
+    lines bypass Python-level stream redirection entirely.
+    """
+
+    def __init__(self, write: Optional[Callable[[str], None]] = None):
+        self._write = write
+
+    def emit(self, record: dict) -> None:
+        line = _dumps(record)
+        if self._write is not None:
+            self._write(line + "\n")
+        else:
+            print(line, flush=True)
+
+    def close(self) -> None:
+        pass
+
+
+class MemorySink:
+    """Collects records in a list (tests)."""
+
+    def __init__(self):
+        self.records: list = []
+
+    def emit(self, record: dict) -> None:
+        self.records.append(record)
+
+    def close(self) -> None:
+        pass
+
+
+@contextlib.contextmanager
+def guarded_stdout_sink():
+    """fd-level stdout hygiene for metric emission.
+
+    Duplicates the real stdout fd for the sink, then points BOTH fd 1 and
+    the Python-level ``sys.stdout`` at stderr for the duration of the
+    context: stray writes — Python prints AND fd-level noise from C++
+    runtimes (the ``"d!\\n"`` fragments interleaved into BENCH_r05.json's
+    capture) — land on stderr, while ``sink.emit`` writes complete
+    single-line JSON records to the original stdout. The original stream/fd
+    layout is restored on exit.
+    """
+    sys.stdout.flush()
+    sys.stderr.flush()
+    saved = os.dup(1)
+    os.dup2(2, 1)
+    prev_stdout = sys.stdout
+    sys.stdout = sys.stderr
+
+    def write_all(s: str) -> None:
+        # os.write may short-write (EINTR, pipes); a truncated metric line
+        # would break the driver's last-line JSON parse — loop to completion.
+        view = memoryview(s.encode())
+        while view:
+            view = view[os.write(saved, view):]
+
+    try:
+        yield StdoutSink(write=write_all)
+    finally:
+        sys.stdout = prev_stdout
+        os.dup2(saved, 1)
+        os.close(saved)
+
+
+# Process-wide "current run" handle: lets deep helpers (the bench measurement
+# functions) record spans without threading a Telemetry through every
+# signature. Falls back to a throwaway registry when none is set, so
+# instrumented code needs no None-guards.
+_CURRENT: list = []
+
+
+def set_current(tel: Optional["Telemetry"]) -> None:
+    _CURRENT[:] = [tel] if tel is not None else []
+
+
+def current() -> "Telemetry":
+    """The process-current Telemetry, or a fresh sink-less one (aggregates
+    still work; nothing is persisted)."""
+    if not _CURRENT:
+        _CURRENT.append(Telemetry(run_id="ephemeral"))
+    return _CURRENT[0]
+
+
+def config_hash(cfg) -> str:
+    """Stable short hash of a frozen ExperimentConfig (repr is deterministic
+    for frozen dataclasses of scalars)."""
+    return hashlib.sha256(repr(cfg).encode()).hexdigest()[:12]
+
+
+def git_rev() -> Optional[str]:
+    """Best-effort git revision of the working tree (None outside a repo)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return out.stdout.strip() or None
+    except Exception:  # noqa: BLE001 — manifest must never crash the run
+        return None
+
+
+def run_manifest(cfg=None, extra: Optional[dict] = None) -> dict:
+    """Backend/device/config/provenance manifest for a run.
+
+    Never initializes a backend that is not already up: jax import failures
+    and backend probe failures degrade to ``None`` fields (the bench suite
+    runs ``ensure_backend`` before creating telemetry, so a dead tunnel has
+    already been replaced by host CPU here).
+    """
+    m: dict = {
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "git_rev": git_rev(),
+        "backend": None,
+        "device_kind": None,
+        "device_count": None,
+        "process_count": None,
+    }
+    try:
+        import jax
+
+        m["jax"] = jax.__version__
+        devices = jax.devices()
+        m["backend"] = jax.default_backend()
+        m["device_kind"] = devices[0].device_kind
+        m["device_count"] = len(devices)
+        m["process_count"] = jax.process_count()
+    except Exception as err:  # noqa: BLE001
+        m["backend_error"] = f"{type(err).__name__}: {err}"[:200]
+    if cfg is not None:
+        m["config_hash"] = config_hash(cfg)
+        try:
+            m["setting"] = cfg.setting
+        except Exception:  # noqa: BLE001
+            pass
+    if extra:
+        m.update(extra)
+    return m
+
+
+class Telemetry:
+    """One run's metric registry: counters, gauges, histograms, spans, sinks.
+
+    Aggregates live in memory and are flushed to ``summary.json`` by
+    ``close()``; ``event()`` records are pushed to every sink immediately.
+    """
+
+    def __init__(
+        self,
+        run_id: str = "run",
+        sinks=(),
+        manifest: Optional[dict] = None,
+        run_dir: Optional[str] = None,
+    ):
+        self.run_id = run_id
+        self.run_dir = run_dir
+        self.sinks = list(sinks)
+        self.manifest = dict(manifest or {})
+        self.spans = SpanRecorder()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._hists: dict = {}
+        self._closed = False
+
+    # --- creation -----------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        name: str = "run",
+        cfg=None,
+        root: Optional[str] = None,
+        extra_sinks=(),
+        extra_manifest: Optional[dict] = None,
+    ) -> "Telemetry":
+        """Create a run directory under ``root`` (default ``artifacts/runs``,
+        overridable via ``P2P_TELEMETRY_DIR``) with manifest + JSONL sink."""
+        root = root or os.environ.get("P2P_TELEMETRY_DIR") or DEFAULT_ROOT
+        run_id = f"{name}-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+        run_dir = os.path.join(root, run_id)
+        os.makedirs(run_dir, exist_ok=True)
+        manifest = run_manifest(cfg, extra=extra_manifest)
+        manifest["run_id"] = run_id
+        with open(os.path.join(run_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, default=_json_default)
+        sinks = [JsonlSink(os.path.join(run_dir, "metrics.jsonl"))]
+        sinks.extend(extra_sinks)
+        return cls(run_id=run_id, sinks=sinks, manifest=manifest, run_dir=run_dir)
+
+    @classmethod
+    def maybe_create(cls, name: str = "run", cfg=None, **kw) -> Optional["Telemetry"]:
+        """``create`` unless telemetry is disabled (``P2P_TELEMETRY=0``)."""
+        if os.environ.get("P2P_TELEMETRY", "").lower() in ("0", "off", "false"):
+            return None
+        return cls.create(name, cfg=cfg, **kw)
+
+    # --- aggregates ---------------------------------------------------------
+
+    def counter(self, name: str, inc=1) -> None:
+        self._counters[name] = self._counters.get(name, 0) + inc
+
+    def gauge(self, name: str, value) -> None:
+        self._gauges[name] = value
+
+    def histogram(self, name: str, value) -> None:
+        self._hists.setdefault(name, []).append(float(value))
+
+    @property
+    def counters(self) -> dict:
+        return dict(self._counters)
+
+    # --- events -------------------------------------------------------------
+
+    def emit(self, record: dict) -> None:
+        """Push a raw record to every sink, verbatim (the bench metric rows
+        must keep their exact schema — no decoration)."""
+        for sink in self.sinks:
+            sink.emit(record)
+
+    def event(self, kind: str, **fields) -> None:
+        """Timestamped, kind-tagged record to every sink."""
+        self.emit({"ts": round(time.time(), 3), "kind": kind, **fields})
+
+    # --- spans --------------------------------------------------------------
+
+    def span(self, name: str, **meta):
+        """Nested timing span context manager (see spans.SpanRecorder)."""
+        return self.spans.span(name, **meta)
+
+    def timed(self, name: str, fn, *args, block: bool = True, **meta):
+        """Run ``fn(*args)`` under a span; with ``block`` (default) the span
+        closes only after ``jax.block_until_ready`` on the result — the
+        boundary that separates dispatch from device execution time."""
+        with self.span(name, **meta):
+            out = fn(*args)
+            if block:
+                try:
+                    import jax
+
+                    jax.block_until_ready(out)
+                except Exception:  # noqa: BLE001 — non-jax results pass through
+                    pass
+        return out
+
+    # --- device counters ----------------------------------------------------
+
+    def record_device_counters(self, dc, prefix: str = "device.") -> None:
+        """Accumulate a DeviceCounters pytree (or its dict) into counters."""
+        from p2pmicrogrid_tpu.telemetry.device_metrics import dc_to_dict
+
+        d = dc if isinstance(dc, dict) else dc_to_dict(dc)
+        for k, v in d.items():
+            self.counter(prefix + k, v)
+
+    # --- summary / shutdown -------------------------------------------------
+
+    def _hist_stats(self, values) -> dict:
+        import numpy as np
+
+        a = np.asarray(values, dtype=float)
+        return {
+            "count": int(a.size),
+            "mean": float(a.mean()),
+            "min": float(a.min()),
+            "max": float(a.max()),
+            "p50": float(np.percentile(a, 50)),
+            "p95": float(np.percentile(a, 95)),
+        }
+
+    def summary(self) -> dict:
+        return {
+            "run_id": self.run_id,
+            "counters": {k: float(v) for k, v in self._counters.items()},
+            "gauges": {k: float(v) for k, v in self._gauges.items()},
+            "histograms": {k: self._hist_stats(v) for k, v in self._hists.items()},
+            "spans": self.spans.totals(),
+        }
+
+    def close(self) -> None:
+        """Flush the summary + Chrome trace to the run dir and close sinks.
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        s = self.summary()
+        self.event("summary", **{k: v for k, v in s.items() if k != "run_id"})
+        if self.run_dir:
+            with open(os.path.join(self.run_dir, "summary.json"), "w") as f:
+                json.dump(s, f, indent=2, default=_json_default)
+            self.spans.write_chrome_trace(
+                os.path.join(self.run_dir, "trace.json")
+            )
+        for sink in self.sinks:
+            sink.close()

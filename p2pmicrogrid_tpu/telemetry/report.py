@@ -1,0 +1,138 @@
+"""Render a telemetry run directory into a human-readable summary.
+
+Backs ``cli.py telemetry-report``: reads ``manifest.json``,
+``metrics.jsonl``, ``summary.json`` and ``trace.json`` (whatever subset
+exists) and produces a plain-text report — manifest provenance, event
+counts, training/health trajectory highlights, device-counter totals and a
+span timing table.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Optional
+
+
+def latest_run_dir(root: str) -> Optional[str]:
+    """Most recently modified run directory under ``root``."""
+    dirs = [d for d in glob.glob(os.path.join(root, "*")) if os.path.isdir(d)]
+    return max(dirs, key=os.path.getmtime) if dirs else None
+
+
+def load_run(run_dir: str) -> dict:
+    """{"manifest": dict|None, "events": [dict], "summary": dict|None}."""
+    out: dict = {"run_dir": run_dir, "manifest": None, "events": [], "summary": None}
+    mpath = os.path.join(run_dir, "manifest.json")
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            out["manifest"] = json.load(f)
+    jpath = os.path.join(run_dir, "metrics.jsonl")
+    if os.path.exists(jpath):
+        with open(jpath) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out["events"].append(json.loads(line))
+    spath = os.path.join(run_dir, "summary.json")
+    if os.path.exists(spath):
+        with open(spath) as f:
+            out["summary"] = json.load(f)
+    return out
+
+
+def _table(rows, headers) -> str:
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
+        for i, h in enumerate(headers)
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(lines)
+
+
+def render_run(run_dir: str) -> str:
+    data = load_run(run_dir)
+    parts = [f"telemetry run: {run_dir}"]
+
+    m = data["manifest"]
+    if m:
+        keys = (
+            "run_id", "created", "backend", "device_kind", "device_count",
+            "process_count", "config_hash", "setting", "git_rev", "jax",
+        )
+        rows = [(k, m[k]) for k in keys if m.get(k) is not None]
+        parts.append("\nmanifest\n" + _table(rows, ("field", "value")))
+    else:
+        parts.append("\n(no manifest.json)")
+
+    events = data["events"]
+    if events:
+        by_kind: dict = {}
+        for e in events:
+            by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
+        parts.append(
+            "\nevents (metrics.jsonl)\n"
+            + _table(sorted(by_kind.items()), ("kind", "count"))
+        )
+        health = [e for e in events if e.get("kind") == "health"]
+        if health:
+            rows = [
+                (e.get("episode"), f"{e.get('greedy_cost_eur', float('nan')):.1f}",
+                 f"{e.get('greedy_reward', float('nan')):.1f}", e.get("status"))
+                for e in health
+            ]
+            parts.append(
+                "\nhealth evals\n"
+                + _table(rows, ("episode", "greedy cost €", "greedy reward", "status"))
+            )
+            alerts = [e for e in events if e.get("kind") == "basin_alert"]
+            if alerts:
+                parts.append(
+                    "\nBASIN ALERTS at episodes: "
+                    + ", ".join(str(e.get("episode")) for e in alerts)
+                )
+        progress = [e for e in events if e.get("kind") == "progress"]
+        if progress:
+            last = progress[-1]
+            parts.append(
+                f"\nprogress: {len(progress)} windows, last at episode "
+                f"{last.get('episode')} (avg reward "
+                f"{last.get('avg_reward', float('nan')):.3f})"
+            )
+
+    s = data["summary"]
+    if s:
+        counters = s.get("counters", {})
+        dev = {k: v for k, v in counters.items() if k.startswith("device.")}
+        other = {k: v for k, v in counters.items() if not k.startswith("device.")}
+        if dev:
+            parts.append(
+                "\ndevice counters (episode-scan totals)\n"
+                + _table(sorted(dev.items()), ("counter", "total"))
+            )
+        if other:
+            parts.append(
+                "\ncounters\n" + _table(sorted(other.items()), ("counter", "total"))
+            )
+        if s.get("gauges"):
+            parts.append(
+                "\ngauges\n" + _table(sorted(s["gauges"].items()), ("gauge", "value"))
+            )
+        spans = s.get("spans", {})
+        if spans:
+            rows = [
+                (name, e["count"], f"{e['total_s']:.3f}")
+                for name, e in sorted(
+                    spans.items(), key=lambda kv: -kv[1]["total_s"]
+                )
+            ]
+            parts.append(
+                "\nspans\n" + _table(rows, ("span", "count", "total s"))
+            )
+    trace = os.path.join(run_dir, "trace.json")
+    if os.path.exists(trace):
+        parts.append(f"\nchrome trace: {trace} (load in chrome://tracing / Perfetto)")
+    return "\n".join(parts) + "\n"

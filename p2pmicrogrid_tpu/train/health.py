@@ -80,6 +80,7 @@ def make_greedy_eval(
     ratings,
     s_eval: int = 8,
     eval_seed: int = 10_000,
+    collect_device_metrics: bool = False,
 ) -> Callable[[object, jax.Array], Tuple[jax.Array, jax.Array]]:
     """Jitted greedy held-out eval: ``fn(pol_state, key) -> (cost, reward)``.
 
@@ -90,6 +91,11 @@ def make_greedy_eval(
     numbers whose DIVERGENCE is the basin signature. Works for all three
     shared implementations; DDPG acts through its deterministic actor (no OU
     state is carried, matching tools/learning_northstar.py's evaluator).
+
+    ``collect_device_metrics`` threads a ``telemetry.DeviceCounters`` total
+    through the slot scan (NaN Q-values, comfort-band violations, market
+    residual — accumulated in-program, one scalar transfer per call) and
+    makes the eval return ``(cost, reward, counters)``.
     """
     from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
 
@@ -110,6 +116,13 @@ def make_greedy_eval(
             )
             return frac, frac, q, ex
 
+    if collect_device_metrics:
+        from p2pmicrogrid_tpu.telemetry.device_metrics import (
+            dc_add,
+            dc_from_slot,
+            dc_zero,
+        )
+
     @jax.jit
     def greedy_eval(pol_state, key):
         k_phys, k_scan = jax.random.split(key)
@@ -123,19 +136,23 @@ def make_greedy_eval(
               xs.next_time, xs.next_load_w, xs.next_pv_w)
 
         def slot(carry, xs_t):
-            phys_s, kk = carry
+            phys_s, kk, dc = carry
             kk, k_act = jax.random.split(kk)
             phys_s, _, out, _, _ = slot_dynamics_batched(
                 cfg, policy, pol_state, phys_s, xs_t, k_act, ratings_j,
                 explore=False, act_fn=act_fn,
             )
-            return (phys_s, kk), (out.cost, out.reward)
+            if collect_device_metrics:
+                dc = dc_add(dc, dc_from_slot(cfg, out))
+            return (phys_s, kk, dc), (out.cost, out.reward)
 
-        (_, _), (cost, reward) = jax.lax.scan(slot, (phys, k_scan), xs)
-        return (
-            jnp.sum(cost, axis=(0, 2)).mean(),
-            jnp.sum(jnp.mean(reward, axis=-1), axis=0).mean(),
+        dc0 = dc_zero() if collect_device_metrics else None
+        (_, _, dc), (cost, reward) = jax.lax.scan(
+            slot, (phys, k_scan, dc0), xs
         )
+        c = jnp.sum(cost, axis=(0, 2)).mean()
+        r = jnp.sum(jnp.mean(reward, axis=-1), axis=0).mean()
+        return (c, r, dc) if collect_device_metrics else (c, r)
 
     return greedy_eval
 
@@ -177,7 +194,9 @@ class HealthMonitor:
     and is flagged at the first in-basin eval).
     """
 
-    def __init__(self, slots: int, warn_stream=None, initial_cost=None):
+    def __init__(
+        self, slots: int, warn_stream=None, initial_cost=None, telemetry=None
+    ):
         self.slots = slots
         self.warn_stream = warn_stream if warn_stream is not None else sys.stderr
         self.points: list[HealthPoint] = []
@@ -186,6 +205,11 @@ class HealthMonitor:
         )
         self.basin_entries: list[int] = []   # first flagged episode per entry
         self.basin_exits: list[int] = []     # first healthy episode after one
+        # Optional telemetry.Telemetry: every eval point and basin
+        # entry/exit is emitted as an event, so alerts land in the SAME run
+        # directory (metrics.jsonl) as the training metrics instead of a
+        # bespoke side file.
+        self.telemetry = telemetry
 
     @property
     def in_basin(self) -> bool:
@@ -197,8 +221,24 @@ class HealthMonitor:
             self.initial_cost = cost
         status = classify_health(cost, reward, self.slots, self.initial_cost)
         was_in_basin = self.in_basin
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "health",
+                episode=episode,
+                greedy_cost_eur=cost,
+                greedy_reward=reward,
+                status=status,
+            )
         if status == "basin" and not was_in_basin:
             self.basin_entries.append(episode)
+            if self.telemetry is not None:
+                self.telemetry.event(
+                    "basin_alert",
+                    episode=episode,
+                    greedy_cost_eur=cost,
+                    greedy_reward=reward,
+                )
+                self.telemetry.counter("health.basin_entries")
             print(
                 f"HEALTH ALERT (episode {episode}): greedy reward "
                 f"{reward:.0f} with community cost {cost:.0f} EUR — the "
@@ -222,6 +262,8 @@ class HealthMonitor:
             )
         elif status == "healthy" and was_in_basin:
             self.basin_exits.append(episode)
+            if self.telemetry is not None:
+                self.telemetry.event("basin_exit", episode=episode)
             print(
                 f"health: recovered at episode {episode} (greedy reward "
                 f"{reward:.0f}, cost {cost:.0f} EUR).",
@@ -239,6 +281,15 @@ class HealthMonitor:
             "points": [p._asdict() for p in self.points],
         }
 
+    def emit_summary(self) -> None:
+        """Serialize through the telemetry sink (one ``health_summary``
+        event in the run's metrics.jsonl) — the replacement for callers
+        hand-writing ``to_dict()`` to bespoke side files."""
+        if self.telemetry is not None:
+            d = self.to_dict()
+            d.pop("points")  # every point is already an event of its own
+            self.telemetry.event("health_summary", **d)
+
 
 def untrained_reference_cost(
     cfg: ExperimentConfig, policy, greedy_eval, seed: int = 0
@@ -251,8 +302,10 @@ def untrained_reference_cost(
     from p2pmicrogrid_tpu.parallel import init_shared_pol_state
 
     ref_ps = init_shared_pol_state(cfg, jax.random.PRNGKey(seed))
-    c, _ = greedy_eval(ref_ps, jax.random.PRNGKey(1))
-    return float(c)
+    # Cost is element 0 for both eval arities (collect_device_metrics
+    # appends a counters element).
+    out = greedy_eval(ref_ps, jax.random.PRNGKey(1))
+    return float(out[0])
 
 
 def _lr_boosted_cfg(cfg: ExperimentConfig, mult: float) -> ExperimentConfig:
@@ -293,6 +346,7 @@ def train_chunked_with_health(
     monitor: Optional[HealthMonitor] = None,
     health_cb: Optional[Callable] = None,
     s_eval: int = 8,
+    telemetry="auto",
 ) -> Tuple[object, np.ndarray, np.ndarray, float, HealthMonitor]:
     """``train_scenarios_chunked`` with the health surface on.
 
@@ -314,6 +368,15 @@ def train_chunked_with_health(
     ``health_cb(point: HealthPoint)`` fires after every eval (CLI uses it to
     log to the results store). Returns (pol_state, rewards, losses, seconds,
     monitor); rewards/losses concatenate the per-block outputs.
+
+    ``telemetry``: a ``telemetry.Telemetry`` to emit through, ``None`` to
+    disable, or ``"auto"`` (default) to create a run directory under
+    ``artifacts/runs/`` (manifest + metrics JSONL + span trace + summary;
+    suppressed by ``P2P_TELEMETRY=0``). Every eval point, basin alert and
+    per-eval device-counter total (NaN Q-values, comfort violations, market
+    residual — accumulated inside the jitted eval scan) is an event; train
+    blocks and evals are spans. An auto-created telemetry is closed (summary
+    + Chrome trace written) before returning.
     """
     from p2pmicrogrid_tpu.parallel.scenarios import (
         make_chunked_episode_runner,
@@ -359,8 +422,31 @@ def train_chunked_with_health(
     normal_runner, normal_episode_fn = build_runner(cfg)
     boosted = None  # (runner, episode_fn), built lazily on first basin entry
 
-    greedy_eval = make_greedy_eval(cfg, policy, ratings, s_eval=s_eval)
+    owns_telemetry = False
+    if telemetry == "auto":
+        from p2pmicrogrid_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry.maybe_create(
+            "train-chunked",
+            cfg=cfg,
+            extra_manifest={
+                "n_episodes": n_episodes,
+                "n_chunks": n_chunks,
+                "aggregate_scenarios": S * n_chunks,
+                "mitigate": mitigate,
+            },
+        )
+        owns_telemetry = telemetry is not None
+    if telemetry is not None and telemetry.run_dir:
+        print(f"telemetry run: {telemetry.run_dir}", file=sys.stderr, flush=True)
+
+    greedy_eval = make_greedy_eval(
+        cfg, policy, ratings, s_eval=s_eval,
+        collect_device_metrics=telemetry is not None,
+    )
     monitor = monitor or HealthMonitor(cfg.sim.slots_per_day)
+    if monitor.telemetry is None:
+        monitor.telemetry = telemetry
     if monitor.initial_cost is None and episode0 > 0:
         # Resuming: calibrate against a fresh init, not the restored policy.
         monitor.initial_cost = untrained_reference_cost(
@@ -368,33 +454,76 @@ def train_chunked_with_health(
         )
 
     def do_eval(ep):
-        c, r = greedy_eval(pol_state, jax.random.PRNGKey(1))
+        if telemetry is not None:
+            from p2pmicrogrid_tpu.telemetry import dc_to_dict
+
+            with telemetry.span("greedy_eval", episode=ep):
+                c, r, dc = greedy_eval(pol_state, jax.random.PRNGKey(1))
+                jax.block_until_ready(c)
+            dcd = dc_to_dict(dc)
+            telemetry.record_device_counters(dcd)
+            telemetry.event("device_counters", episode=ep, **dcd)
+        else:
+            c, r = greedy_eval(pol_state, jax.random.PRNGKey(1))
         monitor.update(ep, c, r)
         if health_cb:
             health_cb(monitor.points[-1])
 
-    do_eval(episode0)
     rewards, losses = [], []
     seconds = 0.0
     done = 0
-    while done < n_episodes:
-        block = min(eval_every, n_episodes - done)
-        runner, episode_fn = normal_runner, normal_episode_fn
-        if mitigate == "lr-boost" and monitor.in_basin:
-            if boosted is None:
-                boosted = build_runner(_lr_boosted_cfg(cfg, lr_boost))
-            runner, episode_fn = boosted
-        pol_state, r, l, secs = train_scenarios_chunked(
-            cfg, policy, pol_state, ratings, key,
-            n_episodes=block, n_chunks=n_chunks,
-            episode0=episode0 + done, episode_cb=episode_cb,
-            episode_fn=episode_fn, runner=runner,
-        )
-        rewards.append(r)
-        losses.append(l)
-        seconds += secs
-        done += block
-        do_eval(episode0 + done)
+    import contextlib
+
+    # An auto-created telemetry must close (summary.json + Chrome trace) even
+    # when a block crashes — a failed run is exactly when the record matters.
+    try:
+        do_eval(episode0)
+        while done < n_episodes:
+            block = min(eval_every, n_episodes - done)
+            runner, episode_fn = normal_runner, normal_episode_fn
+            boosting = mitigate == "lr-boost" and monitor.in_basin
+            if boosting:
+                if boosted is None:
+                    boosted = build_runner(_lr_boosted_cfg(cfg, lr_boost))
+                runner, episode_fn = boosted
+            span = (
+                telemetry.span(
+                    "train_block", episode0=episode0 + done, episodes=block,
+                    lr_boosted=boosting,
+                )
+                if telemetry is not None
+                else contextlib.nullcontext()
+            )
+            with span:
+                pol_state, r, l, secs = train_scenarios_chunked(
+                    cfg, policy, pol_state, ratings, key,
+                    n_episodes=block, n_chunks=n_chunks,
+                    episode0=episode0 + done, episode_cb=episode_cb,
+                    episode_fn=episode_fn, runner=runner,
+                )
+            if telemetry is not None:
+                telemetry.event(
+                    "train_block",
+                    episode0=episode0 + done,
+                    episodes=block,
+                    seconds=round(secs, 3),
+                    mean_reward=float(np.mean(r)),
+                    mean_loss=float(np.mean(l)),
+                    lr_boosted=boosting,
+                )
+                telemetry.counter("train.episodes", block)
+                telemetry.histogram("train.block_seconds", secs)
+            rewards.append(r)
+            losses.append(l)
+            seconds += secs
+            done += block
+            do_eval(episode0 + done)
+        if telemetry is not None:
+            telemetry.gauge("train.seconds_total", seconds)
+            monitor.emit_summary()
+    finally:
+        if owns_telemetry:
+            telemetry.close()
     return (
         pol_state,
         np.concatenate(rewards, axis=0),

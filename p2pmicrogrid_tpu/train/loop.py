@@ -74,6 +74,7 @@ def make_train_step(
     arrays: EpisodeArrays,
     ratings: AgentRatings,
     block: Optional[int] = None,
+    collect_device_metrics: bool = False,
 ) -> Callable:
     """Jitted function running ``block`` training episodes (defaults to
     ``episodes_per_jit_block``).
@@ -84,37 +85,52 @@ def make_train_step(
     schedule (every ``min_episodes_criterion`` episodes, community.py:279-287)
     runs *inside* the block via ``lax.cond`` keyed on the global episode index,
     so fused blocks follow the reference schedule exactly.
+
+    With ``collect_device_metrics`` each episode also accumulates the
+    in-program ``telemetry.DeviceCounters`` (run_episode threads them through
+    the slot scan) and the block returns a 5th element: the block-total
+    counters, reduced on device.
     """
     if block is None:
         block = cfg.train.episodes_per_jit_block
     criterion = cfg.train.min_episodes_criterion
+    if collect_device_metrics:
+        from p2pmicrogrid_tpu.telemetry.device_metrics import dc_add, dc_zero
 
     def one_episode(pol_state, key):
         k_phys, k_ep = jax.random.split(key)
         phys = init_physical(cfg, k_phys)
-        phys, pol_state, outputs = run_episode(
-            cfg, policy, pol_state, phys, arrays, ratings, k_ep, training=True
+        out = run_episode(
+            cfg, policy, pol_state, phys, arrays, ratings, k_ep, training=True,
+            collect_device_metrics=collect_device_metrics,
         )
+        phys, pol_state, outputs = out[:3]
         reward, loss = _episode_metrics(outputs)
-        return pol_state, phys, reward, loss
+        dc = out[3] if collect_device_metrics else None
+        return pol_state, phys, reward, loss, dc
 
     @jax.jit
     def train_block(pol_state, episode0, key):
         keys = jax.random.split(key, block)
 
         def body(carry, xs):
-            pol_state = carry
+            pol_state, dc_tot = carry
             i, k = xs
-            pol_state, phys, reward, loss = one_episode(pol_state, k)
+            pol_state, phys, reward, loss, dc = one_episode(pol_state, k)
+            if collect_device_metrics:
+                dc_tot = dc_add(dc_tot, dc)
             pol_state = jax.lax.cond(
                 (episode0 + i) % criterion == 0, policy.decay, lambda s: s, pol_state
             )
-            return pol_state, (reward, loss, phys)
+            return (pol_state, dc_tot), (reward, loss, phys)
 
-        pol_state, (rewards, losses, physes) = jax.lax.scan(
-            body, pol_state, (jnp.arange(block), keys)
+        dc0 = dc_zero() if collect_device_metrics else None
+        (pol_state, dc_tot), (rewards, losses, physes) = jax.lax.scan(
+            body, (pol_state, dc0), (jnp.arange(block), keys)
         )
         last_phys = jax.tree_util.tree_map(lambda x: x[-1], physes)
+        if collect_device_metrics:
+            return pol_state, last_phys, rewards, losses, dc_tot
         return pol_state, last_phys, rewards, losses
 
     return train_block
@@ -165,6 +181,7 @@ def train_community(
     progress_cb: Optional[Callable[[int, float, float], None]] = None,
     checkpoint_cb: Optional[Callable[[int, object], None]] = None,
     verbose: bool = False,
+    telemetry=None,
 ) -> TrainResult:
     """The reference's training driver (community.py:248-298).
 
@@ -172,6 +189,12 @@ def train_community(
     running-average progress record (community.py:279-288). Every
     ``save_episodes`` episodes: invoke the checkpoint callback
     (community.py:290-292). Returns final states plus metric histories.
+
+    ``telemetry`` (a ``telemetry.Telemetry``) turns the run observable:
+    progress records become ``progress`` events, each fused block runs under
+    a ``train_block`` span, and the in-program device counters (NaN/comfort/
+    market totals accumulated inside the jitted block) are reduced and
+    recorded per block as ``device.*`` counters.
     """
     t = cfg.train
     arrays = build_episode_arrays(cfg, traces, ratings)
@@ -180,7 +203,10 @@ def train_community(
         key, k_warm = jax.random.split(key)
         pol_state = init_dqn_buffers(cfg, policy, pol_state, arrays, ratings, k_warm)
 
-    train_block = make_train_step(cfg, policy, arrays, ratings)
+    collect_dc = telemetry is not None
+    train_block = make_train_step(
+        cfg, policy, arrays, ratings, collect_device_metrics=collect_dc
+    )
     block = t.episodes_per_jit_block
 
     result = TrainResult(pol_state=pol_state, phys=None)
@@ -195,9 +221,12 @@ def train_community(
     def step_of(size: int):
         if size not in step_fns:
             step_fns[size] = make_train_step(
-                cfg, policy, arrays, ratings, block=size
+                cfg, policy, arrays, ratings, block=size,
+                collect_device_metrics=collect_dc,
             )
         return step_fns[size]
+
+    import contextlib
 
     while episode < t.max_episodes:
         key, k_block = jax.random.split(key)
@@ -215,9 +244,20 @@ def train_community(
             to_boundary = t.save_episodes - episode % t.save_episodes
             step_size = min(step_size, to_boundary)
         step_fn = step_of(step_size)
-        pol_state, phys, rewards, losses = step_fn(
-            pol_state, jnp.asarray(episode), k_block
+        span = (
+            telemetry.span("train_block", episode0=episode, episodes=step_size)
+            if telemetry is not None
+            else contextlib.nullcontext()
         )
+        with span:
+            out = step_fn(pol_state, jnp.asarray(episode), k_block)
+            pol_state, phys, rewards, losses = out[:4]
+            if collect_dc:
+                jax.block_until_ready(rewards)
+        if collect_dc:
+            from p2pmicrogrid_tpu.telemetry import dc_to_dict
+
+            telemetry.record_device_counters(dc_to_dict(out[4]))
         rewards = np.asarray(rewards)
         losses = np.asarray(losses)
 
@@ -236,6 +276,10 @@ def train_community(
                 result.progress.append((ep, avg_r, avg_l))
                 if progress_cb:
                     progress_cb(ep, avg_r, avg_l)
+                if telemetry is not None:
+                    telemetry.event(
+                        "progress", episode=ep, avg_reward=avg_r, avg_error=avg_l
+                    )
                 if verbose:
                     print(f"episode {ep}: avg reward {avg_r:.3f}, avg error {avg_l:.3f}")
 
@@ -252,6 +296,9 @@ def train_community(
     result.env_steps = (episode - t.starting_episodes) * arrays.n_slots
     result.pol_state = pol_state
     result.phys = phys
+    if telemetry is not None:
+        telemetry.gauge("train.seconds_total", result.train_seconds)
+        telemetry.gauge("train.env_steps_per_sec", result.env_steps_per_sec)
     return result
 
 

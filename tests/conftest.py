@@ -10,6 +10,11 @@ platform selection back to cpu through jax.config, not the environment.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Auto-created telemetry run directories (Telemetry.maybe_create) stay off
+# under pytest — training helpers/CLI calls in tests must not litter
+# artifacts/runs/. Telemetry tests construct explicit Telemetry objects,
+# which this does not affect.
+os.environ.setdefault("P2P_TELEMETRY", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

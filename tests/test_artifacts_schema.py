@@ -1,0 +1,94 @@
+"""tools/check_artifacts_schema.py: the executable format contracts for
+bench captures, metric JSONL files, and telemetry run directories."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+TOOL = os.path.join(REPO, "tools", "check_artifacts_schema.py")
+
+
+@pytest.fixture(scope="module")
+def checker():
+    spec = importlib.util.spec_from_file_location("check_artifacts_schema", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_committed_artifacts_validate():
+    """The repo's own BENCH_*.json captures and artifacts/ JSONL files must
+    pass — this is the drift tripwire."""
+    out = subprocess.run(
+        [sys.executable, TOOL, "--root", REPO],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+
+
+def test_detects_broken_metric_row(checker, tmp_path):
+    bad = tmp_path / "BENCH_x.json"
+    bad.write_text(json.dumps({
+        "n": 1, "cmd": "c", "rc": 0, "tail": "",
+        "parsed": {"metric": "m", "value": "not-a-number", "unit": "u"},
+    }))
+    problems = []
+    checker.check_bench_capture(str(bad), problems, strict_tail=False)
+    assert any("vs_baseline" in p for p in problems)      # missing key
+    assert any("'value'" in p for p in problems)          # wrong type
+
+
+def test_detects_tail_noise_in_strict_mode(checker, tmp_path):
+    row = json.dumps({"metric": "m", "value": 1.0, "unit": "u",
+                      "vs_baseline": 1.0})
+    doc = {"n": 1, "cmd": "c", "rc": 0,
+           "tail": row + "\nd!\n" + row + "\n", "parsed": json.loads(row)}
+    path = tmp_path / "BENCH_noise.json"
+    path.write_text(json.dumps(doc))
+    lax, strict = [], []
+    checker.check_bench_capture(str(path), lax, strict_tail=False)
+    checker.check_bench_capture(str(path), strict, strict_tail=True)
+    assert lax == []
+    assert any("noise" in p for p in strict)
+
+
+def test_detects_bad_run_dir(checker, tmp_path):
+    run = tmp_path / "run-1"
+    run.mkdir()
+    (run / "metrics.jsonl").write_text('{"no_ts": true}\nnot json\n')
+    problems = []
+    checker.check_run_dir(str(run), problems)
+    assert any("manifest.json" in p for p in problems)
+    assert any("'ts'" in p for p in problems)
+    assert any("not valid JSON" in p for p in problems)
+
+
+def test_valid_run_dir_passes(checker, tmp_path):
+    from p2pmicrogrid_tpu.telemetry import Telemetry
+
+    tel = Telemetry.create("schema-test", root=str(tmp_path))
+    tel.event("health", episode=0, status="healthy")
+    tel.counter("c", 1)
+    with tel.span("s"):
+        pass
+    tel.close()
+    problems = []
+    checker.check_run_dir(tel.run_dir, problems)
+    assert problems == []
+
+
+def test_metric_jsonl_lines_checked(checker, tmp_path):
+    path = tmp_path / "BENCH_full_x.jsonl"
+    path.write_text(
+        json.dumps({"metric": "m", "value": 1.0, "unit": "u",
+                    "vs_baseline": 1.0})
+        + "\n{\"metric\": \"m2\"}\n"
+    )
+    problems = []
+    checker.check_metric_jsonl(str(path), problems)
+    assert any("missing key 'value'" in p for p in problems)

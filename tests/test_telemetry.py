@@ -1,0 +1,407 @@
+"""Telemetry subsystem: registry aggregates/sinks, span nesting + Chrome
+trace export, JSONL round-trip, stdout hygiene, and device-counter
+correctness under jit (NaN injection; comfort-violation count vs a numpy
+recomputation of the same episode)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.telemetry import (
+    JsonlSink,
+    MemorySink,
+    Telemetry,
+    dc_add,
+    dc_from_slot,
+    dc_to_dict,
+    dc_zero,
+    guarded_stdout_sink,
+)
+
+
+class TestRegistry:
+    def test_counters_gauges_histograms_aggregate(self):
+        tel = Telemetry(run_id="t")
+        tel.counter("a")
+        tel.counter("a", 4)
+        tel.gauge("g", 1.0)
+        tel.gauge("g", 2.5)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            tel.histogram("h", v)
+        s = tel.summary()
+        assert s["counters"]["a"] == 5.0
+        assert s["gauges"]["g"] == 2.5
+        h = s["histograms"]["h"]
+        assert h["count"] == 4 and h["min"] == 1.0 and h["max"] == 4.0
+        assert h["mean"] == pytest.approx(2.5)
+
+    def test_events_reach_all_sinks_with_ts_and_kind(self):
+        m1, m2 = MemorySink(), MemorySink()
+        tel = Telemetry(run_id="t", sinks=[m1, m2])
+        tel.event("health", episode=3, status="healthy")
+        assert len(m1.records) == len(m2.records) == 1
+        rec = m1.records[0]
+        assert rec["kind"] == "health" and rec["episode"] == 3
+        assert isinstance(rec["ts"], float)
+
+    def test_emit_is_verbatim(self):
+        # Bench metric rows must keep their exact schema — no decoration.
+        m = MemorySink()
+        tel = Telemetry(run_id="t", sinks=[m])
+        row = {"metric": "x", "value": 1.0, "unit": "u", "vs_baseline": 2.0}
+        tel.emit(row)
+        assert m.records[0] == row
+
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        tel = Telemetry(run_id="t", sinks=[JsonlSink(path)])
+        tel.event("a", x=1)
+        tel.event("b", y=[1, 2], z="s")
+        tel.event("c", w=np.float32(1.5))  # numpy scalars must serialize
+        tel.close()
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        # close() appends a summary event after the three emitted ones.
+        assert [r["kind"] for r in recs] == ["a", "b", "c", "summary"]
+        assert recs[1]["y"] == [1, 2]
+        assert recs[2]["w"] == 1.5
+
+    def test_create_writes_manifest_and_close_writes_summary(self, tmp_path):
+        cfg = default_config()
+        tel = Telemetry.create("unit", cfg=cfg, root=str(tmp_path))
+        tel.counter("c", 2)
+        with tel.span("s"):
+            pass
+        tel.close()
+        assert tel.run_dir is not None
+        manifest = json.load(open(os.path.join(tel.run_dir, "manifest.json")))
+        assert manifest["run_id"] == tel.run_id
+        assert manifest["config_hash"]
+        summary = json.load(open(os.path.join(tel.run_dir, "summary.json")))
+        assert summary["counters"]["c"] == 2.0
+        assert summary["spans"]["s"]["count"] == 1
+        trace = json.load(open(os.path.join(tel.run_dir, "trace.json")))
+        assert [e["name"] for e in trace["traceEvents"]] == ["s"]
+
+    def test_maybe_create_honors_kill_switch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("P2P_TELEMETRY", "0")
+        assert Telemetry.maybe_create("x", root=str(tmp_path)) is None
+        monkeypatch.setenv("P2P_TELEMETRY", "1")
+        tel = Telemetry.maybe_create("x", root=str(tmp_path))
+        assert tel is not None
+
+
+class TestSpans:
+    def test_nesting_and_durations(self):
+        tel = Telemetry(run_id="t")
+        with tel.span("outer"):
+            with tel.span("inner", tag="x"):
+                pass
+        # Completion order: inner closes first.
+        names = [s.name for s in tel.spans.completed]
+        assert names == ["inner", "outer"]
+        inner, outer = tel.spans.completed
+        assert inner.depth == 1 and outer.depth == 0
+        assert outer.duration >= inner.duration >= 0
+
+    def test_chrome_trace_export(self):
+        tel = Telemetry(run_id="t")
+        with tel.span("a"):
+            with tel.span("b"):
+                pass
+        trace = tel.spans.chrome_trace()
+        events = {e["name"]: e for e in trace["traceEvents"]}
+        assert set(events) == {"a", "b"}
+        for e in events.values():
+            assert e["ph"] == "X" and e["dur"] >= 0
+        # Child interval is contained in the parent's.
+        a, b = events["a"], events["b"]
+        assert a["ts"] <= b["ts"]
+        assert b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-3
+
+    def test_duration_lookup_returns_most_recent(self):
+        tel = Telemetry(run_id="t")
+        with tel.span("x"):
+            pass
+        with tel.span("x"):
+            pass
+        assert tel.spans.duration("x") == tel.spans.completed[-1].duration
+        assert tel.spans.duration("missing") is None
+
+    def test_timed_runs_fn_under_span(self):
+        tel = Telemetry(run_id="t")
+        out = tel.timed("compute", lambda: jnp.arange(4).sum())
+        assert int(out) == 6
+        assert tel.spans.duration("compute") is not None
+
+
+class TestStdoutHygiene:
+    def test_guarded_sink_keeps_stdout_strictly_json(self, capfd):
+        with guarded_stdout_sink() as sink:
+            print("stray python noise")          # fd 1 -> stderr now
+            os.write(1, b"stray fd noise\n")      # raw writes too
+            sink.emit({"metric": "m", "value": 1.0, "unit": "u",
+                       "vs_baseline": 2.0})
+        out, err = capfd.readouterr()
+        lines = [l for l in out.splitlines() if l.strip()]
+        assert len(lines) == 1
+        assert json.loads(lines[0])["metric"] == "m"
+        assert "stray python noise" in err and "stray fd noise" in err
+
+
+def _slot_outputs(q, loss, t_in, p_grid, p_p2p):
+    """Minimal SlotOutputs for counter tests (unused fields zeroed)."""
+    from p2pmicrogrid_tpu.envs.community import SlotOutputs
+
+    z = jnp.zeros_like(jnp.asarray(t_in))
+    return SlotOutputs(
+        cost=z, reward=z, loss=jnp.asarray(loss), p_grid=jnp.asarray(p_grid),
+        p_p2p=jnp.asarray(p_p2p), buy_price=jnp.zeros(()),
+        injection_price=jnp.zeros(()), trade_price=jnp.zeros(()),
+        t_in=jnp.asarray(t_in), hp_power_w=z, decisions=z[None],
+        q=jnp.asarray(q),
+    )
+
+
+class TestDeviceCounters:
+    def test_nan_and_inf_counted_under_jit(self):
+        cfg = default_config(sim=SimConfig(n_agents=4))
+
+        @jax.jit
+        def count(q, loss):
+            out = _slot_outputs(
+                q, loss,
+                t_in=jnp.full(4, 21.0),
+                p_grid=jnp.zeros(4), p_p2p=jnp.zeros(4),
+            )
+            return dc_from_slot(cfg, out)
+
+        q = jnp.array([1.0, jnp.nan, jnp.inf, 2.0])
+        loss = jnp.array([0.0, 0.0, jnp.nan, 0.0])
+        d = dc_to_dict(count(q, loss))
+        assert d["nonfinite_q"] == 2
+        assert d["nonfinite_loss"] == 1
+
+    def test_comfort_and_market_counters(self):
+        cfg = default_config(sim=SimConfig(n_agents=3))
+        th = cfg.thermal
+        out = _slot_outputs(
+            q=jnp.zeros(3), loss=jnp.zeros(3),
+            t_in=jnp.array([th.lower_bound - 0.5, th.setpoint,
+                            th.upper_bound + 0.1]),
+            p_grid=jnp.array([1000.0, -500.0, 0.0]),
+            p_p2p=jnp.array([200.0, -200.0, 0.0]),
+        )
+        d = dc_to_dict(dc_from_slot(cfg, out))
+        assert d["comfort_violations"] == 2
+        assert d["market_residual_wh"] == pytest.approx(
+            1500.0 * cfg.sim.slot_hours
+        )
+        assert d["trade_wh"] == pytest.approx(200.0 * cfg.sim.slot_hours)
+
+    def test_accumulation_preserves_dtypes(self):
+        a = dc_add(dc_zero(), dc_zero())
+        assert a.nonfinite_q.dtype == jnp.int32
+        assert a.market_residual_wh.dtype == jnp.float32
+
+    def test_episode_counters_match_numpy_recomputation(self):
+        """run_episode(collect_device_metrics=True): the in-scan comfort and
+        market totals must equal a host recomputation from the recorded
+        per-slot outputs."""
+        from p2pmicrogrid_tpu.data import synthetic_traces
+        from p2pmicrogrid_tpu.envs import (
+            build_episode_arrays,
+            init_physical,
+            make_ratings,
+            run_episode,
+        )
+        from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=3),
+            train=TrainConfig(implementation="tabular"),
+        )
+        traces = synthetic_traces(n_days=1, start_day=11).normalized()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        arrays = build_episode_arrays(cfg, traces, ratings)
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        phys = init_physical(cfg, jax.random.PRNGKey(1))
+
+        fn = jax.jit(
+            lambda ps, phys, k: run_episode(
+                cfg, policy, ps, phys, arrays, ratings, k, training=True,
+                collect_device_metrics=True,
+            )
+        )
+        _, _, outputs, dc = fn(ps, phys, jax.random.PRNGKey(2))
+        d = dc_to_dict(dc)
+
+        t_in = np.asarray(outputs.t_in)          # [T, A] pre-step temps
+        th = cfg.thermal
+        want_viol = int(
+            ((t_in < th.lower_bound) | (t_in > th.upper_bound)).sum()
+        )
+        assert d["comfort_violations"] == want_viol
+        want_resid = float(
+            np.abs(np.asarray(outputs.p_grid)).sum() * cfg.sim.slot_hours
+        )
+        assert d["market_residual_wh"] == pytest.approx(want_resid, rel=1e-4)
+        want_trade = float(
+            np.clip(np.asarray(outputs.p_p2p), 0.0, None).sum()
+            * cfg.sim.slot_hours
+        )
+        assert d["trade_wh"] == pytest.approx(want_trade, rel=1e-4)
+        assert d["nonfinite_q"] == 0 and d["nonfinite_loss"] == 0
+
+
+@pytest.mark.slow
+class TestHealthIntegration:
+    def test_chunked_health_run_produces_run_dir(self, tmp_path):
+        """train_chunked_with_health with an explicit Telemetry emits health
+        events, device counters, spans, and a parseable run directory."""
+        from p2pmicrogrid_tpu.config import DDPGConfig
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+        from p2pmicrogrid_tpu.train import make_policy
+        from p2pmicrogrid_tpu.train.health import (
+            HealthMonitor,
+            train_chunked_with_health,
+        )
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=3, n_scenarios=2),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(buffer_size=32, batch_size=2,
+                            share_across_agents=True),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+        tel = Telemetry.create("test-health", cfg=cfg, root=str(tmp_path))
+        train_chunked_with_health(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(7),
+            n_episodes=2, n_chunks=2, eval_every=1, s_eval=2,
+            monitor=HealthMonitor(96, warn_stream=open(os.devnull, "w")),
+            telemetry=tel,
+        )
+        tel.close()
+        recs = [
+            json.loads(l)
+            for l in open(os.path.join(tel.run_dir, "metrics.jsonl"))
+        ]
+        kinds = {r["kind"] for r in recs}
+        assert {"health", "device_counters", "train_block",
+                "health_summary"} <= kinds
+        # Device counters were accumulated from the jitted eval scan.
+        summary = json.load(open(os.path.join(tel.run_dir, "summary.json")))
+        assert "device.comfort_violations" in summary["counters"]
+        assert summary["spans"]["greedy_eval"]["count"] == 3
+        # The run dir validates against the documented schema.
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "check_artifacts_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        problems: list = []
+        mod.check_run_dir(tel.run_dir, problems)
+        assert problems == []
+
+    def test_untrained_reference_cost_accepts_counter_eval(self):
+        """The resume path calibrates against a counter-collecting greedy
+        eval (3-tuple return) — it must unpack either arity."""
+        from p2pmicrogrid_tpu.config import DDPGConfig
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.train import make_policy
+        from p2pmicrogrid_tpu.train.health import (
+            make_greedy_eval,
+            untrained_reference_cost,
+        )
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=3, n_scenarios=2),
+            train=TrainConfig(implementation="ddpg"),
+            ddpg=DDPGConfig(buffer_size=32, batch_size=2,
+                            share_across_agents=True),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ev = make_greedy_eval(
+            cfg, policy, ratings, s_eval=2, collect_device_metrics=True
+        )
+        c = untrained_reference_cost(cfg, policy, ev, seed=0)
+        assert np.isfinite(c)
+
+    def test_train_community_telemetry(self, tmp_path):
+        """train_community emits progress events and device.* counters."""
+        from p2pmicrogrid_tpu.data import synthetic_traces
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.train import (
+            init_policy_state,
+            make_policy,
+            train_community,
+        )
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=2),
+            train=TrainConfig(implementation="tabular", max_episodes=2),
+        )
+        traces = synthetic_traces(n_days=1, start_day=11).normalized()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        tel = Telemetry.create("test-train", cfg=cfg, root=str(tmp_path))
+        train_community(
+            cfg, policy, ps, traces, ratings, jax.random.PRNGKey(0),
+            telemetry=tel,
+        )
+        tel.close()
+        summary = json.load(open(os.path.join(tel.run_dir, "summary.json")))
+        assert summary["counters"]["device.comfort_violations"] >= 0
+        assert summary["spans"]["train_block"]["count"] >= 1
+        recs = [
+            json.loads(l)
+            for l in open(os.path.join(tel.run_dir, "metrics.jsonl"))
+        ]
+        assert any(r["kind"] == "progress" for r in recs)
+
+
+class TestReport:
+    def test_render_run_smoke(self, tmp_path):
+        tel = Telemetry.create("report-test", root=str(tmp_path))
+        tel.event("health", episode=0, greedy_cost_eur=12.0,
+                  greedy_reward=-2.0, status="healthy")
+        tel.event("basin_alert", episode=10, greedy_cost_eur=-400.0,
+                  greedy_reward=-1500.0)
+        tel.counter("device.comfort_violations", 7)
+        with tel.span("train_block"):
+            pass
+        tel.close()
+        from p2pmicrogrid_tpu.telemetry.report import latest_run_dir, render_run
+
+        assert latest_run_dir(str(tmp_path)) == tel.run_dir
+        text = render_run(tel.run_dir)
+        assert "manifest" in text
+        assert "BASIN ALERTS" in text and "10" in text
+        assert "device.comfort_violations" in text
+        assert "train_block" in text
+
+    def test_cli_telemetry_report(self, tmp_path, capsys):
+        tel = Telemetry.create("cli-test", root=str(tmp_path))
+        tel.event("health", episode=0, greedy_cost_eur=1.0,
+                  greedy_reward=-1.0, status="healthy")
+        tel.close()
+        from p2pmicrogrid_tpu.cli import main
+
+        assert main(["telemetry-report", tel.run_dir]) == 0
+        out = capsys.readouterr().out
+        assert tel.run_id in out and "health" in out
+        assert main(["telemetry-report", str(tmp_path / "nope")]) == 1

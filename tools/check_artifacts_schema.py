@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Validate benchmark captures and telemetry runs against their schemas.
+
+Silent format drift has already cost this repo real signal: BENCH_r05.json
+carried stray non-JSON fragments ("d!" tails) interleaved with metric lines,
+and nothing noticed until a reviewer read the raw capture. This checker makes
+the contracts executable:
+
+* Root ``BENCH_*.json`` (driver captures): a JSON object with ``n`` (int),
+  ``cmd`` (str), ``rc`` (int), ``tail`` (str) and ``parsed``; ``parsed``
+  must be a metric row. With ``--strict-tail`` (opt-in), noise interleaved
+  BETWEEN metric lines in the tail is also reported; the default skips that
+  check because pre-telemetry captures are historical — new captures go
+  through the guarded stdout sink and must pass strict.
+
+* Metric rows (``parsed``, and each line of ``artifacts/BENCH_*.jsonl``):
+  JSON objects with ``metric`` (str), ``value`` (number), ``unit`` (str)
+  and ``vs_baseline`` (number). Extra context keys are allowed.
+
+* Telemetry run directories (``artifacts/runs/<run_id>/``, the layout
+  documented in telemetry/registry.py): ``manifest.json`` must be an object
+  with ``run_id`` and ``created``; every non-empty ``metrics.jsonl`` line
+  must be a JSON object with numeric ``ts`` and string ``kind``;
+  ``summary.json`` (when present) must carry ``counters``/``gauges``/
+  ``histograms``/``spans`` objects; ``trace.json`` (when present) must be a
+  Chrome trace object with a ``traceEvents`` list.
+
+Exit status: 0 when everything validates, 1 with one problem per line on
+stderr otherwise. Stdlib-only — runs with the accelerator stack down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+METRIC_ROW_KEYS = {
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "vs_baseline": (int, float),
+}
+
+
+def check_metric_row(row, where: str, problems: list) -> None:
+    if not isinstance(row, dict):
+        problems.append(f"{where}: metric row is {type(row).__name__}, not object")
+        return
+    for key, typ in METRIC_ROW_KEYS.items():
+        if key not in row:
+            problems.append(f"{where}: metric row missing key {key!r}")
+        elif not isinstance(row[key], typ) or isinstance(row[key], bool):
+            problems.append(
+                f"{where}: metric row key {key!r} has type "
+                f"{type(row[key]).__name__}"
+            )
+
+
+def check_bench_capture(path: str, problems: list, strict_tail: bool) -> None:
+    where = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as err:
+        problems.append(f"{where}: unreadable ({err})")
+        return
+    if not isinstance(doc, dict):
+        problems.append(f"{where}: top level is {type(doc).__name__}, not object")
+        return
+    for key, typ in (("n", int), ("cmd", str), ("rc", int), ("tail", str)):
+        if key not in doc:
+            problems.append(f"{where}: missing key {key!r}")
+        elif not isinstance(doc[key], typ) or isinstance(doc[key], bool):
+            problems.append(f"{where}: key {key!r} has type {type(doc[key]).__name__}")
+    if "parsed" in doc and doc["parsed"] is not None:
+        check_metric_row(doc["parsed"], f"{where}:parsed", problems)
+    if strict_tail and isinstance(doc.get("tail"), str):
+        lines = [l for l in doc["tail"].splitlines() if l.strip()]
+        # Noise check only applies between/after metric lines: the capture
+        # window may open mid-line, so a leading fragment before the first
+        # JSON line is a truncation artifact, not emitted noise.
+        seen_metric = False
+        for i, line in enumerate(lines):
+            try:
+                json.loads(line)
+                seen_metric = True
+            except json.JSONDecodeError:
+                if seen_metric:
+                    problems.append(
+                        f"{where}: non-JSON noise in tail line {i}: {line[:60]!r}"
+                    )
+
+
+def check_metric_jsonl(path: str, problems: list) -> None:
+    where = os.path.relpath(path)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as err:
+        problems.append(f"{where}: unreadable ({err})")
+        return
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            problems.append(f"{where}:{i + 1}: not valid JSON: {line[:60]!r}")
+            continue
+        check_metric_row(row, f"{where}:{i + 1}", problems)
+
+
+def check_run_dir(run_dir: str, problems: list) -> None:
+    where = os.path.relpath(run_dir)
+    mpath = os.path.join(run_dir, "manifest.json")
+    if not os.path.exists(mpath):
+        problems.append(f"{where}: missing manifest.json")
+    else:
+        try:
+            with open(mpath) as f:
+                m = json.load(f)
+            if not isinstance(m, dict):
+                problems.append(f"{where}/manifest.json: not an object")
+            else:
+                for key in ("run_id", "created"):
+                    if key not in m:
+                        problems.append(f"{where}/manifest.json: missing {key!r}")
+        except (OSError, json.JSONDecodeError) as err:
+            problems.append(f"{where}/manifest.json: unreadable ({err})")
+    jpath = os.path.join(run_dir, "metrics.jsonl")
+    if os.path.exists(jpath):
+        with open(jpath) as f:
+            for i, line in enumerate(f):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    problems.append(
+                        f"{where}/metrics.jsonl:{i + 1}: not valid JSON"
+                    )
+                    continue
+                if not isinstance(rec, dict):
+                    problems.append(
+                        f"{where}/metrics.jsonl:{i + 1}: not an object"
+                    )
+                    continue
+                if not isinstance(rec.get("ts"), (int, float)):
+                    problems.append(
+                        f"{where}/metrics.jsonl:{i + 1}: missing numeric 'ts'"
+                    )
+                if not isinstance(rec.get("kind"), str):
+                    problems.append(
+                        f"{where}/metrics.jsonl:{i + 1}: missing string 'kind'"
+                    )
+    spath = os.path.join(run_dir, "summary.json")
+    if os.path.exists(spath):
+        try:
+            with open(spath) as f:
+                s = json.load(f)
+            for key in ("counters", "gauges", "histograms", "spans"):
+                if not isinstance(s.get(key), dict):
+                    problems.append(
+                        f"{where}/summary.json: {key!r} missing or not an object"
+                    )
+        except (OSError, json.JSONDecodeError) as err:
+            problems.append(f"{where}/summary.json: unreadable ({err})")
+    tpath = os.path.join(run_dir, "trace.json")
+    if os.path.exists(tpath):
+        try:
+            with open(tpath) as f:
+                t = json.load(f)
+            if not isinstance(t, dict) or not isinstance(
+                t.get("traceEvents"), list
+            ):
+                problems.append(
+                    f"{where}/trace.json: not a Chrome trace object "
+                    "(traceEvents list)"
+                )
+        except (OSError, json.JSONDecodeError) as err:
+            problems.append(f"{where}/trace.json: unreadable ({err})")
+
+
+def check_all(repo_root: str, strict_tail: bool = False) -> list:
+    """All problems found under ``repo_root`` (empty list = clean)."""
+    problems: list = []
+    for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json"))):
+        check_bench_capture(path, problems, strict_tail=strict_tail)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "BENCH_*.jsonl"))
+    ):
+        check_metric_jsonl(path, problems)
+    for run_dir in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "runs", "*"))
+    ):
+        if os.path.isdir(run_dir):
+            check_run_dir(run_dir, problems)
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--root",
+        default=os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."),
+        help="repo root to scan (default: the checkout containing this script)",
+    )
+    parser.add_argument(
+        "--strict-tail", action="store_true",
+        help="also flag non-JSON noise interleaved into BENCH capture tails "
+             "(new captures through the telemetry stdout sink must be clean; "
+             "pre-telemetry captures are historical and fail this)",
+    )
+    args = parser.parse_args(argv)
+    root = os.path.abspath(args.root)
+    problems = check_all(root, strict_tail=args.strict_tail)
+    for p in problems:
+        print(p, file=sys.stderr)
+    n_bench = len(glob.glob(os.path.join(root, "BENCH_*.json")))
+    n_runs = len(glob.glob(os.path.join(root, "artifacts", "runs", "*")))
+    print(
+        f"checked {n_bench} bench captures, {n_runs} telemetry runs: "
+        f"{len(problems)} problem(s)"
+    )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
