@@ -467,18 +467,13 @@ def ensure_backend() -> str:
 
 def _phase_timings(label: str) -> dict:
     """Most recent compile/execute span durations for ``label`` (recorded by
-    the measurement helpers), as bench-row fields."""
-    from p2pmicrogrid_tpu.telemetry import current
+    the measurement helpers), as bench-row fields. The logic lives in
+    ``telemetry.phase_timings`` so serve-bench's rows decompose phases the
+    same way; this wrapper keeps benchmarks.py's module imports numpy-only
+    (the backend-probe contract at the top of this file)."""
+    from p2pmicrogrid_tpu.telemetry import phase_timings
 
-    rec = current().spans
-    out = {}
-    c = rec.duration(f"compile:{label}")
-    e = rec.duration(f"execute:{label}")
-    if c is not None:
-        out["compile_s"] = round(c, 3)
-    if e is not None:
-        out["execute_s"] = round(e, 3)
-    return out
+    return phase_timings(label)
 
 
 def _device_unit(device: str) -> str:

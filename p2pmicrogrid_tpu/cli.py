@@ -20,7 +20,15 @@ Subcommands:
   telemetry-report
             render a telemetry run directory (artifacts/runs/<run_id>/ —
             manifest, metric events, device counters, spans) into a
-            human-readable summary
+            human-readable summary; --compare A B diffs two runs keyed by
+            their manifests' config_hash/git_rev
+  export-bundle
+            freeze a checkpoint's greedy parameters into a versioned
+            policy bundle for serving (serve/export.py)
+  serve-bench
+            drive the batched inference engine with an open-loop Poisson
+            request stream and print p50/p95/p99 latency, throughput and
+            padding-waste as one JSON object per line (serve/loadgen.py)
 """
 
 from __future__ import annotations
@@ -36,6 +44,13 @@ def _nonneg_int(value: str) -> int:
     i = int(value)
     if i < 0:
         raise argparse.ArgumentTypeError(f"must be >= 0, got {i}")
+    return i
+
+
+def _pow2_int(value: str) -> int:
+    i = int(value)
+    if i < 1 or i & (i - 1):
+        raise argparse.ArgumentTypeError(f"must be a power of two, got {i}")
     return i
 
 
@@ -1125,13 +1140,148 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_export_bundle(args) -> int:
+    """Freeze a checkpoint's greedy parameters into a serving bundle.
+
+    Locates the checkpoint exactly like ``eval`` does (plain, scenario,
+    shared, chunked and share-agents settings all resolve through
+    ``_restore_eval_state``), then writes the bundle via serve/export.py.
+    """
+    import os
+
+    import jax
+
+    from p2pmicrogrid_tpu.serve import export_policy_bundle
+
+    cfg = _build_cfg(args)
+    key = jax.random.PRNGKey(cfg.train.seed)
+    if (
+        cfg.train.implementation == "ddpg"
+        and getattr(args, "share_agents", False)
+        and getattr(args, "scenarios", 1) > 1
+        and getattr(args, "shared", False)
+    ):
+        # Export the BARE community-shared actor. _restore_eval_state would
+        # broadcast it onto per-agent stacks (what evaluation needs), but a
+        # bundle of A identical actor copies is A-fold larger and forces the
+        # engine onto the per-agent vmap path instead of the one flattened
+        # [B*A, 4] pass the shared branch serves with.
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_params_init
+        from p2pmicrogrid_tpu.train.checkpoint import (
+            checkpoint_dir,
+            restore_checkpoint,
+        )
+
+        setting = _scenario_setting(cfg, True, getattr(args, "chunks", 1))
+        ckpt_dir = checkpoint_dir(
+            args.model_dir, setting, cfg.train.implementation
+        )
+        pol_state, episode = restore_checkpoint(
+            ckpt_dir, ddpg_params_init(cfg.ddpg, cfg.sim.n_agents, key)
+        )
+    else:
+        pol_state, episode, ckpt_dir = _restore_eval_state(args, cfg, key)
+    print(f"restored {ckpt_dir} at episode {episode}")
+    out = args.out or os.path.join(
+        "bundles", f"{_persist_setting(args, cfg)}-{cfg.train.implementation}"
+    )
+    path = export_policy_bundle(
+        cfg,
+        pol_state,
+        out,
+        source={"checkpoint": os.path.abspath(ckpt_dir), "episode": episode},
+        dtype=args.dtype,
+    )
+    import json as _json
+
+    with open(os.path.join(path, "manifest.json")) as f:
+        m = _json.load(f)
+    print(
+        f"bundle -> {path} ({m['implementation']}, {m['param_count']} params, "
+        f"{m['param_bytes']} bytes, config {m['config_hash']})"
+    )
+    return 0
+
+
+def cmd_serve_bench(args) -> int:
+    """Open-loop serving benchmark against a policy bundle.
+
+    stdout carries strictly one JSON metric row per line (the same
+    fd-guarded telemetry sink as ``bench``); the LAST line is the headline
+    row with every stat. Without ``--bundle``, a fresh-init bundle for the
+    configured setting is exported to a temp dir first — the zero-to-SLO
+    smoke path on hosts with no trained checkpoint.
+    """
+    from p2pmicrogrid_tpu.serve import PolicyEngine, export_policy_bundle, serve_bench
+    from p2pmicrogrid_tpu.telemetry import (
+        Telemetry,
+        guarded_stdout_sink,
+        set_current,
+    )
+
+    cfg = _build_cfg(args)
+    with guarded_stdout_sink() as sink:
+        # EVERYTHING that may touch the JAX runtime runs inside the guard —
+        # including the fresh-init export — so C++ fd-1 noise cannot precede
+        # the metric rows (the BENCH_r05 interleaving failure mode).
+        bundle = args.bundle
+        if bundle is None:
+            import tempfile
+
+            import jax
+
+            from p2pmicrogrid_tpu.train import init_policy_state
+
+            tmp = tempfile.mkdtemp(prefix="p2p-bundle-")
+            ps = init_policy_state(cfg, jax.random.PRNGKey(cfg.train.seed))
+            bundle = export_policy_bundle(cfg, ps, tmp)
+            print(
+                f"serve-bench: no --bundle given; exported a fresh-init "
+                f"{cfg.train.implementation} bundle to {bundle}",
+                file=sys.stderr,
+                flush=True,
+            )
+        tel = Telemetry(run_id="serve-bench", sinks=[sink])
+        set_current(tel)
+        try:
+            engine = PolicyEngine(
+                bundle_dir=bundle, max_batch=args.max_batch, telemetry=tel
+            )
+            serve_bench(
+                engine,
+                rate_hz=args.rate,
+                n_requests=args.requests,
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1e3,
+                seed=args.bench_seed,
+                slo_ms=args.slo_ms,
+                emit=tel.emit,
+            )
+        finally:
+            set_current(None)
+    return 0
+
+
 def cmd_telemetry_report(args) -> int:
     """Render a telemetry run directory (see telemetry/registry.py for the
     layout) into a plain-text summary: manifest provenance, event counts,
     health trajectory, device-counter totals and span timings."""
     import os
 
-    from p2pmicrogrid_tpu.telemetry.report import latest_run_dir, render_run
+    from p2pmicrogrid_tpu.telemetry.report import (
+        compare_runs,
+        latest_run_dir,
+        render_run,
+    )
+
+    if getattr(args, "compare", None):
+        a, b = args.compare
+        for d in (a, b):
+            if not os.path.isdir(d):
+                print(f"not a telemetry run directory: {d}", file=sys.stderr)
+                return 1
+        print(compare_runs(a, b), end="")
+        return 0
 
     run_dir = args.run
     if run_dir is None:
@@ -1478,7 +1628,70 @@ def main(argv=None) -> int:
     p.add_argument("--runs-root", dest="runs_root",
                    help="root containing run directories (default "
                         "artifacts/runs, or $P2P_TELEMETRY_DIR)")
+    p.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                   help="diff two run directories' summaries side by side, "
+                        "keyed by their manifests' config_hash/git_rev")
     p.set_defaults(fn=cmd_telemetry_report)
+
+    p = sub.add_parser(
+        "export-bundle",
+        help="freeze a checkpoint's greedy parameters into a policy bundle "
+             "for serving (greedy params only — no optimizer/replay/target "
+             "state)",
+    )
+    _add_common(p)
+    p.add_argument("--scenarios", type=int, default=1,
+                   help="locate the checkpoint of a --scenarios N training run")
+    p.add_argument("--shared", action="store_true",
+                   help="the checkpoint came from --shared training")
+    p.add_argument("--chunks", type=int, default=1,
+                   help="the checkpoint came from --chunks K training")
+    p.add_argument("--share-agents", action="store_true", dest="share_agents",
+                   help="the checkpoint came from --share-agents training")
+    p.add_argument("--scenario-index", type=int, default=0,
+                   dest="scenario_index",
+                   help="which learner to export from an independent-mode "
+                        "scenario checkpoint")
+    p.add_argument("--out",
+                   help="bundle output directory (default "
+                        "bundles/<setting>-<implementation>)")
+    p.add_argument("--dtype", choices=["float32", "float16"],
+                   default="float32",
+                   help="on-disk dtype for floating parameter leaves "
+                        "(float16 halves the bundle; the engine computes in "
+                        "float32 either way)")
+    p.set_defaults(fn=cmd_export_bundle)
+
+    p = sub.add_parser(
+        "serve-bench",
+        help="open-loop Poisson load against the batched inference engine; "
+             "prints p50/p95/p99 latency, throughput and padding-waste as "
+             "one JSON object per line",
+    )
+    _add_common(p)
+    p.add_argument("--bundle",
+                   help="policy bundle directory (export-bundle output); "
+                        "omitted: export a fresh-init bundle for the "
+                        "configured setting to a temp dir and bench that")
+    p.add_argument("--rate", type=float, default=256.0,
+                   help="offered request rate, requests/sec (default 256)")
+    p.add_argument("--requests", type=int, default=2048,
+                   help="total requests to generate (default 2048)")
+    p.add_argument("--max-batch", type=_pow2_int, default=64, dest="max_batch",
+                   help="microbatch coalescing cap; must be a power of two "
+                        "(default 64)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   dest="max_wait_ms",
+                   help="max time the oldest queued request waits for "
+                        "coalescing, ms (default 2)")
+    p.add_argument("--slo-ms", type=float, default=100.0, dest="slo_ms",
+                   help="latency SLO budget the vs_baseline headroom is "
+                        "reported against, ms (default 100)")
+    p.add_argument("--bench-seed", type=int, default=0, dest="bench_seed",
+                   help="seed for the Poisson arrivals and synthetic "
+                        "observations (default 0; --seed stays the model "
+                        "config seed)")
+    p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser("analyse", help="statistics + figures from a results DB")
     p.add_argument("--results-db", required=True)

@@ -198,7 +198,12 @@ def _run_episode_loop(
     start = _time.time()
     for e in range(n_episodes):
         key, k = jax.random.split(key)
-        carry, (r, l) = episode_fn(carry, k)
+        # A collect_device_metrics episode_fn appends a DeviceCounters
+        # element; this loop records rewards/losses either way (callers
+        # wanting the counters drive the episode_fn themselves or go through
+        # the chunked trainer's telemetry path).
+        carry, ys = episode_fn(carry, k)
+        r, l = ys[0], ys[1]
         if decay_every and (episode0 + e) % decay_every == 0:
             carry = _decay_carry(policy, carry)
         r, l = np.asarray(r), np.asarray(l)
@@ -711,6 +716,7 @@ def make_shared_episode_fn(
     record_only: bool = False,
     arrays_fn: Optional[Callable] = None,
     n_scenarios: Optional[int] = None,
+    collect_device_metrics: bool = False,
 ) -> Callable:
     """Jitted: one shared-parameter training episode over S scenarios.
 
@@ -719,6 +725,15 @@ def make_shared_episode_fn(
     ``LockstepReplay`` for dqn, a ``DDPGScenState`` for ddpg (build all three
     with ``init_shared_state``). ``settlement_hook`` is forwarded to
     ``slot_dynamics_batched`` (inter-community trading).
+
+    ``collect_device_metrics`` threads a ``telemetry.DeviceCounters`` total
+    through the TRAINING slot scan — the same in-program NaN/comfort/market
+    counters the greedy health eval collects, now for the episodes that
+    actually move the parameters (ROADMAP open item: the chunked trainer's
+    training episodes were blind between health evals). The per-slot learn
+    loss feeds the ``nonfinite_loss`` counter, so a NaN blowing up the
+    critic is visible the episode it happens. The ys tuple gains a third
+    element: (rewards [S], losses [S], counters).
 
     Episode inputs come from ``arrays_s`` (fixed host-built arrays), or —
     when ``arrays_fn(key) -> EpisodeArrays`` is given instead (with
@@ -749,6 +764,12 @@ def make_shared_episode_fn(
     # lr-independent so only this training closure needs the scaled config.
     cfg = auto_scale_ddpg_lrs(cfg, n_scenarios)
     ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    if collect_device_metrics:
+        from p2pmicrogrid_tpu.telemetry.device_metrics import (
+            dc_add,
+            dc_from_slot,
+            dc_zero,
+        )
 
     if impl == "ddpg":
         # OU noise is per-scenario state threaded through every negotiation
@@ -758,7 +779,7 @@ def make_shared_episode_fn(
             return frac, frac, q, ou_s
 
     def slot(carry, xs_t):
-        phys_s, pol_state, scen_state, key = carry
+        (phys_s, pol_state, scen_state, key), dc = carry
         key, k_act, k_learn = jax.random.split(key, 3)
 
         act_fn = ddpg_act_fn if impl == "ddpg" else None
@@ -787,7 +808,11 @@ def make_shared_episode_fn(
             pol_state, scen_state, loss = _ddpg_update_shared(
                 cfg, pol_state, scen_state, tr_s, k_learn
             )
-        return (phys_s, pol_state, scen_state, key), (
+        if collect_device_metrics:
+            # The learn step's loss overrides the zeroed outputs.loss so
+            # nonfinite_loss counts the REAL per-slot training loss.
+            dc = dc_add(dc, dc_from_slot(cfg, outputs_s, loss=loss))
+        return ((phys_s, pol_state, scen_state, key), dc), (
             jnp.mean(outputs_s.reward, axis=-1),
             loss,
         )
@@ -810,14 +835,15 @@ def make_shared_episode_fn(
             xs.next_load_w,
             xs.next_pv_w,
         )
-        (phys_s, pol_state, scen_state, _), (rewards, losses) = jax.lax.scan(
-            slot, (phys_s, pol_state, scen_state, k_scan), xs,
+        dc0 = dc_zero() if collect_device_metrics else None
+        ((phys_s, pol_state, scen_state, _), dc), (rewards, losses) = jax.lax.scan(
+            slot, ((phys_s, pol_state, scen_state, k_scan), dc0), xs,
             unroll=cfg.sim.slot_unroll,
         )
-        return (pol_state, scen_state), (
-            jnp.sum(rewards, axis=0),
-            jnp.mean(losses, axis=0),
-        )
+        ys = (jnp.sum(rewards, axis=0), jnp.mean(losses, axis=0))
+        if collect_device_metrics:
+            ys = ys + (dc,)
+        return (pol_state, scen_state), ys
 
     return episode
 
@@ -895,6 +921,7 @@ def make_chunked_episode_runner(
     n_chunks: int,
     warmup_fn: Optional[Callable] = None,
     chunk_parallel: int = 1,
+    collect_device_metrics: bool = False,
 ) -> Callable:
     """The jitted K-chunk episode: ONE device call — a ``lax.scan`` over
     chunk keys whose body runs the chunk episode from θ₀ and accumulates its
@@ -914,6 +941,13 @@ def make_chunked_episode_runner(
     rewards [K*S], losses [K*S])``. Built once and reused across
     ``train_scenarios_chunked`` calls (each call would otherwise create a
     fresh jit wrapper and recompile).
+
+    ``collect_device_metrics`` requires an episode_fn built with the same
+    flag: the runner then accumulates every chunk's in-scan
+    ``DeviceCounters`` on device and measures each chunk's final replay
+    fill fraction (``telemetry.replay_fill_fraction`` — the replay-
+    saturation gauge), returning ``(theta', rewards, losses, counters,
+    fills [K])`` instead of the 3-tuple.
 
     ``chunk_parallel`` (C, must divide K) runs C chunks side by side through
     a ``vmap`` of the episode program — the outer scan covers K/C groups.
@@ -935,10 +969,17 @@ def make_chunked_episode_runner(
         raise ValueError(
             f"chunk_parallel={C} must be >=1 and divide n_chunks={n_chunks}"
         )
+    if collect_device_metrics:
+        from p2pmicrogrid_tpu.telemetry.device_metrics import (
+            dc_add,
+            dc_zero,
+            replay_fill_fraction,
+        )
 
     def _one_chunk(theta0, kc):
         """Chunk body (C=1 semantics): fresh scen state, optional dqn
-        replay warmup, one episode from theta0. Returns (theta_c, r, l)."""
+        replay warmup, one episode from theta0. Returns (theta_c, r, l)
+        plus (counters, replay fill) when collecting."""
         k_scen, k_ep = jax.random.split(kc)
         scen = init_scen_state_only(cfg, k_scen)
         if warmup_fn is not None and cfg.dqn.warmup_passes > 0:
@@ -951,46 +992,75 @@ def make_chunked_episode_runner(
             # record_only leaves theta untouched; only scen (replay) fills.
             (_, scen), _ = jax.lax.scan(warm, (theta0, scen), k_warm[:-1])
             k_ep = k_warm[-1]
-        (theta_c, _), (r, l) = episode_fn((theta0, scen), k_ep)
-        return theta_c, r, l
+        (theta_c, scen), ys = episode_fn((theta0, scen), k_ep)
+        r, l = ys[0], ys[1]
+        if not collect_device_metrics:
+            return theta_c, r, l
+        # The chunk's scen state dies here — measure its replay saturation
+        # before it does (tabular has no replay: report a 0 gauge).
+        fill = replay_fill_fraction(scen)
+        fill = jnp.zeros(()) if fill is None else fill
+        return theta_c, r, l, ys[2], fill
 
     @jax.jit
     def run_chunks(theta0, chunk_keys):
+        dc_tot = dc_zero() if collect_device_metrics else None
         if C == 1:
 
-            def body(acc, kc):
-                theta_c, r, l = _one_chunk(theta0, kc)
+            def body(carry, kc):
+                acc, dc_tot = carry
+                out = _one_chunk(theta0, kc)
+                theta_c, r, l = out[:3]
                 acc = jax.tree_util.tree_map(
                     lambda a, n, o: a + (n - o), acc, theta_c, theta0
                 )
-                return acc, (r, l)
+                ys = (r, l)
+                if collect_device_metrics:
+                    dc_tot = dc_add(dc_tot, out[3])
+                    ys = ys + (out[4],)
+                return (acc, dc_tot), ys
 
             acc0 = jax.tree_util.tree_map(jnp.zeros_like, theta0)
-            acc, (rs, ls) = jax.lax.scan(body, acc0, chunk_keys)
+            (acc, dc_tot), ys = jax.lax.scan(body, (acc0, dc_tot), chunk_keys)
+            rs, ls = ys[0], ys[1]
+            fills = ys[2] if collect_device_metrics else None  # [K]
         else:
             grouped = chunk_keys.reshape(
                 (n_chunks // C, C) + chunk_keys.shape[1:]
             )
 
-            def body(acc, kcs):  # kcs [C, ...]: one group of C chunk keys
-                theta_cs, r, l = jax.vmap(
-                    lambda kc: _one_chunk(theta0, kc)
-                )(kcs)
+            def body(carry, kcs):  # kcs [C, ...]: one group of C chunk keys
+                acc, dc_tot = carry
+                out = jax.vmap(lambda kc: _one_chunk(theta0, kc))(kcs)
+                theta_cs, r, l = out[:3]
                 acc = jax.tree_util.tree_map(
                     lambda a, n, o: a + jnp.sum(n - o[None], axis=0),
                     acc, theta_cs, theta0,
                 )
-                return acc, (r, l)
+                ys = (r, l)
+                if collect_device_metrics:
+                    # Sum the C vmapped chunks' counters into the total.
+                    dc_tot = dc_add(
+                        dc_tot,
+                        jax.tree_util.tree_map(
+                            lambda x: jnp.sum(x, axis=0), out[3]
+                        ),
+                    )
+                    ys = ys + (out[4],)
+                return (acc, dc_tot), ys
 
             acc0 = jax.tree_util.tree_map(jnp.zeros_like, theta0)
-            acc, (rs, ls) = jax.lax.scan(body, acc0, grouped)
+            (acc, dc_tot), ys = jax.lax.scan(body, (acc0, dc_tot), grouped)
             # [K/C, C, S] -> [K, S]: group-major flatten matches the C=1
             # chunk order (chunk i = group i//C, lane i%C).
-            rs = rs.reshape((-1,) + rs.shape[2:])
-            ls = ls.reshape((-1,) + ls.shape[2:])
+            rs = ys[0].reshape((-1,) + ys[0].shape[2:])
+            ls = ys[1].reshape((-1,) + ys[1].shape[2:])
+            fills = ys[2].reshape(-1) if collect_device_metrics else None
         new = jax.tree_util.tree_map(
             lambda b, a: (b + a / n_chunks).astype(b.dtype), theta0, acc
         )
+        if collect_device_metrics:
+            return new, rs.reshape(-1), ls.reshape(-1), dc_tot, fills
         return new, rs.reshape(-1), ls.reshape(-1)  # chunk-major [K*S]
 
     return run_chunks
@@ -1011,6 +1081,7 @@ def train_scenarios_chunked(
     runner: Optional[Callable] = None,
     scenario_sharding=None,
     chunk_parallel: int = 1,
+    telemetry=None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
     Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
@@ -1036,6 +1107,14 @@ def train_scenarios_chunked(
     Returns (pol_state, rewards [episodes, K*S], losses [episodes, K*S],
     seconds). ``chunk_key_fn(key, episode, chunk) -> key`` overrides the
     per-chunk seeding (tests use it to collapse chunks onto one draw).
+    ``telemetry`` (a ``telemetry.Telemetry``) turns on in-scan device
+    counters for the TRAINING episodes: the default episode program collects
+    NaN/comfort/market totals plus each chunk's replay fill fraction, and
+    every episode emits a ``device_counters`` event (``phase: "train"``) and
+    a ``replay.fill_fraction`` gauge. A caller-prebuilt ``episode_fn`` or
+    ``runner`` must have been built with ``collect_device_metrics=True``
+    itself for the emission to happen (a 5-output runner without a telemetry
+    drops the counters silently — pass both or neither).
     ``chunk_parallel=C`` (C | K) executes C chunks per scan step through a
     vmapped episode program — same per-chunk keys/trajectories and the same
     K-delta mean, wider device program (see ``make_chunked_episode_runner``);
@@ -1061,6 +1140,12 @@ def train_scenarios_chunked(
             "sharding constraints (device_episode_arrays(scenario_sharding=))"
         )
     warmup_fn = None
+    # Collection is only switched on for the DEFAULT-built episode program:
+    # a caller-prebuilt episode_fn fixes its own output arity, and building
+    # a collecting runner over a non-collecting episode_fn would crash at
+    # trace time (prebuilt collecting callers still get their counters
+    # emitted — the loop below keys on the runner's output arity).
+    collect = telemetry is not None and episode_fn is None
     if episode_fn is None:
         from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
 
@@ -1071,7 +1156,8 @@ def train_scenarios_chunked(
             cfg, k, ratings, S, scenario_sharding=scenario_sharding
         )
         episode_fn = make_shared_episode_fn(
-            cfg, policy, None, ratings, arrays_fn=arrays_fn, n_scenarios=S
+            cfg, policy, None, ratings, arrays_fn=arrays_fn, n_scenarios=S,
+            collect_device_metrics=collect,
         )
         if cfg.train.implementation == "dqn" and cfg.dqn.warmup_passes > 0:
             # Per-chunk replay warmup (see make_chunked_episode_runner): a
@@ -1090,7 +1176,7 @@ def train_scenarios_chunked(
     if runner is None:
         runner = make_chunked_episode_runner(
             cfg, episode_fn, n_chunks, warmup_fn=warmup_fn,
-            chunk_parallel=chunk_parallel,
+            chunk_parallel=chunk_parallel, collect_device_metrics=collect,
         )
     run_chunks = runner
 
@@ -1101,7 +1187,22 @@ def train_scenarios_chunked(
         chunk_keys = jnp.stack(
             [chunk_key_fn(key, episode0 + e, c) for c in range(n_chunks)]
         )
-        pol_state, r, l = run_chunks(pol_state, chunk_keys)
+        out = run_chunks(pol_state, chunk_keys)
+        pol_state, r, l = out[:3]
+        if len(out) > 3 and telemetry is not None:
+            from p2pmicrogrid_tpu.telemetry.device_metrics import dc_to_dict
+
+            dcd = dc_to_dict(out[3])
+            # One gauge per episode: chunks train the same slot count from
+            # fresh replays, so per-chunk fills agree — the mean is the
+            # per-episode saturation (ROADMAP replay-saturation item).
+            fill = float(np.asarray(out[4]).mean())
+            telemetry.record_device_counters(dcd)
+            telemetry.gauge("replay.fill_fraction", fill)
+            telemetry.event(
+                "device_counters", episode=episode0 + e, phase="train",
+                replay_fill_fraction=round(fill, 4), **dcd,
+            )
         if decay_every and (episode0 + e) % decay_every == 0:
             pol_state = policy.decay(pol_state)
         r, l = np.asarray(r), np.asarray(l)

@@ -1,0 +1,46 @@
+"""Serving layer: exported policy bundles + batched TPU inference engine.
+
+Training produces a learner-state checkpoint (optimizers, replay rings,
+target copies — everything resume needs); serving needs none of that. This
+package is the deployment half of the paper's decision loop — each
+15-minute slot every household needs a greedy heat-pump action from the
+trained policy given its observation:
+
+* ``export``   freeze a checkpoint's GREEDY parameters into a versioned
+               on-disk policy bundle (manifest + npz).
+* ``engine``   load a bundle and serve ``act(obs_batch)`` through
+               power-of-two padding buckets of pre-compiled programs, with
+               stateful per-household sessions and a microbatching queue.
+* ``loadgen``  open-loop Poisson request streams + latency/throughput/
+               padding-waste reporting (the ``serve-bench`` CLI command).
+"""
+
+from p2pmicrogrid_tpu.serve.engine import (
+    MicroBatchQueue,
+    PolicyEngine,
+    Sessions,
+)
+from p2pmicrogrid_tpu.serve.export import (
+    BUNDLE_FORMAT_VERSION,
+    export_bundle_from_checkpoint,
+    export_policy_bundle,
+    load_policy_bundle,
+)
+from p2pmicrogrid_tpu.serve.loadgen import (
+    plan_open_loop,
+    poisson_arrivals,
+    serve_bench,
+)
+
+__all__ = [
+    "BUNDLE_FORMAT_VERSION",
+    "MicroBatchQueue",
+    "PolicyEngine",
+    "Sessions",
+    "export_bundle_from_checkpoint",
+    "export_policy_bundle",
+    "load_policy_bundle",
+    "plan_open_loop",
+    "poisson_arrivals",
+    "serve_bench",
+]

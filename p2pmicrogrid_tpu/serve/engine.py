@@ -1,0 +1,399 @@
+"""Batched greedy inference engine over a policy bundle.
+
+The serving hot path of the paper's decision loop: every 15-minute slot,
+every household (grouped per community) needs a greedy heat-pump action from
+the trained policy given its observation. Requests arrive as community
+observation rows ``[A, 4]``; the engine coalesces them into batches
+``[B, A, 4]`` and answers with heat-pump fractions ``[B, A]``.
+
+Design points:
+
+* **Padding buckets.** ``jax.jit`` compiles one program per input shape, so
+  arbitrary request-batch sizes would compile unboundedly many programs and
+  stall tail requests behind compiles. The engine rounds every batch up to
+  the next power of two (capped at ``max_batch``), so ALL traffic hits a
+  small fixed set of pre-compiled programs; ``warmup()`` compiles them ahead
+  of the first request. The pad rows are wasted compute — the engine counts
+  them (``padding_waste``) and serve-bench reports the fraction.
+
+* **Bit-exact greedy.** The per-implementation forward passes below are the
+  SAME computations as the training-side greedy paths (``tabular_act`` /
+  ``dqn_act`` with ``explore=False``; the actor half of ``ddpg_shared_act``),
+  so a bundle serves byte-identical actions to the checkpoint it came from —
+  enforced by tests/test_serve.py. One honest caveat: XLA fuses and tiles
+  the MLP math differently per program and per shape, so raw network
+  outputs can move by ~1 ulp vs the training-side call. The DISCRETE
+  policies (tabular, DQN) serve BIT-IDENTICAL actions regardless — a table
+  gather is exact and an argmax only flips on an exact tie; the continuous
+  DDPG actor is deterministic per bucket and matches the training greedy
+  act to ~1e-7 relative. Both guarantees assume the default float32 export:
+  a ``dtype="float16"`` bundle quantizes the parameters themselves (see
+  serve/export.py).
+
+* **Sessions.** ``init_sessions``/``step`` carry per-household cross-slot
+  state (previous served action — the env's round-0 ``hp_frac`` carry — and
+  a served-slot counter) through a donated-buffer jitted step, so a
+  controller loop holds one live array instead of re-shipping state. The
+  shipped greedy policies are feedforward (actions depend on the observation
+  only); the session carry is the contract a recurrent policy (e.g.
+  models/ddpg_recurrent.py) would extend with its hidden state.
+
+* **Microbatching.** ``MicroBatchQueue`` fronts the engine for concurrent
+  callers: single-community requests coalesce until ``max_batch`` or
+  ``max_wait_s``, then execute as one padded batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class Sessions(NamedTuple):
+    """Per-community serving sessions (leaves [N, ...])."""
+
+    hp_frac: object  # [N, A] last served action fraction
+    slots: object    # [N] int32 slots served
+
+
+def _bucket_sizes(max_batch: int) -> list:
+    sizes, b = [], 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return sizes
+
+
+class PolicyEngine:
+    """Loads a policy bundle and serves batched greedy actions.
+
+    ``act(obs)`` with obs ``[B, A, 4]`` returns hp fractions ``[B, A]``;
+    batches larger than ``max_batch`` are split, smaller ones padded up to
+    the next power-of-two bucket. ``telemetry`` (a ``telemetry.Telemetry``)
+    receives ``serve.*`` counters and per-batch latency histograms.
+    """
+
+    def __init__(
+        self,
+        bundle_dir: Optional[str] = None,
+        manifest: Optional[dict] = None,
+        params: Optional[dict] = None,
+        max_batch: int = 256,
+        telemetry=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        if bundle_dir is not None:
+            from p2pmicrogrid_tpu.serve.export import load_policy_bundle
+
+            manifest, params = load_policy_bundle(bundle_dir)
+        if manifest is None or params is None:
+            raise ValueError("pass bundle_dir, or both manifest and params")
+        if max_batch < 1 or max_batch & (max_batch - 1):
+            raise ValueError(f"max_batch must be a power of two, got {max_batch}")
+        self.manifest = manifest
+        self.max_batch = max_batch
+        self.telemetry = telemetry
+        self.n_agents = int(manifest["n_agents"])
+        self._impl = manifest["implementation"]
+        # Serving computes in float32 regardless of the on-disk dtype: a
+        # float16 bundle halves storage/transfer, not arithmetic precision.
+        self.params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(
+                x, jnp.float32 if np.issubdtype(x.dtype, np.floating) else None
+            ),
+            params,
+        )
+        self._act_raw = self._build_act_fn()
+        # One jitted callable; XLA caches one executable per bucket shape.
+        self._act_jit = jax.jit(self._act_raw)
+        self._step_jit = jax.jit(self._step_fn, donate_argnums=(1,))
+        self.stats = {"batches": 0, "rows": 0, "padded_rows": 0}
+
+    # --- greedy forward passes (mirror the training greedy paths) -----------
+
+    def _build_act_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        impl = self._impl
+        model = self.manifest["model"]
+        if impl == "tabular":
+            from p2pmicrogrid_tpu.config import QLearningConfig
+            from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES
+            from p2pmicrogrid_tpu.models.tabular import TabularState, tabular_act
+
+            qcfg = QLearningConfig(**model["qlearning"])
+            key0 = jax.random.PRNGKey(0)  # unused on the explore=False path
+
+            def act(params, obs):  # [B, A, 4] -> [B, A]
+                state = TabularState(
+                    q_table=params["q_table"], epsilon=jnp.zeros(())
+                )
+
+                def one(o):
+                    action, _ = tabular_act(qcfg, state, o, key0, explore=False)
+                    return ACTION_VALUES[action]
+
+                return jax.vmap(one)(obs)
+
+            return act
+
+        if impl == "dqn":
+            from p2pmicrogrid_tpu.config import DQNConfig
+            from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES, _q_all_actions
+
+            dcfg = DQNConfig(hidden=model["hidden"])
+
+            def act(params, obs):
+                def one(o):
+                    q = _q_all_actions(dcfg, params, o)
+                    return ACTION_VALUES[jnp.argmax(q, axis=-1).astype(jnp.int32)]
+
+                return jax.vmap(one)(obs)
+
+            return act
+
+        if impl == "ddpg":
+            from p2pmicrogrid_tpu.models.networks import Actor
+
+            actor = Actor(hidden=model["actor_hidden"])
+            if model["share_across_agents"]:
+
+                def act(params, obs):
+                    B, A, F = obs.shape
+                    flat = obs.reshape(B * A, F)
+                    return actor.apply({"params": params}, flat)[:, 0].reshape(B, A)
+
+            else:
+
+                def act(params, obs):
+                    def one_agent(pa, o):  # o [B, 4]
+                        return actor.apply({"params": pa}, o)[:, 0]
+
+                    return jax.vmap(one_agent, in_axes=(0, 1), out_axes=1)(
+                        params, obs
+                    )
+
+            return act
+
+        raise ValueError(f"bundle has unknown implementation {self._impl!r}")
+
+    # --- batched act --------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest power-of-two bucket >= n (capped at max_batch)."""
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch)
+
+    @property
+    def buckets(self) -> list:
+        return _bucket_sizes(self.max_batch)
+
+    def warmup(self, buckets=None, include_step: bool = True) -> list:
+        """Pre-compile the bucket programs; returns the bucket sizes
+        compiled. Without this, the first request of each size pays its
+        compile inside its latency. ``include_step`` also compiles the
+        session-step executable per bucket (a separate XLA program) — a
+        controller loop's first ``step()`` must not compile in-slot;
+        act-only callers (serve-bench) pass False and skip that cost."""
+        import jax
+
+        warmed = []
+        for b in buckets if buckets is not None else self.buckets:
+            obs = np.zeros((b, self.n_agents, 4), dtype=np.float32)
+            jax.block_until_ready(self._act_jit(self.params, obs))
+            if include_step:
+                jax.block_until_ready(
+                    self._step_jit(self.params, self.init_sessions(b), obs)[1]
+                )
+            warmed.append(b)
+        return warmed
+
+    def _check_obs(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, dtype=np.float32)
+        if obs.ndim != 3 or obs.shape[1:] != (self.n_agents, 4):
+            raise ValueError(
+                f"obs must be [B, {self.n_agents}, 4] for this bundle "
+                f"(setting {self.manifest.get('setting')!r}), got {obs.shape}"
+            )
+        return obs
+
+    def act(self, obs) -> np.ndarray:
+        """Greedy actions for a batch of community observations.
+
+        obs [B, A, 4] -> hp fraction [B, A]. B may exceed ``max_batch``
+        (the batch is split); sub-bucket batches are zero-padded and the pad
+        rows discarded.
+        """
+        obs = self._check_obs(obs)
+        if obs.shape[0] == 0:
+            return np.zeros((0, self.n_agents), dtype=np.float32)
+        outs = []
+        for i in range(0, obs.shape[0], self.max_batch):
+            outs.append(self._act_one_batch(obs[i : i + self.max_batch]))
+        return np.concatenate(outs, axis=0)
+
+    def _act_one_batch(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+
+        b = obs.shape[0]
+        bucket = self.bucket_for(b)
+        if bucket > b:
+            pad = np.zeros((bucket - b,) + obs.shape[1:], dtype=obs.dtype)
+            obs = np.concatenate([obs, pad], axis=0)
+        t0 = time.perf_counter()
+        out = self._act_jit(self.params, obs)
+        jax.block_until_ready(out)
+        secs = time.perf_counter() - t0
+        self.stats["rows"] += b
+        self.stats["batches"] += 1
+        self.stats["padded_rows"] += bucket - b
+        if self.telemetry is not None:
+            self.telemetry.counter("serve.requests", b)
+            self.telemetry.counter("serve.batches")
+            self.telemetry.counter("serve.padded_rows", bucket - b)
+            self.telemetry.histogram("serve.batch_ms", secs * 1e3)
+        return np.asarray(out[:b])
+
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of computed rows that were padding, lifetime."""
+        total = self.stats["rows"] + self.stats["padded_rows"]
+        return self.stats["padded_rows"] / total if total else 0.0
+
+    # --- stateful per-community sessions ------------------------------------
+
+    def _step_fn(self, params, sessions: Sessions, obs):
+        import jax.numpy as jnp
+
+        hp = self._act_raw(params, obs)
+        return Sessions(hp_frac=hp, slots=sessions.slots + jnp.int32(1)), hp
+
+    def init_sessions(self, n: int) -> Sessions:
+        import jax.numpy as jnp
+
+        return Sessions(
+            hp_frac=jnp.zeros((n, self.n_agents), jnp.float32),
+            slots=jnp.zeros((n,), jnp.int32),
+        )
+
+    def step(self, sessions: Sessions, obs):
+        """Advance ``n`` sessions one slot: act on obs [n, A, 4], record the
+        served action as each session's new ``hp_frac``, bump slot counters.
+
+        The jitted step donates the (padded) session buffers — the previous
+        slot's state is consumed in place, not copied. Returns
+        (sessions', hp_frac [n, A]).
+        """
+        import jax.numpy as jnp
+
+        obs = self._check_obs(obs)
+        n = obs.shape[0]
+        if int(sessions.slots.shape[0]) != n:
+            raise ValueError(
+                f"{n} obs rows for {int(sessions.slots.shape[0])} sessions"
+            )
+        bucket = self.bucket_for(n)
+        if n > self.max_batch:
+            raise ValueError(
+                f"sessions batch {n} exceeds max_batch {self.max_batch}"
+            )
+        if bucket > n:
+            pad = bucket - n
+            obs = np.concatenate(
+                [obs, np.zeros((pad,) + obs.shape[1:], obs.dtype)], axis=0
+            )
+            sessions = Sessions(
+                hp_frac=jnp.concatenate(
+                    [sessions.hp_frac,
+                     jnp.zeros((pad, self.n_agents), jnp.float32)], axis=0
+                ),
+                slots=jnp.concatenate(
+                    [sessions.slots, jnp.zeros((pad,), jnp.int32)], axis=0
+                ),
+            )
+        new, hp = self._step_jit(self.params, sessions, obs)
+        new = Sessions(hp_frac=new.hp_frac[:n], slots=new.slots[:n])
+        return new, np.asarray(hp[:n])
+
+
+class MicroBatchQueue:
+    """Coalescing front for concurrent single-community callers.
+
+    ``submit(obs_row [A, 4])`` returns a ``Future`` resolving to the
+    household actions ``[A]``. Waiting requests are dispatched as ONE
+    padded engine batch when either ``max_batch`` have queued or the oldest
+    has waited ``max_wait_s`` (the same knobs serve-bench's open-loop
+    planner models on a virtual clock).
+    """
+
+    def __init__(self, engine: PolicyEngine, max_batch=None, max_wait_s=0.002):
+        self.engine = engine
+        self.max_batch = min(max_batch or engine.max_batch, engine.max_batch)
+        self.max_wait_s = max_wait_s
+        self._pending: list = []  # (obs_row, Future)
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def submit(self, obs_row) -> Future:
+        obs_row = np.asarray(obs_row, dtype=np.float32)
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            self._pending.append((obs_row, fut, time.monotonic()))
+            self._cv.notify()
+        return fut
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending and self._closed:
+                    return
+                # Window anchored at the OLDEST request's enqueue time, not
+                # this wake: a backlog that piled up while the engine was
+                # busy has already out-waited the window and dispatches
+                # immediately — the dispatch model plan_open_loop replays.
+                deadline = self._pending[0][2] + self.max_wait_s
+                while len(self._pending) < self.max_batch and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                batch = self._pending[: self.max_batch]
+                del self._pending[: self.max_batch]
+            try:
+                out = self.engine.act(np.stack([row for row, _, _ in batch]))
+                for i, (_, fut, _) in enumerate(batch):
+                    fut.set_result(np.asarray(out[i]))
+            except Exception as err:  # noqa: BLE001 — fail the waiters, not the loop
+                for _, fut, _ in batch:
+                    if not fut.done():
+                        fut.set_exception(err)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MicroBatchQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

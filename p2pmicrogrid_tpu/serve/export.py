@@ -1,0 +1,263 @@
+"""Policy bundles: frozen, versioned greedy-parameter exports for serving.
+
+A training checkpoint (train/checkpoint.py) is the WHOLE learner state —
+optimizers, replay rings, target copies, exploration schedules — because
+resume needs all of it. Serving needs none of it: the greedy decision path
+of every implementation reads exactly one parameter subtree (the Q-table,
+the online Q-network, the deterministic actor). A *policy bundle* is that
+subtree alone, frozen to disk next to a manifest that pins provenance
+(config hash, git rev, implementation) and the serving contract (obs/action
+spec, community size), so an engine can refuse mismatched inputs instead of
+silently mis-serving.
+
+Layout of a bundle directory::
+
+    <dir>/manifest.json   kind="policy_bundle", format_version, provenance,
+                          obs/action spec, model arch fields
+    <dir>/params.npz      flat '/'-joined tree paths -> arrays
+
+Size matters at the north star: a 1000-agent DDPG checkpoint carries actor +
+critic + 2 targets + 2 Adam states + replay (~6x the actor alone before
+replay); the bundle is the actor subtree, optionally dtype-cast (float16
+halves it again). ``tools/check_artifacts_schema.py`` validates manifests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+BUNDLE_FORMAT_VERSION = 1
+MANIFEST_FILE = "manifest.json"
+PARAMS_FILE = "params.npz"
+
+# The one parameter subtree each implementation's GREEDY path reads
+# (tabular_act -> q_table; dqn_act -> online; ddpg greedy -> actor).
+GREEDY_FIELD = {"tabular": "q_table", "dqn": "online", "ddpg": "actor"}
+
+# On-disk dtypes for floating leaves. bfloat16 is deliberately absent: numpy
+# cannot persist it natively and a bit-punned encoding would make bundles
+# unreadable without this codebase — float16 is the compact option.
+EXPORT_DTYPES = ("float32", "float16")
+
+OBS_SPEC = {
+    "dim": 4,
+    "features": ["time_norm", "norm_temp", "norm_balance", "p2p_mean"],
+}
+
+
+def _path_key(entry) -> str:
+    from jax.tree_util import DictKey, GetAttrKey, SequenceKey
+
+    if isinstance(entry, DictKey):
+        return str(entry.key)
+    if isinstance(entry, GetAttrKey):
+        return entry.name
+    if isinstance(entry, SequenceKey):
+        return str(entry.idx)
+    return str(entry)
+
+
+def _flatten_tree(tree) -> dict:
+    """'/'-joined path -> np.ndarray for every leaf of a params pytree."""
+    import jax
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(_path_key(p) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten_tree(flat: dict) -> dict:
+    """Inverse of ``_flatten_tree`` into plain nested dicts (what
+    ``flax.linen.Module.apply`` accepts as params)."""
+    root: dict = {}
+    for key, arr in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root
+
+
+def greedy_params(implementation: str, pol_state):
+    """Extract the greedy parameter subtree from a learner state.
+
+    Accepts both live state objects (TabularState/DQNState/DDPGState/
+    DDPGParams) and the raw field-keyed dicts orbax returns from a
+    structure-free checkpoint read (``train.checkpoint.restore_raw``).
+    Always returns a dict-rooted tree (the bare tabular array is wrapped)
+    so the npz leaf paths are never empty.
+    """
+    try:
+        field = GREEDY_FIELD[implementation]
+    except KeyError:
+        raise ValueError(
+            f"unknown implementation {implementation!r}; "
+            f"expected one of {sorted(GREEDY_FIELD)}"
+        ) from None
+    if isinstance(pol_state, dict):
+        node = pol_state.get(field)
+    else:
+        node = getattr(pol_state, field, None)
+    if node is None:
+        have = (
+            sorted(pol_state)
+            if isinstance(pol_state, dict)
+            else type(pol_state).__name__
+        )
+        raise ValueError(
+            f"state has no {field!r} subtree for implementation "
+            f"{implementation!r} (got {have}); is this the right checkpoint?"
+        )
+    return {field: node} if implementation == "tabular" else node
+
+
+def _model_spec(cfg, implementation: str, flat_params: dict) -> dict:
+    """Architecture fields the engine needs to rebuild the greedy forward
+    pass exactly (bin counts for the tabular discretizer, hidden widths for
+    the nets, the agent-shared flag for DDPG)."""
+    if implementation == "tabular":
+        return {"qlearning": dataclasses.asdict(cfg.qlearning)}
+    if implementation == "dqn":
+        return {"hidden": cfg.dqn.hidden}
+    # ddpg: a per-agent actor stacks a leading [A] axis on every Dense
+    # kernel (ndim 3); the agent-shared actor is unbatched (ndim 2). Detect
+    # from the exported params, not cfg — an eval-path restore may have
+    # broadcast a shared checkpoint onto per-agent stacks already.
+    kernel = flat_params.get("Dense_0/kernel")
+    share = kernel is not None and kernel.ndim == 2
+    return {"actor_hidden": cfg.ddpg.actor_hidden, "share_across_agents": share}
+
+
+def _action_spec(implementation: str) -> dict:
+    if implementation in ("tabular", "dqn"):
+        return {
+            "type": "discrete",
+            "values": [0.0, 0.5, 1.0],  # models/dqn.py ACTION_VALUES
+            "semantics": "heat-pump power fraction",
+        }
+    return {
+        "type": "continuous",
+        "low": 0.0,
+        "high": 1.0,
+        "semantics": "heat-pump power fraction",
+    }
+
+
+def export_policy_bundle(
+    cfg,
+    pol_state,
+    out_dir: str,
+    source: Optional[dict] = None,
+    dtype: str = "float32",
+) -> str:
+    """Freeze ``pol_state``'s greedy parameters into a bundle at ``out_dir``.
+
+    ``source`` (e.g. ``{"checkpoint": dir, "episode": n}``) is recorded
+    verbatim in the manifest for provenance. ``dtype`` casts floating leaves
+    on disk (``float16`` halves the bundle; integer leaves are untouched).
+    Note that a float16 export QUANTIZES the parameters — the engine's
+    bit-identical-to-checkpoint guarantee for discrete policies holds for
+    float32 bundles (the default); a float16 Q-table can collapse near-tied
+    action values and flip an argmax. Returns ``out_dir``.
+    """
+    from p2pmicrogrid_tpu.telemetry import config_hash
+    from p2pmicrogrid_tpu.telemetry.registry import git_rev
+
+    if dtype not in EXPORT_DTYPES:
+        raise ValueError(f"dtype must be one of {EXPORT_DTYPES}, got {dtype!r}")
+    impl = cfg.train.implementation
+    params = greedy_params(impl, pol_state)
+    flat = _flatten_tree(params)
+    cast = np.dtype(dtype)
+    flat = {
+        k: (v.astype(cast) if np.issubdtype(v.dtype, np.floating) else v)
+        for k, v in flat.items()
+    }
+
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez(os.path.join(out_dir, PARAMS_FILE), **flat)
+    manifest = {
+        "kind": "policy_bundle",
+        "format_version": BUNDLE_FORMAT_VERSION,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "implementation": impl,
+        "n_agents": cfg.sim.n_agents,
+        "setting": cfg.setting,
+        "config_hash": config_hash(cfg),
+        "git_rev": git_rev(),
+        "dtype": dtype,
+        "obs_spec": dict(OBS_SPEC),
+        "action_spec": _action_spec(impl),
+        "model": _model_spec(cfg, impl, flat),
+        "params_file": PARAMS_FILE,
+        "param_count": int(sum(v.size for v in flat.values())),
+        "param_bytes": int(sum(v.nbytes for v in flat.values())),
+        "source": source,
+    }
+    with open(os.path.join(out_dir, MANIFEST_FILE), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return out_dir
+
+
+def load_policy_bundle(bundle_dir: str) -> Tuple[dict, dict]:
+    """(manifest, nested params dict of np arrays) from a bundle directory.
+
+    Refuses bundles written by a NEWER format version — fields this reader
+    does not understand could change greedy semantics silently.
+    """
+    mpath = os.path.join(bundle_dir, MANIFEST_FILE)
+    if not os.path.exists(mpath):
+        raise FileNotFoundError(f"no {MANIFEST_FILE} under {bundle_dir}")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("kind") != "policy_bundle":
+        raise ValueError(
+            f"{mpath} is not a policy bundle manifest "
+            f"(kind={manifest.get('kind')!r})"
+        )
+    version = manifest.get("format_version")
+    if not isinstance(version, int) or version > BUNDLE_FORMAT_VERSION:
+        raise ValueError(
+            f"bundle {bundle_dir} has format_version {version!r}; this "
+            f"reader understands <= {BUNDLE_FORMAT_VERSION} — upgrade the "
+            "serving code, do not guess at a newer format"
+        )
+    ppath = os.path.join(bundle_dir, manifest.get("params_file", PARAMS_FILE))
+    with np.load(ppath) as z:
+        flat = {k: z[k] for k in z.files}
+    return manifest, _unflatten_tree(flat)
+
+
+def export_bundle_from_checkpoint(
+    cfg,
+    ckpt_dir: str,
+    out_dir: str,
+    dtype: str = "float32",
+) -> str:
+    """Export the newest checkpoint step under ``ckpt_dir`` as a bundle.
+
+    Template-free: the checkpoint is read structure-free
+    (``train.checkpoint.restore_raw``) and only the greedy subtree is
+    touched, so the export works even when the full learner-state template
+    is expensive to build (the raw read skips optimizer/replay
+    reconstruction entirely).
+    """
+    from p2pmicrogrid_tpu.train.checkpoint import restore_raw
+
+    raw, episode, step_path = restore_raw(ckpt_dir)
+    return export_policy_bundle(
+        cfg,
+        raw,
+        out_dir,
+        source={"checkpoint": os.path.abspath(step_path), "episode": episode},
+        dtype=dtype,
+    )

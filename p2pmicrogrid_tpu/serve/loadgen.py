@@ -1,0 +1,241 @@
+"""Open-loop load generation + SLO reporting for the serving engine.
+
+The question serve-bench answers: at a given request rate, what latency do
+households see from the batched engine, and how much compute does padding
+waste? Methodology:
+
+* **Arrivals are open-loop** (Poisson, fixed rate, independent of service
+  times) — the standard way to expose queueing delay; a closed loop would
+  let a slow server throttle its own offered load and flatter the tail.
+* **Batching runs on a virtual clock.** ``plan_open_loop`` replays the
+  microbatch policy (dispatch at ``max_batch`` queued or ``max_wait`` after
+  the oldest arrival, server serially busy) deterministically over the
+  arrival times, asking a ``service_time_fn`` how long each dispatched
+  batch takes. serve-bench passes a ``service_time_fn`` that EXECUTES the
+  batch on the real engine and returns the measured wall time, so queueing
+  waits are exactly reproducible while service times are real; tests pass a
+  synthetic model, making the whole percentile pipeline deterministic under
+  a fixed seed.
+* **Per-request latency** = batch completion - request arrival (queue wait
+  + padded-batch service). Reported as p50/p95/p99 against an SLO budget,
+  plus throughput (completed / makespan) and the padding-waste fraction.
+
+Output goes through the telemetry stdout sink with the same one-JSON-per-
+line hygiene as ``bench`` (rows follow the metric-row schema that
+``tools/check_artifacts_schema.py`` validates; the LAST line is the
+headline row carrying every stat).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+def poisson_arrivals(rate_hz: float, n: int, seed: int = 0) -> np.ndarray:
+    """Cumulative arrival times (seconds) of ``n`` Poisson requests."""
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+
+
+@dataclass
+class LoadgenResult:
+    """Per-request latencies plus the batch schedule that produced them."""
+
+    latencies_s: np.ndarray      # [N]
+    batch_sizes: List[int]
+    bucket_sizes: List[int]
+    makespan_s: float            # first arrival -> last completion
+
+    @property
+    def n_requests(self) -> int:
+        return int(self.latencies_s.shape[0])
+
+    @property
+    def throughput_rps(self) -> float:
+        return self.n_requests / self.makespan_s if self.makespan_s > 0 else 0.0
+
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of computed batch rows that were padding."""
+        total = sum(self.bucket_sizes)
+        return 1.0 - sum(self.batch_sizes) / total if total else 0.0
+
+    def latency_ms(self, q: float) -> float:
+        return float(np.percentile(self.latencies_s, q) * 1e3)
+
+
+def plan_open_loop(
+    arrivals: np.ndarray,
+    service_time_fn: Callable[[int, int], float],
+    max_batch: int,
+    max_wait_s: float,
+    bucket_fn: Optional[Callable[[int], int]] = None,
+) -> LoadgenResult:
+    """Deterministic replay of the microbatch policy over ``arrivals``.
+
+    ``service_time_fn(i, j)`` serves requests [i, j) and returns the batch's
+    service seconds (measured on a real engine, or modeled in tests).
+    Dispatch rule, matching ``engine.MicroBatchQueue`` exactly: the batch's
+    coalescing window is anchored at its OLDEST request's arrival — dispatch
+    at ``max(server_free, oldest_arrival + max_wait_s)``, or as soon as
+    ``max_batch`` requests have queued (but never before the server frees);
+    every request arrived by the dispatch instant joins, up to the cap.
+    """
+    if bucket_fn is None:
+        bucket_fn = lambda n: n
+    arrivals = np.asarray(arrivals, dtype=float)
+    n = arrivals.shape[0]
+    latencies = np.zeros(n)
+    batch_sizes: List[int] = []
+    bucket_sizes: List[int] = []
+    free = 0.0
+    i = 0
+    while i < n:
+        dispatch = max(free, arrivals[i] + max_wait_s)
+        j = i + 1
+        while j < n and (j - i) < max_batch and arrivals[j] <= dispatch:
+            j += 1
+        if (j - i) == max_batch:
+            # Filled before the window closed: dispatch at the filling
+            # arrival (or the moment the server frees, whichever is later).
+            dispatch = max(free, arrivals[j - 1])
+        done = dispatch + service_time_fn(i, j)
+        latencies[i:j] = done - arrivals[i:j]
+        batch_sizes.append(j - i)
+        bucket_sizes.append(bucket_fn(j - i))
+        free = done
+        i = j
+    return LoadgenResult(
+        latencies_s=latencies,
+        batch_sizes=batch_sizes,
+        bucket_sizes=bucket_sizes,
+        makespan_s=float(free - arrivals[0]),
+    )
+
+
+def synthetic_obs(n: int, n_agents: int, seed: int = 0) -> np.ndarray:
+    """Request observations drawn uniformly over the serving contract's
+    feature ranges (obs_spec: time in [0,1), the normalized features in
+    [-1, 1])."""
+    rng = np.random.default_rng(seed)
+    obs = np.empty((n, n_agents, 4), dtype=np.float32)
+    obs[..., 0] = rng.uniform(0.0, 1.0, (n, n_agents))
+    obs[..., 1:] = rng.uniform(-1.0, 1.0, (n, n_agents, 3))
+    return obs
+
+
+def serve_bench(
+    engine,
+    rate_hz: float = 256.0,
+    n_requests: int = 2048,
+    max_batch: Optional[int] = None,
+    max_wait_s: float = 0.002,
+    seed: int = 0,
+    slo_ms: float = 100.0,
+    emit: Optional[Callable[[dict], None]] = None,
+    service_time_fn: Optional[Callable[[int, int], float]] = None,
+) -> List[dict]:
+    """Drive ``engine`` with an open-loop Poisson stream; report SLO metrics.
+
+    Emits (and returns) metric rows in the bench schema. ``vs_baseline``
+    semantics per row: latency rows report SLO headroom (``slo_ms / pXX`` —
+    > 1 means inside budget); throughput reports achieved/offered;
+    padding-waste reports the useful-row fraction (1 - waste). The LAST row
+    is the headline, carrying all stats plus compile/execute span timings.
+    """
+    from p2pmicrogrid_tpu.telemetry import current, phase_timings
+
+    max_batch = min(max_batch or engine.max_batch, engine.max_batch)
+    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    obs = synthetic_obs(n_requests, engine.n_agents, seed=seed)
+
+    tel = current()
+    with tel.span("compile:serve", max_batch=max_batch):
+        # Pre-compile every bucket the planner can hit: tail latency must
+        # measure the queue + device, not one-off XLA compiles. The limit is
+        # the bucket a full max_batch PADS to — with a non-power-of-two
+        # max_batch, batches between the last smaller bucket and max_batch
+        # land in bucket_for(max_batch), which must be warm too.
+        limit = engine.bucket_for(max_batch)
+        # include_step=False: this benchmark only drives act(); compiling
+        # the session-step executables would double compile_s for nothing.
+        engine.warmup(
+            [b for b in engine.buckets if b <= limit], include_step=False
+        )
+
+    if service_time_fn is None:
+
+        def service_time_fn(i, j):
+            t0 = time.perf_counter()
+            engine.act(obs[i:j])
+            return time.perf_counter() - t0
+
+    with tel.span("execute:serve", n_requests=n_requests, rate_hz=rate_hz):
+        result = plan_open_loop(
+            arrivals,
+            service_time_fn,
+            max_batch=max_batch,
+            max_wait_s=max_wait_s,
+            bucket_fn=engine.bucket_for,
+        )
+
+    p50, p95, p99 = (result.latency_ms(q) for q in (50, 95, 99))
+    waste = result.padding_waste
+    rows = [
+        {
+            "metric": f"serve_latency_ms_p{q}",
+            "value": round(v, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / v, 2) if v > 0 else 0.0,
+        }
+        for q, v in (("50", p50), ("95", p95), ("99", p99))
+    ]
+    rows.append(
+        {
+            "metric": "serve_throughput_rps",
+            "value": round(result.throughput_rps, 1),
+            "unit": "requests/sec",
+            "vs_baseline": round(result.throughput_rps / rate_hz, 3),
+        }
+    )
+    rows.append(
+        {
+            "metric": "serve_padding_waste",
+            "value": round(waste, 4),
+            "unit": "fraction",
+            "vs_baseline": round(1.0 - waste, 4),
+        }
+    )
+    rows.append(
+        {
+            "metric": "serve_bench",
+            "value": round(p99, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / p99, 2) if p99 > 0 else 0.0,
+            "p50_ms": round(p50, 3),
+            "p95_ms": round(p95, 3),
+            "p99_ms": round(p99, 3),
+            "throughput_rps": round(result.throughput_rps, 1),
+            "padding_waste": round(waste, 4),
+            "n_requests": n_requests,
+            "offered_rate_rps": rate_hz,
+            "max_batch": max_batch,
+            "max_wait_ms": round(max_wait_s * 1e3, 3),
+            "slo_ms": slo_ms,
+            "n_batches": len(result.batch_sizes),
+            "implementation": engine.manifest.get("implementation"),
+            "n_agents": engine.n_agents,
+            "config_hash": engine.manifest.get("config_hash"),
+            **phase_timings("serve"),
+        }
+    )
+    if emit is not None:
+        for row in rows:
+            emit(row)
+    return rows

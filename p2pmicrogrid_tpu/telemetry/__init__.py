@@ -9,6 +9,7 @@ from p2pmicrogrid_tpu.telemetry.device_metrics import (
     dc_from_slot,
     dc_to_dict,
     dc_zero,
+    replay_fill_fraction,
 )
 from p2pmicrogrid_tpu.telemetry.registry import (
     JsonlSink,
@@ -18,6 +19,7 @@ from p2pmicrogrid_tpu.telemetry.registry import (
     config_hash,
     current,
     guarded_stdout_sink,
+    phase_timings,
     run_manifest,
     set_current,
 )
@@ -29,6 +31,8 @@ __all__ = [
     "dc_from_slot",
     "dc_to_dict",
     "dc_zero",
+    "replay_fill_fraction",
+    "phase_timings",
     "JsonlSink",
     "MemorySink",
     "StdoutSink",

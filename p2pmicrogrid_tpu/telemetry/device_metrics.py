@@ -80,6 +80,29 @@ def dc_from_slot(cfg, outputs, loss=None) -> DeviceCounters:
     )
 
 
+def replay_fill_fraction(state):
+    """Fill fraction (count / capacity) of a replay-carrying state, or None.
+
+    The replay-saturation gauge (ROADMAP open item): a shared/chunked
+    trainer's per-slot update samples only the FILLED region of its
+    ``LockstepReplay`` ring — early in an episode (or in every fresh-replay
+    chunk) the effective training set is a handful of slots, and nothing
+    host-side could see how saturated the ring actually got. Accepts any of
+    the replay carriers (``LockstepReplay``/``ReplayState`` directly, or a
+    state with a ``.replay`` field: ``DDPGScenState``, ``DDPGState``,
+    ``DQNState``) and returns a traceable f32 scalar in [0, 1]; ``None``
+    for stateless learners (tabular) so callers can skip the gauge.
+    """
+    if state is None:
+        return None
+    replay = getattr(state, "replay", state)
+    count = getattr(replay, "count", None)
+    capacity = getattr(replay, "capacity", None)
+    if count is None or capacity is None:
+        return None
+    return jnp.asarray(count, jnp.float32) / float(capacity)
+
+
 def dc_to_dict(dc: DeviceCounters) -> dict:
     """Reduce a (possibly still device-resident) counter pytree to host
     Python numbers — the once-per-device-call transfer."""

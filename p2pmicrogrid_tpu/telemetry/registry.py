@@ -164,6 +164,27 @@ def current() -> "Telemetry":
     return _CURRENT[0]
 
 
+def phase_timings(label: str, spans=None) -> dict:
+    """Most recent ``compile:<label>`` / ``execute:<label>`` span durations
+    as metric-row fields (``compile_s``/``execute_s``).
+
+    The shared helper behind both benchmark suites' per-phase reporting
+    (benchmarks.py rows and serve-bench's headline row): the measurement
+    helpers bracket compile+first-run and pure-execution with those span
+    names, and this turns them into row fields. ``spans`` overrides the
+    process-current recorder (tests).
+    """
+    rec = spans if spans is not None else current().spans
+    out = {}
+    c = rec.duration(f"compile:{label}")
+    e = rec.duration(f"execute:{label}")
+    if c is not None:
+        out["compile_s"] = round(c, 3)
+    if e is not None:
+        out["execute_s"] = round(e, 3)
+    return out
+
+
 def config_hash(cfg) -> str:
     """Stable short hash of a frozen ExperimentConfig (repr is deterministic
     for frozen dataclasses of scalars)."""

@@ -4,7 +4,10 @@ Backs ``cli.py telemetry-report``: reads ``manifest.json``,
 ``metrics.jsonl``, ``summary.json`` and ``trace.json`` (whatever subset
 exists) and produces a plain-text report — manifest provenance, event
 counts, training/health trajectory highlights, device-counter totals and a
-span timing table.
+span timing table. ``compare_runs`` diffs two runs side by side, keyed by
+their manifests' config_hash/git_rev (``telemetry-report --compare A B``):
+the manifest carries those fields precisely so a regression can be
+attributed to a config change, a code change, or neither.
 """
 
 from __future__ import annotations
@@ -107,11 +110,21 @@ def render_run(run_dir: str) -> str:
     if s:
         counters = s.get("counters", {})
         dev = {k: v for k, v in counters.items() if k.startswith("device.")}
-        other = {k: v for k, v in counters.items() if not k.startswith("device.")}
+        serve = {k: v for k, v in counters.items() if k.startswith("serve.")}
+        other = {
+            k: v
+            for k, v in counters.items()
+            if not k.startswith(("device.", "serve."))
+        }
         if dev:
             parts.append(
                 "\ndevice counters (episode-scan totals)\n"
                 + _table(sorted(dev.items()), ("counter", "total"))
+            )
+        if serve:
+            parts.append(
+                "\nserve counters (inference engine)\n"
+                + _table(sorted(serve.items()), ("counter", "total"))
             )
         if other:
             parts.append(
@@ -121,6 +134,26 @@ def render_run(run_dir: str) -> str:
             parts.append(
                 "\ngauges\n" + _table(sorted(s["gauges"].items()), ("gauge", "value"))
             )
+        hists = s.get("histograms", {})
+        if hists:
+            rows = [
+                (
+                    name,
+                    h.get("count"),
+                    f"{h.get('mean', float('nan')):.3f}",
+                    f"{h.get('p50', float('nan')):.3f}",
+                    f"{h.get('p95', float('nan')):.3f}",
+                    f"{h.get('max', float('nan')):.3f}",
+                )
+                for name, h in sorted(hists.items())
+                if isinstance(h, dict)
+            ]
+            if rows:
+                parts.append(
+                    "\nhistograms\n"
+                    + _table(rows, ("histogram", "count", "mean", "p50",
+                                    "p95", "max"))
+                )
         spans = s.get("spans", {})
         if spans:
             rows = [
@@ -135,4 +168,78 @@ def render_run(run_dir: str) -> str:
     trace = os.path.join(run_dir, "trace.json")
     if os.path.exists(trace):
         parts.append(f"\nchrome trace: {trace} (load in chrome://tracing / Perfetto)")
+    return "\n".join(parts) + "\n"
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, float) and not v.is_integer():
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _delta(a, b) -> str:
+    try:
+        d = float(b) - float(a)
+    except (TypeError, ValueError):
+        return "?"
+    ratio = f" ({float(b) / float(a):.3g}x)" if a not in (0, 0.0) else ""
+    return f"{d:+.4g}{ratio}"
+
+
+def compare_runs(dir_a: str, dir_b: str) -> str:
+    """Side-by-side diff of two run directories' summaries.
+
+    The identity block leads: config_hash and git_rev from each manifest,
+    flagged ``match`` / ``DIFFERS`` — a metric delta only means something
+    once you know whether the config or the code moved under it. Then
+    counters, gauges, histogram p50/p95 and span totals, each as
+    (A, B, delta) rows; names present in only one run show ``—`` on the
+    other side.
+    """
+    a, b = load_run(dir_a), load_run(dir_b)
+    parts = [f"comparing A={dir_a}\n          B={dir_b}"]
+
+    ma, mb = a["manifest"] or {}, b["manifest"] or {}
+    rows = []
+    for key in ("config_hash", "git_rev", "setting", "backend", "device_kind",
+                "device_count", "run_id", "created"):
+        va, vb = ma.get(key), mb.get(key)
+        if va is None and vb is None:
+            continue
+        flag = "match" if va == vb else "DIFFERS"
+        rows.append((key, va, vb, flag))
+    parts.append("\nidentity\n" + _table(rows, ("field", "A", "B", "")))
+
+    sa, sb = a["summary"] or {}, b["summary"] or {}
+
+    def diff_section(title, da, db, fmt=lambda v: v):
+        names = sorted(set(da) | set(db))
+        if not names:
+            return
+        rows = []
+        for name in names:
+            va, vb = da.get(name), db.get(name)
+            rows.append((
+                name,
+                "—" if va is None else _fmt_num(fmt(va)),
+                "—" if vb is None else _fmt_num(fmt(vb)),
+                _delta(fmt(va), fmt(vb)) if va is not None and vb is not None
+                else "",
+            ))
+        parts.append(f"\n{title}\n" + _table(rows, ("name", "A", "B", "delta")))
+
+    diff_section("counters", sa.get("counters", {}), sb.get("counters", {}))
+    diff_section("gauges", sa.get("gauges", {}), sb.get("gauges", {}))
+    diff_section(
+        "histogram p95",
+        sa.get("histograms", {}),
+        sb.get("histograms", {}),
+        fmt=lambda h: h.get("p95") if isinstance(h, dict) else h,
+    )
+    diff_section(
+        "span total_s",
+        sa.get("spans", {}),
+        sb.get("spans", {}),
+        fmt=lambda s: s.get("total_s") if isinstance(s, dict) else s,
+    )
     return "\n".join(parts) + "\n"

@@ -65,6 +65,29 @@ def latest_checkpoint(path: str) -> Optional[str]:
     return os.path.join(path, max(steps, key=lambda d: int(d.split("_")[1])))
 
 
+def restore_raw(path: str) -> Tuple[dict, int, str]:
+    """Structure-free read of the newest checkpoint step under ``path``.
+
+    The serving-export hook (serve/export.py): a bundle export needs ONLY
+    the greedy parameter subtree, so it reads the checkpoint without a
+    learner-state template — no optimizer/replay/target reconstruction, and
+    the raw field-keyed dicts orbax returns are exactly what
+    ``serve.export.greedy_params`` consumes. Returns
+    ``(raw_pol_state, episode, step_path)``.
+    """
+    step_path = latest_checkpoint(path)
+    if step_path is None:
+        raise FileNotFoundError(f"no checkpoint under {path}")
+    raw = _checkpointer().restore(step_path)
+    if not isinstance(raw, dict) or "pol_state" not in raw:
+        raise RuntimeError(
+            f"checkpoint {step_path} has no 'pol_state' tree (root keys: "
+            f"{sorted(raw) if isinstance(raw, dict) else type(raw).__name__}); "
+            "not a checkpoint of this framework"
+        )
+    return raw["pol_state"], int(raw.get("episode", 0)), step_path
+
+
 def _graft_old_checkpoint(template, raw):
     """Rebuild ``template``'s structure from a raw orbax tree, filling leaves
     the checkpoint lacks with the template's init defaults.

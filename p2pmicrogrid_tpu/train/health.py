@@ -375,8 +375,11 @@ def train_chunked_with_health(
     suppressed by ``P2P_TELEMETRY=0``). Every eval point, basin alert and
     per-eval device-counter total (NaN Q-values, comfort violations, market
     residual — accumulated inside the jitted eval scan) is an event; train
-    blocks and evals are spans. An auto-created telemetry is closed (summary
-    + Chrome trace written) before returning.
+    blocks and evals are spans. With telemetry on, the TRAINING episodes
+    collect the same in-scan counters too (``device_counters`` events with
+    ``phase: "train"``) plus the per-chunk replay fill fraction as the
+    ``replay.fill_fraction`` gauge. An auto-created telemetry is closed
+    (summary + Chrome trace written) before returning.
     """
     from p2pmicrogrid_tpu.parallel.scenarios import (
         make_chunked_episode_runner,
@@ -396,32 +399,6 @@ def train_chunked_with_health(
         )
     S = cfg.sim.n_scenarios
 
-    def build_runner(run_cfg):
-        episode_fn = make_shared_episode_fn(
-            run_cfg, policy, None, ratings,
-            arrays_fn=lambda k: device_episode_arrays(
-                run_cfg, k, ratings, S
-            ),
-            n_scenarios=S,
-        )
-        warmup_fn = None
-        if run_cfg.train.implementation == "dqn" and run_cfg.dqn.warmup_passes > 0:
-            warmup_fn = make_shared_episode_fn(
-                run_cfg, policy, None, ratings,
-                arrays_fn=lambda k: device_episode_arrays(
-                    run_cfg, k, ratings, S
-                ),
-                n_scenarios=S, record_only=True,
-            )
-        runner = make_chunked_episode_runner(
-            run_cfg, episode_fn, n_chunks, warmup_fn=warmup_fn,
-            chunk_parallel=chunk_parallel,
-        )
-        return runner, episode_fn
-
-    normal_runner, normal_episode_fn = build_runner(cfg)
-    boosted = None  # (runner, episode_fn), built lazily on first basin entry
-
     owns_telemetry = False
     if telemetry == "auto":
         from p2pmicrogrid_tpu.telemetry import Telemetry
@@ -439,6 +416,37 @@ def train_chunked_with_health(
         owns_telemetry = telemetry is not None
     if telemetry is not None and telemetry.run_dir:
         print(f"telemetry run: {telemetry.run_dir}", file=sys.stderr, flush=True)
+
+    # With telemetry on, the TRAINING episode program also collects the
+    # in-scan device counters + per-chunk replay fill (not just the greedy
+    # evals — ROADMAP open item), so the runner is built to match.
+    collect = telemetry is not None
+
+    def build_runner(run_cfg):
+        episode_fn = make_shared_episode_fn(
+            run_cfg, policy, None, ratings,
+            arrays_fn=lambda k: device_episode_arrays(
+                run_cfg, k, ratings, S
+            ),
+            n_scenarios=S, collect_device_metrics=collect,
+        )
+        warmup_fn = None
+        if run_cfg.train.implementation == "dqn" and run_cfg.dqn.warmup_passes > 0:
+            warmup_fn = make_shared_episode_fn(
+                run_cfg, policy, None, ratings,
+                arrays_fn=lambda k: device_episode_arrays(
+                    run_cfg, k, ratings, S
+                ),
+                n_scenarios=S, record_only=True,
+            )
+        runner = make_chunked_episode_runner(
+            run_cfg, episode_fn, n_chunks, warmup_fn=warmup_fn,
+            chunk_parallel=chunk_parallel, collect_device_metrics=collect,
+        )
+        return runner, episode_fn
+
+    normal_runner, normal_episode_fn = build_runner(cfg)
+    boosted = None  # (runner, episode_fn), built lazily on first basin entry
 
     greedy_eval = make_greedy_eval(
         cfg, policy, ratings, s_eval=s_eval,
@@ -462,7 +470,7 @@ def train_chunked_with_health(
                 jax.block_until_ready(c)
             dcd = dc_to_dict(dc)
             telemetry.record_device_counters(dcd)
-            telemetry.event("device_counters", episode=ep, **dcd)
+            telemetry.event("device_counters", episode=ep, phase="eval", **dcd)
         else:
             c, r = greedy_eval(pol_state, jax.random.PRNGKey(1))
         monitor.update(ep, c, r)
@@ -500,6 +508,7 @@ def train_chunked_with_health(
                     n_episodes=block, n_chunks=n_chunks,
                     episode0=episode0 + done, episode_cb=episode_cb,
                     episode_fn=episode_fn, runner=runner,
+                    telemetry=telemetry,
                 )
             if telemetry is not None:
                 telemetry.event(

@@ -92,3 +92,23 @@ def test_metric_jsonl_lines_checked(checker, tmp_path):
     problems = []
     checker.check_metric_jsonl(str(path), problems)
     assert any("missing key 'value'" in p for p in problems)
+
+
+def test_serve_capture_rows_scanned(checker, tmp_path):
+    """check_all picks up artifacts/SERVE_*.jsonl with the metric-row schema."""
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    good = json.dumps({"metric": "serve_bench", "value": 1.0, "unit": "ms",
+                       "vs_baseline": 2.0})
+    (art / "SERVE_r01.jsonl").write_text(good + '\n{"metric": "m"}\n')
+    problems = checker.check_all(str(tmp_path))
+    assert any("SERVE_r01.jsonl" in p for p in problems)
+
+
+def test_bundle_dirs_scanned_by_check_all(checker, tmp_path):
+    bad = tmp_path / "bundles" / "broken"
+    bad.mkdir(parents=True)
+    (bad / "manifest.json").write_text(json.dumps({"kind": "policy_bundle"}))
+    problems = checker.check_all(str(tmp_path))
+    assert any("format_version" in p for p in problems)
+    assert any("params_file" in p or "missing key" in p for p in problems)

@@ -1,0 +1,501 @@
+"""Serving layer: bundle export/load round-trips are bit-exact against the
+training-side greedy paths, padding buckets never change outputs, sessions
+carry state, the microbatch queue coalesces correctly, loadgen percentiles
+are seed-deterministic, and the serve-bench CLI keeps stdout strictly
+one-JSON-per-line. Fast and JAX_PLATFORMS=cpu-safe by design (tier-1)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES
+from p2pmicrogrid_tpu.serve import (
+    MicroBatchQueue,
+    PolicyEngine,
+    export_bundle_from_checkpoint,
+    export_policy_bundle,
+    load_policy_bundle,
+    plan_open_loop,
+    poisson_arrivals,
+    serve_bench,
+)
+from p2pmicrogrid_tpu.train import init_policy_state
+
+A = 3  # community size for all serving tests
+
+
+def _cfg(impl, **ddpg_kw):
+    return default_config(
+        sim=SimConfig(n_agents=A),
+        train=TrainConfig(implementation=impl),
+        ddpg=DDPGConfig(buffer_size=16, batch_size=2, **ddpg_kw),
+    )
+
+
+def _obs(n, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = np.empty((n, A, 4), dtype=np.float32)
+    obs[..., 0] = rng.uniform(0, 1, (n, A))
+    obs[..., 1:] = rng.uniform(-1, 1, (n, A, 3))
+    return obs
+
+
+def _trained_state(cfg, seed=0):
+    """A state with non-trivial greedy structure (random, not trained —
+    bit-exactness does not care, but an all-zero Q-table would make every
+    argmax trivially 0)."""
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
+    if cfg.train.implementation == "tabular":
+        ps = ps._replace(
+            q_table=jax.random.normal(
+                jax.random.PRNGKey(seed + 1), ps.q_table.shape
+            )
+        )
+    return ps
+
+
+def _reference_actions(cfg, ps, obs):
+    """Greedy actions through the TRAINING-side code paths."""
+    impl = cfg.train.implementation
+    key = jax.random.PRNGKey(0)
+    if impl == "tabular":
+        from p2pmicrogrid_tpu.models.tabular import tabular_act
+
+        def one(o):
+            action, _ = tabular_act(cfg.qlearning, ps, o, key, explore=False)
+            return ACTION_VALUES[action]
+
+        return np.asarray(jax.vmap(one)(jnp.asarray(obs)))
+    if impl == "dqn":
+        from p2pmicrogrid_tpu.models.dqn import dqn_act
+
+        def one(o):
+            action, _ = dqn_act(cfg.dqn, ps, o, key, explore=False)
+            return ACTION_VALUES[action]
+
+        return np.asarray(jax.vmap(one)(jnp.asarray(obs)))
+    # ddpg: the scenario-batched greedy act (what health evals serve with).
+    from p2pmicrogrid_tpu.models.ddpg import DDPGParams, ddpg_shared_act
+
+    params = DDPGParams(
+        actor=ps.actor,
+        critic=ps.critic,
+        actor_target=ps.actor_target,
+        critic_target=ps.critic_target,
+        actor_opt=ps.actor_opt,
+        critic_opt=ps.critic_opt,
+        noise_scale=ps.noise_scale,
+    ) if not isinstance(ps, DDPGParams) else ps
+    a, _, _ = ddpg_shared_act(
+        cfg.ddpg, params, jnp.asarray(obs),
+        jnp.zeros(obs.shape[:2]), key, explore=False,
+    )
+    return np.asarray(a)
+
+
+class TestBundleRoundTrip:
+    @pytest.mark.parametrize("impl", ["tabular", "dqn"])
+    def test_export_load_act_bit_exact(self, impl, tmp_path):
+        cfg = _cfg(impl)
+        ps = _trained_state(cfg)
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        manifest, params = load_policy_bundle(bundle)
+        assert manifest["kind"] == "policy_bundle"
+        assert manifest["implementation"] == impl
+        assert manifest["n_agents"] == A
+        assert manifest["config_hash"]
+        assert manifest["obs_spec"]["dim"] == 4
+
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        obs = _obs(4)
+        got = engine.act(obs)
+        want = _reference_actions(cfg, ps, obs)
+        np.testing.assert_array_equal(got, want)
+
+    def test_export_load_act_ddpg_ulp_exact(self, tmp_path):
+        # Continuous actor: the engine's fused program matches the
+        # training-side greedy act to ~1 ulp, not bit-for-bit (engine.py
+        # "Bit-exact greedy" caveat); the discrete policies above carry the
+        # bit-identical guarantee.
+        cfg = _cfg("ddpg")
+        ps = _trained_state(cfg)
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        obs = _obs(4)
+        np.testing.assert_allclose(
+            engine.act(obs), _reference_actions(cfg, ps, obs), rtol=1e-6
+        )
+
+    def test_agent_shared_ddpg_bundle(self, tmp_path):
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_params_init
+
+        cfg = _cfg("ddpg", share_across_agents=True)
+        ps = ddpg_params_init(cfg.ddpg, A, jax.random.PRNGKey(0))
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        manifest, _ = load_policy_bundle(bundle)
+        assert manifest["model"]["share_across_agents"] is True
+
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        for n in (4, 5):  # exact bucket and a padded one
+            obs = _obs(n)
+            np.testing.assert_allclose(
+                engine.act(obs), _reference_actions(cfg, ps, obs), rtol=1e-6
+            )
+
+    def test_bundle_excludes_learner_state(self, tmp_path):
+        # The bundle is the greedy subtree ONLY: no optimizer moments, no
+        # replay rings, no target copies.
+        cfg = _cfg("ddpg")
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        with np.load(str(tmp_path / "b" / "params.npz")) as z:
+            keys = set(z.files)
+        assert all(
+            not k.startswith(("critic", "actor_target", "critic_target",
+                              "actor_opt", "critic_opt", "replay", "ou_"))
+            for k in keys
+        )
+        manifest, _ = load_policy_bundle(bundle)
+        # actor MLP: 3 Dense layers x (kernel, bias) per agent
+        assert manifest["param_count"] == sum(
+            np.prod(s) for s in [
+                (A, 4, 64), (A, 64), (A, 64, 64), (A, 64), (A, 64, 1), (A, 1),
+            ]
+        )
+
+    def test_newer_format_version_refused(self, tmp_path):
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(
+            cfg, _trained_state(cfg), str(tmp_path / "b")
+        )
+        mpath = tmp_path / "b" / "manifest.json"
+        m = json.loads(mpath.read_text())
+        m["format_version"] = 99
+        mpath.write_text(json.dumps(m))
+        with pytest.raises(ValueError, match="format_version"):
+            load_policy_bundle(bundle)
+
+    def test_float16_bundle_halves_disk_and_still_serves(self, tmp_path):
+        cfg = _cfg("ddpg")
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        b32 = export_policy_bundle(cfg, ps, str(tmp_path / "f32"))
+        b16 = export_policy_bundle(
+            cfg, ps, str(tmp_path / "f16"), dtype="float16"
+        )
+        m32, _ = load_policy_bundle(b32)
+        m16, _ = load_policy_bundle(b16)
+        assert m16["param_bytes"] == m32["param_bytes"] // 2
+        engine = PolicyEngine(bundle_dir=b16)
+        out = engine.act(_obs(2))
+        # Quantized, not bit-exact — but the same policy to f16 tolerance.
+        np.testing.assert_allclose(
+            out, _reference_actions(cfg, ps, _obs(2)), atol=2e-3
+        )
+
+
+class TestCheckpointToBundle:
+    @pytest.mark.parametrize("impl", ["tabular", "dqn"])
+    def test_checkpoint_export_bit_exact_across_two_buckets(self, impl, tmp_path):
+        """Acceptance: bundle greedy actions are bit-identical to the source
+        checkpoint's, across at least two padding buckets. Discrete policies
+        carry the guarantee (argmax absorbs per-shape gemm retiling); the
+        continuous actor's cross-bucket ulp caveat is covered in
+        TestBundleRoundTrip."""
+        from p2pmicrogrid_tpu.train.checkpoint import save_checkpoint
+
+        cfg = _cfg(impl)
+        ps = _trained_state(cfg)
+        ckpt_dir = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt_dir, ps, episode=7)
+        bundle = export_bundle_from_checkpoint(
+            cfg, ckpt_dir, str(tmp_path / "bundle")
+        )
+        manifest, _ = load_policy_bundle(bundle)
+        assert manifest["source"]["episode"] == 7
+
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        obs = _obs(5, seed=3)
+        want = _reference_actions(cfg, ps, obs)
+        # Batch 3 pads to bucket 4; batch 5 pads to bucket 8 — two distinct
+        # compiled programs must both reproduce the checkpoint bit-exactly.
+        got3 = engine.act(obs[:3])
+        got5 = engine.act(obs)
+        assert engine.bucket_for(3) == 4 and engine.bucket_for(5) == 8
+        np.testing.assert_array_equal(got3, want[:3])
+        np.testing.assert_array_equal(got5, want)
+        assert engine.stats["padded_rows"] == (4 - 3) + (8 - 5)
+
+    def test_ddpg_checkpoint_export_ulp_exact(self, tmp_path):
+        """The raw-restore export path works for the actor-critic state too
+        (continuous actor: ulp tolerance, see engine.py)."""
+        from p2pmicrogrid_tpu.train.checkpoint import save_checkpoint
+
+        cfg = _cfg("ddpg")
+        ps = _trained_state(cfg)
+        ckpt_dir = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt_dir, ps, episode=1)
+        bundle = export_bundle_from_checkpoint(
+            cfg, ckpt_dir, str(tmp_path / "bundle")
+        )
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        obs = _obs(4, seed=4)
+        np.testing.assert_allclose(
+            engine.act(obs), _reference_actions(cfg, ps, obs), rtol=1e-6
+        )
+
+
+class TestEngine:
+    def test_padding_never_changes_outputs(self, tmp_path):
+        cfg = _cfg("tabular")
+        ps = _trained_state(cfg)
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+        obs = _obs(11, seed=5)
+        # 11 rows through max_batch 4 = batches of 4+4+3 (last padded to 4).
+        got = engine.act(obs)
+        np.testing.assert_array_equal(got, _reference_actions(cfg, ps, obs))
+        assert engine.stats["batches"] == 3
+        assert engine.stats["padded_rows"] == 1
+        assert 0.0 < engine.padding_waste < 0.1
+
+    def test_warmup_compiles_buckets(self, tmp_path):
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        assert engine.buckets == [1, 2, 4, 8]
+        assert engine.warmup() == [1, 2, 4, 8]
+
+    def test_rejects_wrong_community_shape(self, tmp_path):
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle)
+        with pytest.raises(ValueError, match=r"\[B, 3, 4\]"):
+            engine.act(np.zeros((2, A + 1, 4), np.float32))
+
+    def test_sessions_carry_state_with_donated_step(self, tmp_path):
+        cfg = _cfg("ddpg")
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        sessions = engine.init_sessions(3)
+        obs1, obs2 = _obs(3, seed=1), _obs(3, seed=2)
+        sessions, a1 = engine.step(sessions, obs1)
+        np.testing.assert_array_equal(a1, _reference_actions(cfg, ps, obs1))
+        np.testing.assert_array_equal(np.asarray(sessions.hp_frac), a1)
+        sessions, a2 = engine.step(sessions, obs2)
+        np.testing.assert_array_equal(np.asarray(sessions.hp_frac), a2)
+        assert np.asarray(sessions.slots).tolist() == [2, 2, 2]
+
+    def test_microbatch_queue_matches_direct_act(self, tmp_path):
+        cfg = _cfg("tabular")
+        ps = _trained_state(cfg)
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        engine.warmup()
+        obs = _obs(6, seed=9)
+        want = _reference_actions(cfg, ps, obs)
+        with MicroBatchQueue(engine, max_wait_s=0.01) as q:
+            futs = [q.submit(obs[i]) for i in range(6)]
+            for i, fut in enumerate(futs):
+                np.testing.assert_array_equal(fut.result(timeout=30), want[i])
+
+    def test_serve_counters_reach_telemetry(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry import Telemetry
+
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        tel = Telemetry(run_id="t")
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4, telemetry=tel)
+        engine.act(_obs(3))
+        s = tel.summary()
+        assert s["counters"]["serve.requests"] == 3
+        assert s["counters"]["serve.batches"] == 1
+        assert s["counters"]["serve.padded_rows"] == 1
+        assert s["histograms"]["serve.batch_ms"]["count"] == 1
+
+
+class TestLoadgen:
+    def test_poisson_arrivals_deterministic(self):
+        a = poisson_arrivals(100.0, 50, seed=7)
+        b = poisson_arrivals(100.0, 50, seed=7)
+        np.testing.assert_array_equal(a, b)
+        assert (np.diff(a) > 0).all()
+
+    def test_plan_percentiles_deterministic_under_seed(self):
+        def run():
+            arrivals = poisson_arrivals(1000.0, 200, seed=11)
+            res = plan_open_loop(
+                arrivals,
+                service_time_fn=lambda i, j: 0.0005 * (j - i) + 0.001,
+                max_batch=8,
+                max_wait_s=0.002,
+                bucket_fn=lambda n: 1 << (n - 1).bit_length() if n > 1 else 1,
+            )
+            return res.latency_ms(50), res.latency_ms(95), res.latency_ms(99)
+
+        assert run() == run()
+
+    def test_plan_semantics(self):
+        # 4 simultaneous arrivals, max_batch 2, zero wait: two batches of 2,
+        # serial service, second batch waits for the first.
+        arrivals = np.array([0.0, 0.0, 0.0, 0.0])
+        res = plan_open_loop(
+            arrivals, lambda i, j: 1.0, max_batch=2, max_wait_s=0.0
+        )
+        assert res.batch_sizes == [2, 2]
+        np.testing.assert_allclose(res.latencies_s, [1.0, 1.0, 2.0, 2.0])
+        assert res.throughput_rps == pytest.approx(2.0)
+
+    def test_padding_waste_accounting(self):
+        arrivals = np.array([0.0, 0.0, 0.0])
+        res = plan_open_loop(
+            arrivals, lambda i, j: 1.0, max_batch=4, max_wait_s=0.0,
+            bucket_fn=lambda n: 4,
+        )
+        assert res.batch_sizes == [3]
+        assert res.padding_waste == pytest.approx(0.25)
+
+    def test_serve_bench_rows(self, tmp_path):
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+        emitted = []
+        rows = serve_bench(
+            engine, rate_hz=5000.0, n_requests=64, max_batch=4,
+            max_wait_s=0.001, seed=3, emit=emitted.append,
+        )
+        assert rows == emitted
+        metrics = [r["metric"] for r in rows]
+        assert metrics[:3] == [
+            "serve_latency_ms_p50", "serve_latency_ms_p95",
+            "serve_latency_ms_p99",
+        ]
+        assert "serve_throughput_rps" in metrics
+        assert "serve_padding_waste" in metrics
+        head = rows[-1]
+        assert head["metric"] == "serve_bench"
+        for key in ("p50_ms", "p95_ms", "p99_ms", "throughput_rps",
+                    "padding_waste", "config_hash"):
+            assert key in head
+        # Every row satisfies the metric-row schema the checker enforces.
+        for r in rows:
+            assert isinstance(r["metric"], str)
+            assert isinstance(r["value"], (int, float))
+            assert isinstance(r["unit"], str)
+            assert isinstance(r["vs_baseline"], (int, float))
+
+
+class TestServeCli:
+    def test_serve_bench_cli_one_json_per_line(self, capfd):
+        from p2pmicrogrid_tpu.cli import main
+
+        rc = main([
+            "serve-bench", "--agents", "2", "--implementation", "tabular",
+            "--requests", "48", "--rate", "5000", "--max-batch", "8",
+            "--max-wait-ms", "1",
+        ])
+        assert rc == 0
+        out, err = capfd.readouterr()
+        lines = [l for l in out.splitlines() if l.strip()]
+        assert len(lines) == 6  # 3 latency + throughput + waste + headline
+        rows = [json.loads(l) for l in lines]
+        assert rows[-1]["metric"] == "serve_bench"
+        assert "fresh-init" in err
+
+    def test_export_bundle_cli(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+        from p2pmicrogrid_tpu.train.checkpoint import (
+            checkpoint_dir,
+            save_checkpoint,
+        )
+
+        cfg = _cfg("tabular")
+        ps = _trained_state(cfg)
+        model_dir = str(tmp_path / "models")
+        save_checkpoint(
+            checkpoint_dir(model_dir, cfg.setting, "tabular"), ps, episode=3
+        )
+        out_dir = str(tmp_path / "bundle")
+        rc = main([
+            "export-bundle", "--agents", str(A), "--implementation",
+            "tabular", "--model-dir", model_dir, "--out", out_dir,
+        ])
+        assert rc == 0
+        manifest, _ = load_policy_bundle(out_dir)
+        assert manifest["implementation"] == "tabular"
+        engine = PolicyEngine(bundle_dir=out_dir)
+        obs = _obs(2)
+        np.testing.assert_array_equal(
+            engine.act(obs), _reference_actions(cfg, ps, obs)
+        )
+
+    def test_export_bundle_cli_share_agents_keeps_bare_actor(self, tmp_path):
+        """A --share-agents checkpoint must export the ONE shared actor, not
+        the A-fold broadcast the eval path builds — the bundle stays small
+        and the engine serves through the flattened shared branch."""
+        from p2pmicrogrid_tpu.cli import main
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_params_init
+        from p2pmicrogrid_tpu.train.checkpoint import (
+            checkpoint_dir,
+            save_checkpoint,
+        )
+
+        cfg = _cfg("ddpg", share_across_agents=True)
+        ps = ddpg_params_init(cfg.ddpg, A, jax.random.PRNGKey(0))
+        model_dir = str(tmp_path / "models")
+        setting = f"{cfg.setting}-x2-shared"
+        save_checkpoint(
+            checkpoint_dir(model_dir, setting, "ddpg"), ps, episode=5
+        )
+        out_dir = str(tmp_path / "bundle")
+        rc = main([
+            "export-bundle", "--agents", str(A), "--implementation", "ddpg",
+            "--scenarios", "2", "--shared", "--share-agents",
+            "--model-dir", model_dir, "--out", out_dir,
+        ])
+        assert rc == 0
+        manifest, params = load_policy_bundle(out_dir)
+        assert manifest["model"]["share_across_agents"] is True
+        assert params["Dense_0"]["kernel"].ndim == 2  # no [A] broadcast
+        engine = PolicyEngine(bundle_dir=out_dir)
+        obs = _obs(4)
+        np.testing.assert_allclose(
+            engine.act(obs), _reference_actions(cfg, ps, obs), rtol=1e-6
+        )
+
+
+class TestBundleSchema:
+    def test_exported_bundle_validates(self, tmp_path):
+        import importlib.util
+        import os
+
+        cfg = _cfg("tabular")
+        export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "check_artifacts_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        problems: list = []
+        mod.check_bundle_dir(str(tmp_path / "b"), problems)
+        assert problems == []
+        # And a corrupted manifest is caught.
+        m = json.loads((tmp_path / "b" / "manifest.json").read_text())
+        del m["implementation"]
+        m["kind"] = "something_else"
+        (tmp_path / "b" / "manifest.json").write_text(json.dumps(m))
+        problems = []
+        mod.check_bundle_dir(str(tmp_path / "b"), problems)
+        assert any("kind" in p for p in problems)
+        assert any("implementation" in p for p in problems)

@@ -374,6 +374,114 @@ class TestHealthIntegration:
         assert any(r["kind"] == "progress" for r in recs)
 
 
+class TestReplayFillGauge:
+    def test_fill_fraction_over_replay_carriers(self):
+        from p2pmicrogrid_tpu.models.replay import (
+            lockstep_replay_add,
+            lockstep_replay_init,
+        )
+        from p2pmicrogrid_tpu.telemetry import replay_fill_fraction
+
+        replay = lockstep_replay_init(2, 3, capacity=4)
+        assert float(replay_fill_fraction(replay)) == 0.0
+        for _ in range(2):
+            replay = lockstep_replay_add(
+                replay,
+                jnp.zeros((2, 3, 4)), jnp.zeros((2, 3, 1)),
+                jnp.zeros((2, 3)), jnp.zeros((2, 3, 4)),
+            )
+        assert float(replay_fill_fraction(replay)) == pytest.approx(0.5)
+        # Wrapped carriers (DDPGScenState-style .replay field) resolve too.
+        from p2pmicrogrid_tpu.parallel.scenarios import DDPGScenState
+
+        scen = DDPGScenState(replay=replay, ou=jnp.zeros((2, 3)))
+        assert float(replay_fill_fraction(scen)) == pytest.approx(0.5)
+        # Stateless learners report None so callers skip the gauge.
+        assert replay_fill_fraction(None) is None
+        from p2pmicrogrid_tpu.models.tabular import tabular_init
+
+        assert replay_fill_fraction(tabular_init(default_config().qlearning, 2)) is None
+
+
+class TestSharedEpisodeCounters:
+    def test_shared_training_scan_collects_counters(self):
+        """make_shared_episode_fn(collect_device_metrics=True): the TRAINING
+        slot scan accumulates the same in-program counters the greedy eval
+        collects (ROADMAP open item)."""
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.parallel import (
+            init_shared_state,
+            make_scenario_traces,
+            stack_scenario_arrays,
+        )
+        from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+        from p2pmicrogrid_tpu.train import make_policy
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=3, n_scenarios=2),
+            train=TrainConfig(implementation="tabular"),
+        )
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        traces = make_scenario_traces(cfg, seed=0)
+        arrays = stack_scenario_arrays(cfg, traces, ratings)
+        policy = make_policy(cfg)
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        fn = make_shared_episode_fn(
+            cfg, policy, arrays, ratings, collect_device_metrics=True
+        )
+        (ps, _), ys = fn((ps, scen), jax.random.PRNGKey(1))
+        assert len(ys) == 3
+        d = dc_to_dict(ys[2])
+        assert d["nonfinite_q"] == 0 and d["nonfinite_loss"] == 0
+        assert d["comfort_violations"] >= 0
+        assert d["market_residual_wh"] > 0.0  # a day of grid settlement
+        # The default (collect off) keeps the 2-tuple contract.
+        fn2 = make_shared_episode_fn(cfg, policy, arrays, ratings)
+        _, ys2 = fn2((ps, scen), jax.random.PRNGKey(1))
+        assert len(ys2) == 2
+
+
+class TestCompareRuns:
+    def _make_run(self, root, name, counter, git_rev):
+        tel = Telemetry.create(name, root=str(root))
+        tel.manifest["config_hash"] = "abc123"
+        tel.manifest["git_rev"] = git_rev
+        import json as _json
+        import os as _os
+
+        with open(_os.path.join(tel.run_dir, "manifest.json"), "w") as f:
+            _json.dump(tel.manifest, f)
+        tel.counter("train.episodes", counter)
+        tel.gauge("replay.fill_fraction", 0.25)
+        with tel.span("train_block"):
+            pass
+        tel.close()
+        return tel.run_dir
+
+    def test_compare_runs_diffs_and_keys_identity(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry.report import compare_runs
+
+        a = self._make_run(tmp_path, "a", counter=10, git_rev="rev-a")
+        b = self._make_run(tmp_path, "b", counter=25, git_rev="rev-b")
+        text = compare_runs(a, b)
+        assert "config_hash" in text and "match" in text
+        assert "git_rev" in text and "DIFFERS" in text
+        assert "train.episodes" in text
+        assert "+15" in text  # counter delta
+        assert "replay.fill_fraction" in text
+        assert "train_block" in text
+
+    def test_cli_compare(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+
+        a = self._make_run(tmp_path, "a", counter=1, git_rev="r")
+        b = self._make_run(tmp_path, "b", counter=2, git_rev="r")
+        assert main(["telemetry-report", "--compare", a, b]) == 0
+        out = capsys.readouterr().out
+        assert "identity" in out and "counters" in out
+        assert main(["telemetry-report", "--compare", a, str(tmp_path / "x")]) == 1
+
+
 class TestReport:
     def test_render_run_smoke(self, tmp_path):
         tel = Telemetry.create("report-test", root=str(tmp_path))
@@ -382,6 +490,8 @@ class TestReport:
         tel.event("basin_alert", episode=10, greedy_cost_eur=-400.0,
                   greedy_reward=-1500.0)
         tel.counter("device.comfort_violations", 7)
+        tel.histogram("serve.batch_ms", 1.5)
+        tel.histogram("serve.batch_ms", 2.5)
         with tel.span("train_block"):
             pass
         tel.close()
@@ -392,6 +502,7 @@ class TestReport:
         assert "manifest" in text
         assert "BASIN ALERTS" in text and "10" in text
         assert "device.comfort_violations" in text
+        assert "serve.batch_ms" in text  # histogram stats render too
         assert "train_block" in text
 
     def test_cli_telemetry_report(self, tmp_path, capsys):

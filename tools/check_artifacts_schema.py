@@ -25,6 +25,15 @@ the contracts executable:
   ``histograms``/``spans`` objects; ``trace.json`` (when present) must be a
   Chrome trace object with a ``traceEvents`` list.
 
+* Policy bundles (``bundles/*/`` and ``artifacts/bundles/*/``, the serving
+  export format of serve/export.py): ``manifest.json`` must declare
+  ``kind: "policy_bundle"`` with an integer ``format_version``, a known
+  ``implementation``, the obs/action spec objects and a ``params_file``
+  that exists next to it.
+
+* Serve-bench captures (``artifacts/SERVE_*.jsonl``): metric rows, same
+  schema as the bench captures.
+
 Exit status: 0 when everything validates, 1 with one problem per line on
 stderr otherwise. Stdlib-only — runs with the accelerator stack down.
 """
@@ -113,6 +122,66 @@ def check_metric_jsonl(path: str, problems: list) -> None:
         check_metric_row(row, f"{where}:{i + 1}", problems)
 
 
+BUNDLE_IMPLEMENTATIONS = ("tabular", "dqn", "ddpg")
+BUNDLE_MANIFEST_KEYS = {
+    "format_version": int,
+    "implementation": str,
+    "created": str,
+    "n_agents": int,
+    "dtype": str,
+    "params_file": str,
+    "obs_spec": dict,
+    "action_spec": dict,
+    "model": dict,
+}
+
+
+def check_bundle_dir(bundle_dir: str, problems: list) -> None:
+    """Validate one policy-bundle directory (serve/export.py layout)."""
+    where = os.path.relpath(bundle_dir)
+    mpath = os.path.join(bundle_dir, "manifest.json")
+    if not os.path.exists(mpath):
+        problems.append(f"{where}: missing manifest.json")
+        return
+    try:
+        with open(mpath) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError) as err:
+        problems.append(f"{where}/manifest.json: unreadable ({err})")
+        return
+    if not isinstance(m, dict):
+        problems.append(f"{where}/manifest.json: not an object")
+        return
+    if m.get("kind") != "policy_bundle":
+        problems.append(
+            f"{where}/manifest.json: kind is {m.get('kind')!r}, "
+            "expected 'policy_bundle'"
+        )
+    for key, typ in BUNDLE_MANIFEST_KEYS.items():
+        if key not in m:
+            problems.append(f"{where}/manifest.json: missing key {key!r}")
+        elif not isinstance(m[key], typ) or isinstance(m[key], bool):
+            problems.append(
+                f"{where}/manifest.json: key {key!r} has type "
+                f"{type(m[key]).__name__}"
+            )
+    if m.get("implementation") not in BUNDLE_IMPLEMENTATIONS:
+        problems.append(
+            f"{where}/manifest.json: unknown implementation "
+            f"{m.get('implementation')!r}"
+        )
+    if isinstance(m.get("obs_spec"), dict) and m["obs_spec"].get("dim") != 4:
+        problems.append(
+            f"{where}/manifest.json: obs_spec.dim is "
+            f"{m['obs_spec'].get('dim')!r}, expected 4"
+        )
+    pfile = m.get("params_file")
+    if isinstance(pfile, str) and not os.path.exists(
+        os.path.join(bundle_dir, pfile)
+    ):
+        problems.append(f"{where}: params_file {pfile!r} does not exist")
+
+
 def check_run_dir(run_dir: str, problems: list) -> None:
     where = os.path.relpath(run_dir)
     mpath = os.path.join(run_dir, "manifest.json")
@@ -189,15 +258,20 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
     problems: list = []
     for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json"))):
         check_bench_capture(path, problems, strict_tail=strict_tail)
-    for path in sorted(
-        glob.glob(os.path.join(repo_root, "artifacts", "BENCH_*.jsonl"))
-    ):
-        check_metric_jsonl(path, problems)
+    for pattern in ("BENCH_*.jsonl", "SERVE_*.jsonl"):
+        for path in sorted(
+            glob.glob(os.path.join(repo_root, "artifacts", pattern))
+        ):
+            check_metric_jsonl(path, problems)
     for run_dir in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "runs", "*"))
     ):
         if os.path.isdir(run_dir):
             check_run_dir(run_dir, problems)
+    for root in ("bundles", os.path.join("artifacts", "bundles")):
+        for bundle_dir in sorted(glob.glob(os.path.join(repo_root, root, "*"))):
+            if os.path.isdir(bundle_dir):
+                check_bundle_dir(bundle_dir, problems)
     return problems
 
 
@@ -221,9 +295,12 @@ def main(argv=None) -> int:
         print(p, file=sys.stderr)
     n_bench = len(glob.glob(os.path.join(root, "BENCH_*.json")))
     n_runs = len(glob.glob(os.path.join(root, "artifacts", "runs", "*")))
+    n_bundles = len(
+        glob.glob(os.path.join(root, "bundles", "*"))
+    ) + len(glob.glob(os.path.join(root, "artifacts", "bundles", "*")))
     print(
-        f"checked {n_bench} bench captures, {n_runs} telemetry runs: "
-        f"{len(problems)} problem(s)"
+        f"checked {n_bench} bench captures, {n_runs} telemetry runs, "
+        f"{n_bundles} policy bundles: {len(problems)} problem(s)"
     )
     return 1 if problems else 0
 
