@@ -22,6 +22,11 @@ Subcommands:
             manifest, metric events, device counters, spans) into a
             human-readable summary; --compare A B diffs two runs keyed by
             their manifests' config_hash/git_rev
+  telemetry-query
+            SQL over the telemetry warehouse in a results DB: the default
+            join links telemetry runs to eval runs on config_hash (one JSON
+            object per row); --sql runs arbitrary queries over the
+            telemetry_runs/telemetry_points/telemetry_spans/eval_runs tables
   export-bundle
             freeze a checkpoint's greedy parameters into a versioned
             policy bundle for serving (serve/export.py)
@@ -287,9 +292,13 @@ def cmd_train(args) -> int:
         device_ctx = _cpu_placement_ctx()
 
     print(f"setting: {cfg.setting} ({cfg.train.implementation})")
-    from p2pmicrogrid_tpu.telemetry import Telemetry
+    from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
 
-    tel = Telemetry.maybe_create("train", cfg=cfg)
+    # With a results DB, the run's telemetry ALSO streams into its SQLite
+    # warehouse tables (keyed by config_hash) — the join target for the eval
+    # rows the same DB collects (`telemetry-query`).
+    extra_sinks = [SqliteSink(args.results_db)] if args.results_db else []
+    tel = Telemetry.maybe_create("train", cfg=cfg, extra_sinks=extra_sinks)
     if tel is not None:
         print(f"telemetry run: {tel.run_dir}")
     try:
@@ -700,12 +709,16 @@ def _cmd_eval_multi(args) -> int:
         print(f"day {d}: community costs {per_c} €")
 
     if args.results_db:
+        from p2pmicrogrid_tpu.telemetry import config_hash, git_rev
+
         store = ResultsStore(args.results_db)
+        rev = git_rev()
         for c in range(C):
             out_c = jax.tree_util.tree_map(lambda x: x[:, :, c], outputs)
             arrays_c = jax.tree_util.tree_map(lambda x: x[:, c], day_arrays)
             save_eval_outputs(
-                store, f"{setting}-c{c}", impl, args.test, days, out_c, arrays_c
+                store, f"{setting}-c{c}", impl, args.test, days, out_c,
+                arrays_c, config_hash=config_hash(cfg), git_rev=rev,
             )
         print(f"results ({C} communities) -> {args.results_db}")
     return 0
@@ -812,6 +825,8 @@ def cmd_eval(args) -> int:
         print(f"day {d}: community cost {c:+.3f} €")
 
     if args.results_db:
+        from p2pmicrogrid_tpu.telemetry import config_hash, git_rev
+
         store = ResultsStore(args.results_db)
         save_eval_outputs(
             store,
@@ -821,6 +836,10 @@ def cmd_eval(args) -> int:
             days,
             outputs,
             day_arrays,
+            # Registers the eval in eval_runs under the config identity —
+            # the anchor `telemetry-query` joins telemetry runs against.
+            config_hash=config_hash(cfg),
+            git_rev=git_rev(),
         )
         print(f"results -> {args.results_db}")
     if args.figures_dir:
@@ -1211,13 +1230,23 @@ def cmd_serve_bench(args) -> int:
     row with every stat. Without ``--bundle``, a fresh-init bundle for the
     configured setting is exported to a temp dir first — the zero-to-SLO
     smoke path on hosts with no trained checkpoint.
+
+    With ``--results-db``, the run also streams into the SQLite telemetry
+    warehouse: per-request ``serve_request`` trace records (enqueue->
+    dispatch wait, bucket, padding, batch service span), the per-bucket
+    compile profiles and the metric rows — keyed by the bundle's
+    config_hash, so serve SLOs are one SQL join away from the training
+    telemetry and eval rows of the same config (``telemetry-query``).
     """
     from p2pmicrogrid_tpu.serve import PolicyEngine, export_policy_bundle, serve_bench
     from p2pmicrogrid_tpu.telemetry import (
+        SqliteSink,
         Telemetry,
         guarded_stdout_sink,
+        run_manifest,
         set_current,
     )
+    from p2pmicrogrid_tpu.telemetry.registry import run_stamp
 
     cfg = _build_cfg(args)
     with guarded_stdout_sink() as sink:
@@ -1241,12 +1270,28 @@ def cmd_serve_bench(args) -> int:
                 file=sys.stderr,
                 flush=True,
             )
-        tel = Telemetry(run_id="serve-bench", sinks=[sink])
+        # The stdout sink carries ONLY metric rows (the driver contract);
+        # event-stream records (per-request traces, compile profiles) go to
+        # the telemetry's own sinks — the SQLite warehouse when requested.
+        tel_sinks = []
+        if args.results_db:
+            tel_sinks.append(SqliteSink(args.results_db))
+        tel = Telemetry(
+            run_id=f"serve-bench-{run_stamp()}",
+            sinks=tel_sinks,
+            manifest=run_manifest(cfg),
+        )
         set_current(tel)
         try:
             engine = PolicyEngine(
                 bundle_dir=bundle, max_batch=args.max_batch, telemetry=tel
             )
+            # Serve rows join on the BUNDLE's training config identity: the
+            # engine serves the exported checkpoint's config, which may
+            # differ from the CLI flags' freshly built cfg.
+            bundle_hash = engine.manifest.get("config_hash")
+            if bundle_hash:
+                tel.annotate_manifest(config_hash=bundle_hash)
             serve_bench(
                 engine,
                 rate_hz=args.rate,
@@ -1255,10 +1300,11 @@ def cmd_serve_bench(args) -> int:
                 max_wait_s=args.max_wait_ms / 1e3,
                 seed=args.bench_seed,
                 slo_ms=args.slo_ms,
-                emit=tel.emit,
+                emit=lambda row: (sink.emit(row), tel.emit(row)),
             )
         finally:
             set_current(None)
+            tel.close()
     return 0
 
 
@@ -1301,6 +1347,79 @@ def cmd_telemetry_report(args) -> int:
     return 0
 
 
+def cmd_telemetry_query(args) -> int:
+    """Query the SQLite telemetry warehouse.
+
+    Default query: the config-hash join — every (telemetry run, eval run)
+    pair sharing a ``config_hash``, with the run's point/gauge counts and
+    the eval's total cost; ``--gauges`` inlines each joined run's gauge
+    points (compile profiles, throughput, replay saturation). ``--sql``
+    runs arbitrary read-only SQL instead. Output: one JSON object per row
+    (machine-greppable, like the bench suites).
+    """
+    import sqlite3
+
+    from p2pmicrogrid_tpu.data.results import (
+        TELEMETRY_JOIN_SQL,
+        TELEMETRY_SCHEMA_VERSION,
+    )
+
+    # Read-only open: querying must never create a DB, run migrations, or
+    # let --sql mutate the warehouse.
+    try:
+        con = sqlite3.connect(f"file:{args.results_db}?mode=ro", uri=True)
+    except sqlite3.Error as err:
+        print(f"cannot open {args.results_db}: {err}", file=sys.stderr)
+        return 1
+
+    def select(sql, params=()):
+        cur = con.execute(sql, params)
+        cols = [d[0] for d in cur.description] if cur.description else []
+        return [dict(zip(cols, r)) for r in cur.fetchall()]
+
+    try:
+        if args.sql:
+            rows = select(args.sql)
+        else:
+            rows = select(TELEMETRY_JOIN_SQL)
+            if args.gauges:
+                for row in rows:
+                    row["gauges"] = {
+                        g["name"]: g["value"]
+                        for g in select(
+                            "SELECT name, value FROM telemetry_points "
+                            "WHERE run_id = ? AND kind = 'gauge' "
+                            "AND name IS NOT NULL ORDER BY seq",
+                            (row["run_id"],),
+                        )
+                    }
+        for row in rows:
+            print(json.dumps(row, default=float))
+        if not rows and not args.sql:
+            (n_runs,) = con.execute(
+                "SELECT COUNT(*) FROM telemetry_runs"
+            ).fetchone()
+            (n_evals,) = con.execute(
+                "SELECT COUNT(*) FROM eval_runs"
+            ).fetchone()
+            print(
+                f"no joined rows: {n_runs} telemetry run(s), {n_evals} eval "
+                f"run(s), no config_hash overlap (schema v"
+                f"{TELEMETRY_SCHEMA_VERSION}). Train with --results-db to "
+                "stream telemetry; eval with --results-db to register the "
+                "join anchor.",
+                file=sys.stderr,
+            )
+    except sqlite3.Error as err:
+        # Covers bad --sql, a pre-warehouse DB (no telemetry tables), and
+        # write attempts through --sql (readonly database).
+        print(f"SQL error: {err}", file=sys.stderr)
+        return 1
+    finally:
+        con.close()
+    return 0
+
+
 def cmd_analyse(args) -> int:
     from p2pmicrogrid_tpu.analysis import (
         plot_cost_comparison,
@@ -1319,6 +1438,20 @@ def cmd_analyse(args) -> int:
 
     store = ResultsStore(args.results_db)
     out = statistical_tests(store)
+    # Telemetry warehouse digest rides along when the DB carries runs: the
+    # config-hash join links each telemetry run to its eval rows (the full
+    # row stream is `telemetry-query`).
+    n_tel = store.con.execute("SELECT COUNT(*) FROM telemetry_runs").fetchone()[0]
+    if n_tel:
+        out["telemetry"] = {
+            "runs": int(n_tel),
+            "points": int(
+                store.con.execute(
+                    "SELECT COUNT(*) FROM telemetry_points"
+                ).fetchone()[0]
+            ),
+            "joined_eval_rows": store.query_telemetry_joined(),
+        }
     print(json.dumps(out, indent=2, default=float))
     if args.figures_dir:
         import os
@@ -1692,6 +1825,23 @@ def main(argv=None) -> int:
                         "observations (default 0; --seed stays the model "
                         "config seed)")
     p.set_defaults(fn=cmd_serve_bench)
+
+    p = sub.add_parser(
+        "telemetry-query",
+        help="query the SQLite telemetry warehouse: default is the "
+             "config-hash join of telemetry runs to eval runs, one JSON "
+             "object per row; --sql runs arbitrary SQL",
+    )
+    p.add_argument("--results-db", required=True)
+    p.add_argument("--sql",
+                   help="run this SQL instead of the default join "
+                        "(tables: telemetry_runs, telemetry_points, "
+                        "telemetry_spans, eval_runs + the classic results "
+                        "tables)")
+    p.add_argument("--gauges", action="store_true",
+                   help="inline each joined run's gauge points "
+                        "(profile.*, train.*, replay.*) into its row")
+    p.set_defaults(fn=cmd_telemetry_query)
 
     p = sub.add_parser("analyse", help="statistics + figures from a results DB")
     p.add_argument("--results-db", required=True)

@@ -15,6 +15,7 @@ The loggers accept numpy arrays straight from the simulator's ``SlotOutputs``
 from __future__ import annotations
 
 import sqlite3
+import time as _time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -71,12 +72,101 @@ _DDL = [
         PRIMARY KEY (setting, implementation, episode))""",
 ]
 
+# --- telemetry warehouse ----------------------------------------------------
+#
+# The observability half of the store (ISSUE 3): telemetry runs stream into
+# the SAME SQLite file the eval/bench rows land in, keyed by the run
+# manifest's config_hash/git_rev, so one SQL join links a training run's
+# telemetry to its eval results. Versioned via ``PRAGMA user_version`` so a
+# pre-warehouse results DB migrates in place on open (CREATE IF NOT EXISTS
+# is additive only; bumping TELEMETRY_SCHEMA_VERSION must come with a
+# migration branch in ``ensure_telemetry_schema``).
+
+TELEMETRY_SCHEMA_VERSION = 1
+
+_TELEMETRY_DDL = [
+    # One row per telemetry run: the manifest identity columns are promoted
+    # for joining/filtering; the full manifest rides along as JSON.
+    """CREATE TABLE IF NOT EXISTS telemetry_runs
+       (run_id text PRIMARY KEY, created text, config_hash text,
+        git_rev text, setting text, backend text, device_kind text,
+        device_count integer, process_count integer, mesh_shape text,
+        mesh_axis_names text, manifest_json text)""",
+    # Every streamed event plus the exploded close-time aggregates: kind is
+    # the event kind ('counter'/'gauge'/'histogram' for aggregates, 'metric'
+    # for bench rows, the raw event kind otherwise); name/value carry the
+    # queryable scalar, attrs_json everything else.
+    """CREATE TABLE IF NOT EXISTS telemetry_points
+       (run_id text NOT NULL REFERENCES telemetry_runs(run_id),
+        seq integer NOT NULL, ts real, kind text NOT NULL, name text,
+        value real, attrs_json text,
+        PRIMARY KEY (run_id, seq))""",
+    # Completed timing spans (start is run-relative perf_counter seconds).
+    """CREATE TABLE IF NOT EXISTS telemetry_spans
+       (run_id text NOT NULL REFERENCES telemetry_runs(run_id),
+        seq integer NOT NULL, name text NOT NULL, start_s real,
+        duration_s real, depth integer, meta_json text,
+        PRIMARY KEY (run_id, seq))""",
+    # Eval-run registry: the join anchor on the results side. The per-slot
+    # eval tables carry no config identity (reference schema); this row
+    # binds a (setting, implementation) eval to the config_hash/git_rev the
+    # telemetry manifest also carries.
+    """CREATE TABLE IF NOT EXISTS eval_runs
+       (setting text NOT NULL, implementation text NOT NULL,
+        is_testing integer NOT NULL, config_hash text, git_rev text,
+        n_days integer, total_cost_eur real, created text NOT NULL,
+        PRIMARY KEY (setting, implementation, is_testing))""",
+    """CREATE INDEX IF NOT EXISTS idx_telemetry_points_kind
+       ON telemetry_points(kind, name)""",
+    """CREATE INDEX IF NOT EXISTS idx_telemetry_runs_config
+       ON telemetry_runs(config_hash)""",
+]
+
+
+def ensure_telemetry_schema(con: sqlite3.Connection) -> int:
+    """Create or migrate the telemetry warehouse tables on ``con``.
+
+    Idempotent; safe on a fresh DB, a legacy (pre-warehouse) results DB and
+    an already-current one. Returns the schema version now in effect.
+    """
+    (version,) = con.execute("PRAGMA user_version").fetchone()
+    for ddl in _TELEMETRY_DDL:
+        con.execute(ddl)
+    if version < TELEMETRY_SCHEMA_VERSION:
+        # v0 -> v1 is pure table creation; future bumps branch on `version`
+        # here with ALTER TABLE migrations.
+        con.execute(f"PRAGMA user_version = {TELEMETRY_SCHEMA_VERSION}")
+    con.commit()
+    return TELEMETRY_SCHEMA_VERSION
+
+
+# The default telemetry-query join (cli.py `telemetry-query`): one row per
+# (telemetry run, eval run) pair sharing a config_hash, with the run's gauge
+# points aggregated alongside the eval cost.
+TELEMETRY_JOIN_SQL = """
+SELECT t.run_id, t.config_hash, t.git_rev,
+       t.setting AS telemetry_setting, t.backend, t.device_count,
+       t.mesh_shape,
+       e.setting AS eval_setting, e.implementation, e.is_testing,
+       e.n_days, e.total_cost_eur,
+       (SELECT COUNT(*) FROM telemetry_points p
+         WHERE p.run_id = t.run_id) AS n_points,
+       (SELECT COUNT(*) FROM telemetry_points p
+         WHERE p.run_id = t.run_id AND p.kind = 'gauge') AS n_gauges
+FROM telemetry_runs t
+JOIN eval_runs e ON e.config_hash = t.config_hash
+ORDER BY t.run_id, e.setting
+"""
+
 
 class ResultsStore:
     """Thin, explicit wrapper over an SQLite results database."""
 
     def __init__(self, path: str = ":memory:"):
         self.con = sqlite3.connect(path)
+        # WAL lets a SqliteSink stream telemetry while a reader (analyse /
+        # telemetry-query) has the same file open; a no-op on :memory:.
+        self.con.execute("PRAGMA journal_mode=WAL")
         self.create_tables()
 
     # -- lifecycle ---------------------------------------------------------
@@ -89,6 +179,7 @@ class ResultsStore:
             self.con.commit()
         finally:
             cur.close()
+        ensure_telemetry_schema(self.con)
 
     def close(self) -> None:
         self.con.close()
@@ -317,6 +408,66 @@ class ResultsStore:
                 records,
             )
 
+    # -- telemetry warehouse -------------------------------------------------
+
+    def log_eval_run(
+        self,
+        setting: str,
+        implementation: str,
+        is_testing: bool,
+        config_hash: Optional[str] = None,
+        git_rev: Optional[str] = None,
+        n_days: Optional[int] = None,
+        total_cost_eur: Optional[float] = None,
+    ) -> None:
+        """Register an eval run's config identity — the join anchor that
+        links its per-slot rows to any telemetry run sharing the
+        config_hash."""
+        with self.con:
+            self.con.execute(
+                "INSERT OR REPLACE INTO eval_runs VALUES (?,?,?,?,?,?,?,?)",
+                (
+                    setting, implementation, int(bool(is_testing)),
+                    config_hash, git_rev,
+                    None if n_days is None else int(n_days),
+                    None if total_cost_eur is None else float(total_cost_eur),
+                    _time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                ),
+            )
+
+    def get_eval_runs(self):
+        return self._read("eval_runs")
+
+    def get_telemetry_runs(self):
+        return self._read("telemetry_runs")
+
+    def get_telemetry_points(self, run_id: Optional[str] = None):
+        if run_id is None:
+            return self._read("telemetry_points")
+        return self._read("telemetry_points", "WHERE run_id = ?", (run_id,))
+
+    def get_telemetry_spans(self, run_id: Optional[str] = None):
+        if run_id is None:
+            return self._read("telemetry_spans")
+        return self._read("telemetry_spans", "WHERE run_id = ?", (run_id,))
+
+    def query_telemetry_joined(self) -> list:
+        """Telemetry runs joined to eval runs on config_hash, as a list of
+        dicts (``TELEMETRY_JOIN_SQL``) — the warehouse's headline query."""
+        cur = self.con.execute(TELEMETRY_JOIN_SQL)
+        cols = [d[0] for d in cur.description]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
+
+    def get_run_gauges(self, run_id: str) -> dict:
+        """{name: last value} of a run's streamed gauge points."""
+        rows = self.con.execute(
+            "SELECT name, value FROM telemetry_points "
+            "WHERE run_id = ? AND kind = 'gauge' AND name IS NOT NULL "
+            "ORDER BY seq",
+            (run_id,),
+        ).fetchall()
+        return {name: value for name, value in rows}
+
     # -- readers (database.py:212-345) --------------------------------------
 
     def _read(self, table: str, where: str = "", params: tuple = ()):
@@ -354,13 +505,25 @@ def save_eval_outputs(
     days: np.ndarray,
     outputs,
     arrays_per_day,
+    config_hash: Optional[str] = None,
+    git_rev: Optional[str] = None,
 ) -> None:
     """Persist ``evaluate_community`` outputs for every day in one call
     (the reference's save_community_results, community.py:341-361).
 
     outputs: SlotOutputs with leaves [D, T, ...]; arrays_per_day: EpisodeArrays
     with leaves [D, T, ...] (for the load/pv traces).
+
+    ``config_hash``/``git_rev`` additionally register the eval in
+    ``eval_runs`` so telemetry runs of the same config join against it.
     """
+    if config_hash is not None or git_rev is not None:
+        store.log_eval_run(
+            setting, implementation, is_testing,
+            config_hash=config_hash, git_rev=git_rev,
+            n_days=int(np.asarray(days).shape[0]),
+            total_cost_eur=float(np.asarray(outputs.cost).sum()),
+        )
     for i, day in enumerate(np.asarray(days).tolist()):
         store.log_run_results(
             setting,

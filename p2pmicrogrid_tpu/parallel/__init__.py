@@ -18,6 +18,8 @@ from p2pmicrogrid_tpu.parallel.mesh import (
     hybrid_scenario_sharding,
     make_hybrid_mesh,
     make_mesh,
+    mesh_counter_sum,
+    mesh_manifest,
     scenario_sharding,
     replicated_sharding,
     shard_scen_state,
@@ -43,6 +45,8 @@ __all__ = [
     "hybrid_scenario_sharding",
     "make_hybrid_mesh",
     "make_mesh",
+    "mesh_counter_sum",
+    "mesh_manifest",
     "shard_scen_state",
     "scenario_sharding",
     "replicated_sharding",

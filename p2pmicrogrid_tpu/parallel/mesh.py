@@ -97,6 +97,69 @@ def hybrid_scenario_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
 
+def mesh_manifest(mesh: Mesh) -> dict:
+    """Telemetry-manifest identity of a mesh: the full shape and axis names,
+    not just a device count (an 8-device run may be [8], [2, 4] or [4, 2] —
+    collective cost and the DCN/ICI split differ; the manifest must say
+    which)."""
+    return {
+        "mesh_shape": [int(s) for s in mesh.devices.shape],
+        "mesh_axis_names": [str(a) for a in mesh.axis_names],
+        "mesh_device_count": int(mesh.devices.size),
+    }
+
+
+# jax.jit caches by callable identity, so the jitted reduction program is
+# cached here per (mesh, tree structure, leaf avals) — without this every
+# call would re-trace and re-compile the psum program, paying on the host
+# exactly the overhead the in-program reduction exists to avoid.
+_COUNTER_SUM_CACHE: dict = {}
+
+
+def mesh_counter_sum(tree, mesh: Mesh):
+    """Global sum of per-device partial counters, reduced IN-PROGRAM.
+
+    ``tree`` leaves carry a leading per-device axis of length
+    ``mesh.devices.size`` (one partial per device, mesh-major order). The
+    reduction is a jitted ``shard_map`` whose body psums over EVERY mesh
+    axis, so on a pod the cross-host all-reduce happens over ICI/DCN before
+    the single replicated scalar crosses the host link — the multi-host
+    metric-aggregation recipe (ROADMAP) — instead of shipping one partial
+    per process for a host-side sum.
+
+    Returns the tree with global-total scalar leaves (replicated over the
+    mesh), preserving each leaf's dtype.
+    """
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    key = (
+        mesh,
+        treedef,
+        tuple(
+            (np.shape(l), np.asarray(l).dtype if not hasattr(l, "dtype")
+             else l.dtype)
+            for l in leaves
+        ),
+    )
+    fn = _COUNTER_SUM_CACHE.get(key)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map
+
+        axes = tuple(mesh.axis_names)
+
+        def body(t):
+            # Each shard holds [size/n_devices, ...] partials: reduce the
+            # local slice, then psum across the whole mesh.
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x.sum(axis=0), axes), t
+            )
+
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(axes), out_specs=P()))
+        _COUNTER_SUM_CACHE[key] = fn
+    return fn(tree)
+
+
 def scenario_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
     """Shard the leading (scenario) axis across the mesh; all trailing axes
     replicated."""

@@ -1139,6 +1139,14 @@ def train_scenarios_chunked(
             "episode program; a custom episode_fn/runner must apply its own "
             "sharding constraints (device_episode_arrays(scenario_sharding=))"
         )
+    if telemetry is not None and scenario_sharding is not None:
+        # Sharded runs record the mesh IDENTITY, not just a device count:
+        # the in-program counter totals below all-reduce over this mesh
+        # (jnp.sum over scenario-sharded arrays lowers to a psum across it),
+        # and [2, 4] vs [8] changes what that collective costs.
+        from p2pmicrogrid_tpu.parallel.mesh import mesh_manifest
+
+        telemetry.annotate_manifest(**mesh_manifest(scenario_sharding.mesh))
     warmup_fn = None
     # Collection is only switched on for the DEFAULT-built episode program:
     # a caller-prebuilt episode_fn fixes its own output arity, and building

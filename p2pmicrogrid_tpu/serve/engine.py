@@ -113,6 +113,10 @@ class PolicyEngine:
         self._act_raw = self._build_act_fn()
         # One jitted callable; XLA caches one executable per bucket shape.
         self._act_jit = jax.jit(self._act_raw)
+        # Profiled warmups stash the AOT executable per bucket here; the act
+        # path prefers it (the AOT and jit-call caches are separate, so this
+        # is what keeps compile-profiling from compiling every bucket twice).
+        self._compiled: dict = {}
         self._step_jit = jax.jit(self._step_fn, donate_argnums=(1,))
         self.stats = {"batches": 0, "rows": 0, "padded_rows": 0}
 
@@ -206,13 +210,41 @@ class PolicyEngine:
         compile inside its latency. ``include_step`` also compiles the
         session-step executable per bucket (a separate XLA program) — a
         controller loop's first ``step()`` must not compile in-slot;
-        act-only callers (serve-bench) pass False and skip that cost."""
+        act-only callers (serve-bench) pass False and skip that cost.
+
+        With a ``telemetry`` attached (and ``P2P_PROFILE`` not 0), each
+        bucket's program is also compile-profiled: HLO flops/bytes and the
+        executable's buffer sizes land as ``profile.serve_bucket_<b>.*``
+        gauges plus a ``compile_profile`` event — the per-bucket cost model
+        next to the measured ``serve.batch_ms`` latencies."""
         import jax
 
+        profile = False
+        if self.telemetry is not None:
+            from p2pmicrogrid_tpu.telemetry.profiling import (
+                profile_and_compile,
+                profiling_enabled,
+            )
+
+            profile = profiling_enabled()
         warmed = []
         for b in buckets if buckets is not None else self.buckets:
             obs = np.zeros((b, self.n_agents, 4), dtype=np.float32)
-            jax.block_until_ready(self._act_jit(self.params, obs))
+            if profile:
+                # One AOT compile serves both the profile and the bucket's
+                # executable (stashed for the act path) — the AOT and
+                # jit-call caches are separate, so profiling via the jit
+                # wrapper would compile each bucket twice.
+                compiled, _ = profile_and_compile(
+                    self._act_jit, self.params, obs,
+                    label=f"serve_bucket_{b}", telemetry=self.telemetry,
+                    extra={"bucket": b, "n_agents": self.n_agents},
+                )
+                if compiled is not self._act_jit:
+                    self._compiled[b] = compiled
+                jax.block_until_ready(compiled(self.params, obs))
+            else:
+                jax.block_until_ready(self._act_jit(self.params, obs))
             if include_step:
                 jax.block_until_ready(
                     self._step_jit(self.params, self.init_sessions(b), obs)[1]
@@ -253,7 +285,10 @@ class PolicyEngine:
             pad = np.zeros((bucket - b,) + obs.shape[1:], dtype=obs.dtype)
             obs = np.concatenate([obs, pad], axis=0)
         t0 = time.perf_counter()
-        out = self._act_jit(self.params, obs)
+        # Prefer the bucket's AOT executable from a profiled warmup (same
+        # program; avoids a cold jit-cache compile next to it).
+        act = self._compiled.get(bucket, self._act_jit)
+        out = act(self.params, obs)
         jax.block_until_ready(out)
         secs = time.perf_counter() - t0
         self.stats["rows"] += b
@@ -378,13 +413,48 @@ class MicroBatchQueue:
                 batch = self._pending[: self.max_batch]
                 del self._pending[: self.max_batch]
             try:
+                dispatch_t = time.monotonic()
                 out = self.engine.act(np.stack([row for row, _, _ in batch]))
+                service_s = time.monotonic() - dispatch_t
                 for i, (_, fut, _) in enumerate(batch):
                     fut.set_result(np.asarray(out[i]))
             except Exception as err:  # noqa: BLE001 — fail the waiters, not the loop
                 for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(err)
+                continue
+            try:
+                # AFTER result delivery, and fenced off: a sink hiccup (a
+                # locked warehouse DB, full disk) must not fail waiters whose
+                # inference succeeded, nor stall the next dispatch's results.
+                self._trace(batch, dispatch_t, service_s)
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
+
+    def _trace(self, batch, dispatch_t: float, service_s: float) -> None:
+        """Per-request trace records through the engine's telemetry: the
+        enqueue->dispatch coalescing wait, the bucket the batch padded to,
+        and the shared batch-service span — the queueing story serve-bench
+        models on a virtual clock, measured live here."""
+        tel = self.engine.telemetry
+        if tel is None:
+            return
+        n = len(batch)
+        bucket = self.engine.bucket_for(n)
+        for row_i, (_, _, t_enq) in enumerate(batch):
+            wait_ms = (dispatch_t - t_enq) * 1e3
+            tel.histogram("serve.queue_wait_ms", wait_ms)
+            tel.event(
+                "serve_request",
+                source="queue",
+                row=row_i,
+                batch_size=n,
+                bucket=bucket,
+                padded_rows=bucket - n,
+                wait_ms=round(wait_ms, 3),
+                service_ms=round(service_s * 1e3, 3),
+                latency_ms=round(wait_ms + service_s * 1e3, 3),
+            )
 
     def close(self) -> None:
         with self._cv:

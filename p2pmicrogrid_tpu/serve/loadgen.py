@@ -29,7 +29,7 @@ headline row carrying every stat).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -51,6 +51,12 @@ class LoadgenResult:
     batch_sizes: List[int]
     bucket_sizes: List[int]
     makespan_s: float            # first arrival -> last completion
+    # Full batch schedule (per batch): first request index, dispatch instant
+    # and measured/modeled service seconds — enough to reconstruct every
+    # request's enqueue->dispatch wait for the per-request trace records.
+    batch_starts: List[int] = field(default_factory=list)
+    dispatch_s: List[float] = field(default_factory=list)
+    service_s: List[float] = field(default_factory=list)
 
     @property
     def n_requests(self) -> int:
@@ -94,6 +100,9 @@ def plan_open_loop(
     latencies = np.zeros(n)
     batch_sizes: List[int] = []
     bucket_sizes: List[int] = []
+    batch_starts: List[int] = []
+    dispatch_s: List[float] = []
+    service_s: List[float] = []
     free = 0.0
     i = 0
     while i < n:
@@ -105,10 +114,14 @@ def plan_open_loop(
             # Filled before the window closed: dispatch at the filling
             # arrival (or the moment the server frees, whichever is later).
             dispatch = max(free, arrivals[j - 1])
-        done = dispatch + service_time_fn(i, j)
+        service = service_time_fn(i, j)
+        done = dispatch + service
         latencies[i:j] = done - arrivals[i:j]
         batch_sizes.append(j - i)
         bucket_sizes.append(bucket_fn(j - i))
+        batch_starts.append(i)
+        dispatch_s.append(float(dispatch))
+        service_s.append(float(service))
         free = done
         i = j
     return LoadgenResult(
@@ -116,6 +129,9 @@ def plan_open_loop(
         batch_sizes=batch_sizes,
         bucket_sizes=bucket_sizes,
         makespan_s=float(free - arrivals[0]),
+        batch_starts=batch_starts,
+        dispatch_s=dispatch_s,
+        service_s=service_s,
     )
 
 
@@ -128,6 +144,33 @@ def synthetic_obs(n: int, n_agents: int, seed: int = 0) -> np.ndarray:
     obs[..., 0] = rng.uniform(0.0, 1.0, (n, n_agents))
     obs[..., 1:] = rng.uniform(-1.0, 1.0, (n, n_agents, 3))
     return obs
+
+
+def _emit_request_traces(tel, arrivals: np.ndarray, result: LoadgenResult) -> None:
+    """One ``serve_request`` event per request from the replayed batch
+    schedule (same fields as ``MicroBatchQueue``'s live traces, plus the
+    virtual-clock arrival/dispatch instants)."""
+    for b, start in enumerate(result.batch_starts):
+        size = result.batch_sizes[b]
+        bucket = result.bucket_sizes[b]
+        dispatch = result.dispatch_s[b]
+        service_ms = result.service_s[b] * 1e3
+        for r in range(start, start + size):
+            wait_ms = (dispatch - arrivals[r]) * 1e3
+            tel.event(
+                "serve_request",
+                source="loadgen",
+                request=r,
+                batch=b,
+                batch_size=size,
+                bucket=bucket,
+                padded_rows=bucket - size,
+                arrival_s=round(float(arrivals[r]), 6),
+                dispatch_s=round(dispatch, 6),
+                wait_ms=round(wait_ms, 3),
+                service_ms=round(service_ms, 3),
+                latency_ms=round(float(result.latencies_s[r]) * 1e3, 3),
+            )
 
 
 def serve_bench(
@@ -184,6 +227,15 @@ def serve_bench(
             max_wait_s=max_wait_s,
             bucket_fn=engine.bucket_for,
         )
+
+    if tel.sinks:
+        # Per-request trace records through the run's sinks (the SQLite
+        # warehouse when serve-bench got --results-db): every request's
+        # enqueue->dispatch wait, its batch's bucket/padding and the shared
+        # service span — the raw rows behind the percentile summary, SQL-
+        # queryable next to training telemetry. Skipped sink-less: the
+        # records would go nowhere.
+        _emit_request_traces(tel, arrivals, result)
 
     p50, p95, p99 = (result.latency_ms(q) for q in (50, 95, 99))
     waste = result.padding_waste

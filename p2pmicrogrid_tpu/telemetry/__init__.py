@@ -7,17 +7,27 @@ from p2pmicrogrid_tpu.telemetry.device_metrics import (
     DeviceCounters,
     dc_add,
     dc_from_slot,
+    dc_mesh_sum,
+    dc_psum,
     dc_to_dict,
     dc_zero,
     replay_fill_fraction,
 )
+from p2pmicrogrid_tpu.telemetry.profiling import (
+    compiled_metrics,
+    profile_and_compile,
+    profile_jitted,
+    profiling_enabled,
+)
 from p2pmicrogrid_tpu.telemetry.registry import (
     JsonlSink,
     MemorySink,
+    SqliteSink,
     StdoutSink,
     Telemetry,
     config_hash,
     current,
+    git_rev,
     guarded_stdout_sink,
     phase_timings,
     run_manifest,
@@ -29,16 +39,24 @@ __all__ = [
     "DeviceCounters",
     "dc_add",
     "dc_from_slot",
+    "dc_mesh_sum",
+    "dc_psum",
     "dc_to_dict",
     "dc_zero",
     "replay_fill_fraction",
+    "compiled_metrics",
+    "profile_and_compile",
+    "profile_jitted",
+    "profiling_enabled",
     "phase_timings",
     "JsonlSink",
     "MemorySink",
+    "SqliteSink",
     "StdoutSink",
     "Telemetry",
     "config_hash",
     "current",
+    "git_rev",
     "guarded_stdout_sink",
     "run_manifest",
     "set_current",

@@ -103,6 +103,27 @@ def replay_fill_fraction(state):
     return jnp.asarray(count, jnp.float32) / float(capacity)
 
 
+def dc_psum(dc: DeviceCounters, axis_names) -> DeviceCounters:
+    """All-reduce a counter pytree across mesh axes INSIDE a collective
+    context (``shard_map``/``pmap`` body): each device's partial totals
+    become the global totals before anything reaches the host. ``axis_names``
+    is a mesh axis name or tuple of them."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.psum(x, axis_names), dc
+    )
+
+
+def dc_mesh_sum(dc: DeviceCounters, mesh) -> DeviceCounters:
+    """Reduce per-device partial counters (leaves ``[n_devices, ...]``,
+    mesh-major) to replicated global totals in ONE jitted device program —
+    the pod-scale front of ``dc_to_dict``: psum over the mesh first, then
+    transfer a handful of replicated scalars. See
+    ``parallel.mesh.mesh_counter_sum``."""
+    from p2pmicrogrid_tpu.parallel.mesh import mesh_counter_sum
+
+    return mesh_counter_sum(dc, mesh)
+
+
 def dc_to_dict(dc: DeviceCounters) -> dict:
     """Reduce a (possibly still device-resident) counter pytree to host
     Python numbers — the once-per-device-call transfer."""

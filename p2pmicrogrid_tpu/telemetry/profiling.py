@@ -1,0 +1,157 @@
+"""Compile-time profiling hooks: what did XLA actually build?
+
+Wall-clock spans say how long a program ran; nothing so far said what the
+compiler produced — how many flops the episode scan's HLO costs, how many
+bytes it touches, or how much buffer memory the executable reserves. Those
+numbers come for free from the AOT API (``jitted.lower(...).compile()``):
+
+* ``compiled.cost_analysis()``    HLO-level flop and bytes-accessed totals
+                                  (per-op properties summed by XLA).
+* ``compiled.memory_analysis()``  the executable's buffer-assignment sizes:
+                                  argument/output/temp/alias bytes and
+                                  generated code size — ``peak_bytes`` below
+                                  is their sum, the executable's live-buffer
+                                  peak estimate.
+
+``profile_jitted`` lowers + compiles a jitted callable for concrete example
+arguments and logs the numbers as ``profile.<label>.*`` gauges plus one
+``compile_profile`` event, so they stream into the telemetry warehouse
+(``SqliteSink``) and render in ``telemetry-report``. The hook costs one AOT
+compile per (function, shape) — callers gate it behind an attached telemetry
+and the ``P2P_PROFILE=0`` kill switch, and wrap it in try/except: profiling
+must never take down a training or serving run.
+
+Wired at the two hot seams: the training episode scan
+(``train/loop.py:train_community`` profiles the fused train block) and each
+serve padding bucket (``serve/engine.py:PolicyEngine.warmup``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# cost_analysis keys worth warehousing (XLA emits dozens of per-opcode
+# properties; these are the stable cross-backend ones).
+_COST_KEYS = {
+    "flops": "flops",
+    "bytes accessed": "bytes_accessed",
+    "transcendentals": "transcendentals",
+}
+
+_MEMORY_ATTRS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def profiling_enabled() -> bool:
+    """Compile profiling kill switch (``P2P_PROFILE=0`` disables)."""
+    return os.environ.get("P2P_PROFILE", "").lower() not in (
+        "0", "off", "false"
+    )
+
+
+def compiled_metrics(compiled) -> dict:
+    """Flatten a ``jax.stages.Compiled``'s cost/memory analyses into one
+    metrics dict. Missing analyses (backends without the query) degrade to
+    an empty/partial dict — never raise."""
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        # Historical API drift: some jax versions return [dict], others dict.
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        for key, name in _COST_KEYS.items():
+            if isinstance(ca, dict) and key in ca:
+                out[name] = float(ca[key])
+    except Exception:  # noqa: BLE001 — analysis is best-effort
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            peak = 0.0
+            for attr in _MEMORY_ATTRS:
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    out[attr.replace("_in_bytes", "_bytes")] = float(v)
+                    peak += float(v)
+            # Buffer-assignment live peak estimate: everything the
+            # executable reserves (args + outputs + temps + aliased + code).
+            out["peak_bytes"] = peak
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def profile_jitted(
+    jitted,
+    *args,
+    label: str,
+    telemetry=None,
+    extra: Optional[dict] = None,
+    **kwargs,
+) -> dict:
+    """AOT-compile ``jitted`` for ``*args`` and warehouse its compile costs.
+
+    Returns the metrics dict (empty when the callable has no AOT surface or
+    every analysis is unavailable). With ``telemetry``: each metric lands as
+    a ``profile.<label>.<metric>`` gauge and one ``compile_profile`` event
+    (kind-tagged, so the SQLite warehouse keeps it queryable next to the
+    run's spans). ``extra`` fields ride along on the event only.
+    """
+    if not hasattr(jitted, "lower"):
+        return {}
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+    except Exception:  # noqa: BLE001 — profiling must never break the run
+        return {}
+    metrics = compiled_metrics(compiled)
+    _log(metrics, label, telemetry, extra)
+    return metrics
+
+
+def profile_and_compile(
+    jitted,
+    *args,
+    label: str,
+    telemetry=None,
+    extra: Optional[dict] = None,
+):
+    """``profile_jitted`` that hands back the compiled executable.
+
+    The AOT path and the jit call cache are SEPARATE in jax: profiling via
+    ``lower().compile()`` and then calling ``jitted(...)`` compiles the
+    program twice. For a big program (the fused episode scan) that doubles
+    startup, so callers that control their call site take the
+    ``jax.stages.Compiled`` from here and invoke it directly (same shapes/
+    dtypes as the example args — exactly the train loop's contract).
+
+    Returns ``(compiled_or_jitted, metrics)``; on any failure the original
+    jitted callable comes back with ``{}`` so the caller's path is unchanged.
+    """
+    if not hasattr(jitted, "lower"):
+        return jitted, {}
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception:  # noqa: BLE001 — profiling must never break the run
+        return jitted, {}
+    metrics = compiled_metrics(compiled)
+    _log(metrics, label, telemetry, extra)
+    return compiled, metrics
+
+
+def _log(metrics: dict, label: str, telemetry, extra: Optional[dict]) -> None:
+    if telemetry is None or not metrics:
+        return
+    try:
+        for name, value in metrics.items():
+            telemetry.gauge(f"profile.{label}.{name}", value)
+        telemetry.event(
+            "compile_profile", label=label, **metrics, **(extra or {})
+        )
+    except Exception:  # noqa: BLE001 — a dead sink must not fail the caller
+        pass

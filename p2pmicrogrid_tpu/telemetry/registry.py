@@ -111,6 +111,220 @@ class MemorySink:
         pass
 
 
+class SqliteSink:
+    """Stream telemetry into the results store's warehouse tables.
+
+    Events become ``telemetry_points`` rows (one per record; ``metric``
+    rows from the bench suites keep their metric name/value as the point's
+    name/value), ``close()``-time aggregates explode into ``counter``/
+    ``gauge``/``histogram`` points, and completed spans land in
+    ``telemetry_spans`` — all keyed by the run's manifest identity row in
+    ``telemetry_runs`` (config_hash/git_rev), so ONE SQL join links a run's
+    telemetry to the eval/bench rows living in the same SQLite file
+    (``data/results.py:TELEMETRY_JOIN_SQL``).
+
+    Inserts are buffered and written in batches (``executemany`` every
+    ``batch`` records and on close); the connection runs in WAL mode and is
+    lock-guarded, so the serve engine's microbatch worker thread can emit
+    concurrently with the main thread.
+    """
+
+    def __init__(self, path: str, batch: int = 64):
+        import threading
+
+        self.path = path
+        self.batch = max(1, int(batch))
+        self._con = None
+        self._lock = threading.Lock()
+        self._run_id: Optional[str] = None
+        self._manifest: dict = {}
+        self._seq = 0
+        self._span_seq = 0
+        self._points: list = []
+        self._registered = False
+
+    # -- wiring -------------------------------------------------------------
+
+    def _connect(self):
+        if self._con is None:
+            import sqlite3
+
+            from p2pmicrogrid_tpu.data.results import ensure_telemetry_schema
+
+            # check_same_thread off: emits may arrive from the microbatch
+            # worker thread; every access below holds self._lock.
+            self._con = sqlite3.connect(self.path, check_same_thread=False)
+            self._con.execute("PRAGMA journal_mode=WAL")
+            ensure_telemetry_schema(self._con)
+        return self._con
+
+    def register_run(self, run_id: str, manifest: dict) -> None:
+        """Bind this sink to a run identity (called by ``Telemetry`` on
+        attach; re-registering upserts, so a manifest annotated mid-run —
+        e.g. with the mesh shape — refreshes its row on close)."""
+        self._run_id = run_id
+        self._manifest = dict(manifest or {})
+        with self._lock:
+            self._write_run_row()
+
+    def _write_run_row(self) -> None:
+        m = self._manifest
+        con = self._connect()
+        with con:
+            con.execute(
+                "INSERT OR REPLACE INTO telemetry_runs VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    self._run_id or "run",
+                    m.get("created"),
+                    m.get("config_hash"),
+                    m.get("git_rev"),
+                    m.get("setting"),
+                    m.get("backend"),
+                    m.get("device_kind"),
+                    m.get("device_count"),
+                    m.get("process_count"),
+                    _dumps(m["mesh_shape"]) if "mesh_shape" in m else None,
+                    _dumps(m["mesh_axis_names"])
+                    if "mesh_axis_names" in m else None,
+                    _dumps(m),
+                ),
+            )
+        self._registered = True
+
+    # -- event stream -------------------------------------------------------
+
+    @staticmethod
+    def _point_of(record: dict):
+        """(kind, name, value, attrs) split of one emitted record."""
+        rec = dict(record)
+        ts = rec.pop("ts", None)
+        if "metric" in rec and "value" in rec:
+            # Bench/serve metric rows (no 'kind'): queryable by metric name.
+            kind = rec.pop("kind", "metric")
+            name = rec.pop("metric")
+            value = rec.pop("value")
+        else:
+            kind = rec.pop("kind", "event")
+            name = rec.pop("name", None)
+            value = rec.pop("value", None)
+        try:
+            value = None if value is None else float(value)
+        except (TypeError, ValueError):
+            rec["value"] = value
+            value = None
+        return ts, kind, name, value, rec
+
+    def emit(self, record: dict) -> None:
+        if record.get("kind") == "summary":
+            # close() streams the monolithic summary event to every sink,
+            # then hands this sink the SAME aggregates via write_summary's
+            # typed explosion — storing the blob too would duplicate every
+            # aggregate as one unqueryable attrs_json row.
+            return
+        ts, kind, name, value, attrs = self._point_of(record)
+        with self._lock:
+            self._points.append(
+                (
+                    self._run_id or "run", self._seq, ts, str(kind), name,
+                    value, _dumps(attrs) if attrs else None,
+                )
+            )
+            self._seq += 1
+            if len(self._points) >= self.batch:
+                # A flush failure (locked/full DB) must not take down the
+                # instrumented run: drop the batch, warn once, keep going —
+                # close() retries whatever accumulates after.
+                try:
+                    self._flush_locked()
+                except Exception as err:  # noqa: BLE001
+                    self._points = []
+                    if not getattr(self, "_flush_warned", False):
+                        self._flush_warned = True
+                        print(
+                            f"SqliteSink: dropping telemetry points "
+                            f"({type(err).__name__}: {err})",
+                            file=sys.stderr,
+                        )
+
+    def _flush_locked(self) -> None:
+        if not self._registered:
+            self._write_run_row()
+        if not self._points:
+            return
+        con = self._connect()
+        with con:
+            # Plain INSERT: a (run_id, seq) collision means two runs share an
+            # id — raising (surfaced as the one-time drop warning in emit)
+            # beats OR REPLACE silently interleaving their rows.
+            con.executemany(
+                "INSERT INTO telemetry_points VALUES (?,?,?,?,?,?,?)",
+                self._points,
+            )
+        self._points = []
+
+    # -- close-time aggregates (called by Telemetry.close) -------------------
+
+    def write_summary(self, summary: dict) -> None:
+        """Explode the run's final aggregates into queryable points."""
+        ts = round(time.time(), 3)
+        for name, v in summary.get("counters", {}).items():
+            self.emit({"ts": ts, "kind": "counter", "name": name, "value": v})
+        for name, v in summary.get("gauges", {}).items():
+            self.emit({"ts": ts, "kind": "gauge", "name": name, "value": v})
+        for name, stats in summary.get("histograms", {}).items():
+            self.emit(
+                {"ts": ts, "kind": "histogram", "name": name,
+                 "value": stats.get("p50"), **stats}
+            )
+
+    def write_spans(self, recorder) -> None:
+        """Persist every completed span (``spans.SpanRecorder``)."""
+        rows = []
+        perf0 = getattr(recorder, "_perf0", 0.0)
+        for s in recorder.completed:
+            if s.end is None:
+                continue
+            rows.append(
+                (
+                    self._run_id or "run", self._span_seq, s.name,
+                    round(s.start - perf0, 6), round(s.end - s.start, 6),
+                    s.depth, _dumps(s.meta) if s.meta else None,
+                )
+            )
+            self._span_seq += 1
+        if not rows:
+            return
+        with self._lock:
+            if not self._registered:
+                self._write_run_row()
+            try:
+                con = self._connect()
+                with con:
+                    con.executemany(
+                        "INSERT INTO telemetry_spans VALUES (?,?,?,?,?,?,?)",
+                        rows,
+                    )
+            except Exception as err:  # noqa: BLE001 — close() must finish
+                print(
+                    f"SqliteSink: dropping telemetry spans "
+                    f"({type(err).__name__}: {err})",
+                    file=sys.stderr,
+                )
+
+    def close(self) -> None:
+        with self._lock:
+            # Re-upsert the run row so late manifest annotations (mesh
+            # shape, extra provenance) land in the warehouse.
+            if self._run_id is not None:
+                self._write_run_row()
+            self._flush_locked()
+            if self._con is not None:
+                self._con.close()
+                self._con = None
+                self._registered = False
+
+
 @contextlib.contextmanager
 def guarded_stdout_sink():
     """fd-level stdout hygiene for metric emission.
@@ -183,6 +397,12 @@ def phase_timings(label: str, spans=None) -> dict:
     if e is not None:
         out["execute_s"] = round(e, 3)
     return out
+
+
+def run_stamp() -> str:
+    """The time+pid suffix shared by every run id (``Telemetry.create`` and
+    the CLI's ad-hoc run ids must stay the same format)."""
+    return f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
 
 
 def config_hash(cfg) -> str:
@@ -269,6 +489,11 @@ class Telemetry:
         self._gauges: dict = {}
         self._hists: dict = {}
         self._closed = False
+        # Identity-aware sinks (SqliteSink) bind to the run manifest here so
+        # their warehouse rows carry config_hash/git_rev from the start.
+        for sink in self.sinks:
+            if hasattr(sink, "register_run"):
+                sink.register_run(self.run_id, self.manifest)
 
     # --- creation -----------------------------------------------------------
 
@@ -284,7 +509,7 @@ class Telemetry:
         """Create a run directory under ``root`` (default ``artifacts/runs``,
         overridable via ``P2P_TELEMETRY_DIR``) with manifest + JSONL sink."""
         root = root or os.environ.get("P2P_TELEMETRY_DIR") or DEFAULT_ROOT
-        run_id = f"{name}-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+        run_id = f"{name}-{run_stamp()}"
         run_dir = os.path.join(root, run_id)
         os.makedirs(run_dir, exist_ok=True)
         manifest = run_manifest(cfg, extra=extra_manifest)
@@ -301,6 +526,19 @@ class Telemetry:
         if os.environ.get("P2P_TELEMETRY", "").lower() in ("0", "off", "false"):
             return None
         return cls.create(name, cfg=cfg, **kw)
+
+    def annotate_manifest(self, **fields) -> None:
+        """Add identity fields discovered after creation (e.g. the mesh
+        shape once a sharded program is built): updates the in-memory
+        manifest, rewrites ``manifest.json`` and re-registers any
+        identity-aware sinks."""
+        self.manifest.update(fields)
+        if self.run_dir:
+            with open(os.path.join(self.run_dir, "manifest.json"), "w") as f:
+                json.dump(self.manifest, f, indent=2, default=_json_default)
+        for sink in self.sinks:
+            if hasattr(sink, "register_run"):
+                sink.register_run(self.run_id, self.manifest)
 
     # --- aggregates ---------------------------------------------------------
 
@@ -399,4 +637,10 @@ class Telemetry:
                 os.path.join(self.run_dir, "trace.json")
             )
         for sink in self.sinks:
+            # Structured aggregate dump for warehouse sinks: counters/gauges/
+            # histogram stats as typed points, spans as telemetry_spans rows.
+            if hasattr(sink, "write_summary"):
+                sink.write_summary(s)
+            if hasattr(sink, "write_spans"):
+                sink.write_spans(self.spans)
             sink.close()

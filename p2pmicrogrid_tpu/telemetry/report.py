@@ -25,23 +25,54 @@ def latest_run_dir(root: str) -> Optional[str]:
 
 
 def load_run(run_dir: str) -> dict:
-    """{"manifest": dict|None, "events": [dict], "summary": dict|None}."""
-    out: dict = {"run_dir": run_dir, "manifest": None, "events": [], "summary": None}
-    mpath = os.path.join(run_dir, "manifest.json")
-    if os.path.exists(mpath):
-        with open(mpath) as f:
-            out["manifest"] = json.load(f)
+    """{"manifest": dict|None, "events": [dict], "summary": dict|None,
+    "warnings": [str]}.
+
+    Degrades gracefully on empty or partially-written run directories — the
+    common shape of a crashed or still-running run: a truncated trailing
+    JSONL line (the process died mid-write) or a missing/unparseable
+    manifest/summary becomes a warning, never an exception, because a
+    partial record is exactly when the report matters most.
+    """
+    out: dict = {
+        "run_dir": run_dir, "manifest": None, "events": [], "summary": None,
+        "warnings": [],
+    }
+
+    def _load_json(path):
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError) as err:
+            out["warnings"].append(
+                f"unreadable {os.path.basename(path)} ({err}); skipped"
+            )
+            return None
+
+    out["manifest"] = _load_json(os.path.join(run_dir, "manifest.json"))
     jpath = os.path.join(run_dir, "metrics.jsonl")
     if os.path.exists(jpath):
-        with open(jpath) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    out["events"].append(json.loads(line))
-    spath = os.path.join(run_dir, "summary.json")
-    if os.path.exists(spath):
-        with open(spath) as f:
-            out["summary"] = json.load(f)
+        skipped = 0
+        try:
+            with open(jpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out["events"].append(json.loads(line))
+                    except json.JSONDecodeError:
+                        skipped += 1
+        except OSError as err:
+            out["warnings"].append(f"unreadable metrics.jsonl ({err})")
+        if skipped:
+            out["warnings"].append(
+                f"metrics.jsonl: skipped {skipped} truncated/non-JSON "
+                f"line(s) — partially written run?"
+            )
+    out["summary"] = _load_json(os.path.join(run_dir, "summary.json"))
     return out
 
 
@@ -59,12 +90,15 @@ def _table(rows, headers) -> str:
 def render_run(run_dir: str) -> str:
     data = load_run(run_dir)
     parts = [f"telemetry run: {run_dir}"]
+    for w in data["warnings"]:
+        parts.append(f"WARNING: {w}")
 
     m = data["manifest"]
     if m:
         keys = (
             "run_id", "created", "backend", "device_kind", "device_count",
-            "process_count", "config_hash", "setting", "git_rev", "jax",
+            "process_count", "mesh_shape", "mesh_axis_names",
+            "config_hash", "setting", "git_rev", "jax",
         )
         rows = [(k, m[k]) for k in keys if m.get(k) is not None]
         parts.append("\nmanifest\n" + _table(rows, ("field", "value")))
@@ -130,9 +164,34 @@ def render_run(run_dir: str) -> str:
             parts.append(
                 "\ncounters\n" + _table(sorted(other.items()), ("counter", "total"))
             )
-        if s.get("gauges"):
+        gauges = s.get("gauges", {})
+        profile = {k: v for k, v in gauges.items() if k.startswith("profile.")}
+        plain = {k: v for k, v in gauges.items() if not k.startswith("profile.")}
+        if plain:
             parts.append(
-                "\ngauges\n" + _table(sorted(s["gauges"].items()), ("gauge", "value"))
+                "\ngauges\n" + _table(sorted(plain.items()), ("gauge", "value"))
+            )
+        if profile:
+            # Compile-profile gauges (telemetry/profiling.py): one row per
+            # profiled program — HLO flops/bytes and the executable's peak
+            # buffer estimate.
+            progs: dict = {}
+            for k, v in profile.items():
+                _, label, metric = k.split(".", 2)
+                progs.setdefault(label, {})[metric] = v
+            rows = [
+                (
+                    label,
+                    _fmt_num(d.get("flops", "—")),
+                    _fmt_num(d.get("bytes_accessed", "—")),
+                    _fmt_num(d.get("peak_bytes", "—")),
+                )
+                for label, d in sorted(progs.items())
+            ]
+            parts.append(
+                "\ncompile profile (HLO cost / executable memory)\n"
+                + _table(rows, ("program", "flops", "bytes accessed",
+                                "peak bytes"))
             )
         hists = s.get("histograms", {})
         if hists:
@@ -199,10 +258,15 @@ def compare_runs(dir_a: str, dir_b: str) -> str:
     a, b = load_run(dir_a), load_run(dir_b)
     parts = [f"comparing A={dir_a}\n          B={dir_b}"]
 
+    for side, run in (("A", a), ("B", b)):
+        for w in run["warnings"]:
+            parts.append(f"WARNING ({side}): {w}")
+
     ma, mb = a["manifest"] or {}, b["manifest"] or {}
     rows = []
     for key in ("config_hash", "git_rev", "setting", "backend", "device_kind",
-                "device_count", "run_id", "created"):
+                "device_count", "mesh_shape", "mesh_axis_names",
+                "run_id", "created"):
         va, vb = ma.get(key), mb.get(key)
         if va is None and vb is None:
             continue

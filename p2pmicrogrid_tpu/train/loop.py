@@ -228,6 +228,7 @@ def train_community(
 
     import contextlib
 
+    profiled = False
     while episode < t.max_episodes:
         key, k_block = jax.random.split(key)
         # Clamp the final block so exactly max_episodes episodes run (a full
@@ -244,6 +245,26 @@ def train_community(
             to_boundary = t.save_episodes - episode % t.save_episodes
             step_size = min(step_size, to_boundary)
         step_fn = step_of(step_size)
+        if telemetry is not None and not profiled:
+            # Compile-profile the episode-scan program ONCE (HLO flops/bytes
+            # + executable buffer sizes -> profile.episode_scan.* gauges).
+            # The AOT-compiled executable replaces the jitted wrapper in the
+            # step cache — same shapes every call — so the profile costs no
+            # second compile. P2P_PROFILE=0 skips.
+            profiled = True
+            from p2pmicrogrid_tpu.telemetry.profiling import (
+                profile_and_compile,
+                profiling_enabled,
+            )
+
+            if profiling_enabled():
+                step_fn, _ = profile_and_compile(
+                    step_fn, pol_state, jnp.asarray(episode), k_block,
+                    label="episode_scan", telemetry=telemetry,
+                    extra={"episodes_per_block": step_size,
+                           "slots_per_episode": arrays.n_slots},
+                )
+                step_fns[step_size] = step_fn
         span = (
             telemetry.span("train_block", episode0=episode, episodes=step_size)
             if telemetry is not None

@@ -112,3 +112,93 @@ def test_bundle_dirs_scanned_by_check_all(checker, tmp_path):
     problems = checker.check_all(str(tmp_path))
     assert any("format_version" in p for p in problems)
     assert any("params_file" in p or "missing key" in p for p in problems)
+
+
+class TestResultsDbChecker:
+    """Telemetry warehouse validation: schema version + orphan-free FKs
+    (SQLite enforces neither on its own)."""
+
+    def test_warehouse_db_validates(self, checker, tmp_path):
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        db = str(tmp_path / "r.db")
+        tel = Telemetry(
+            run_id="run-1", sinks=[SqliteSink(db)],
+            manifest={"config_hash": "abc", "created": "t"},
+        )
+        tel.counter("c", 1)
+        with tel.span("s"):
+            pass
+        tel.close()
+        with ResultsStore(db) as store:
+            store.log_eval_run("s", "tabular", False, config_hash="abc")
+        problems = []
+        checker.check_results_db(db, problems)
+        assert problems == []
+
+    def test_version_in_sync_with_results_module(self, checker):
+        from p2pmicrogrid_tpu.data.results import TELEMETRY_SCHEMA_VERSION
+
+        assert (
+            checker.EXPECTED_TELEMETRY_SCHEMA_VERSION
+            == TELEMETRY_SCHEMA_VERSION
+        )
+
+    def test_orphaned_points_and_bad_version_flagged(self, checker, tmp_path):
+        import sqlite3
+
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        db = str(tmp_path / "r.db")
+        ResultsStore(db).close()
+        con = sqlite3.connect(db)
+        con.execute(
+            "INSERT INTO telemetry_points VALUES "
+            "('ghost-run', 0, 1.0, 'counter', 'c', 1.0, NULL)"
+        )
+        con.execute("PRAGMA user_version = 99")
+        con.commit()
+        con.close()
+        problems = []
+        checker.check_results_db(db, problems)
+        assert any("orphaned run_id" in p for p in problems)
+        assert any("schema version 99" in p for p in problems)
+
+    def test_pre_warehouse_db_passes(self, checker, tmp_path):
+        """A legacy results DB (no telemetry tables) is not an error."""
+        import sqlite3
+
+        db = str(tmp_path / "old.db")
+        con = sqlite3.connect(db)
+        con.execute("CREATE TABLE training_progress (x real)")
+        con.commit()
+        con.close()
+        problems = []
+        checker.check_results_db(db, problems)
+        assert problems == []
+
+    def test_non_sqlite_file_flagged(self, checker, tmp_path):
+        db = tmp_path / "junk.db"
+        db.write_text("this is not a database")
+        problems = []
+        checker.check_results_db(str(db), problems)
+        assert problems
+
+    def test_check_all_scans_dbs(self, checker, tmp_path):
+        import sqlite3
+
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        (tmp_path / "artifacts").mkdir()
+        db = str(tmp_path / "artifacts" / "results.db")
+        ResultsStore(db).close()
+        con = sqlite3.connect(db)
+        con.execute(
+            "INSERT INTO telemetry_spans VALUES "
+            "('ghost', 0, 's', 0.0, 1.0, 0, NULL)"
+        )
+        con.commit()
+        con.close()
+        problems = checker.check_all(str(tmp_path))
+        assert any("telemetry_spans" in p for p in problems)

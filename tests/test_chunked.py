@@ -611,6 +611,36 @@ class TestChunkedOnMesh:
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
             )
 
+    def test_sharded_run_records_mesh_identity_and_counters(self, tmp_path):
+        """A sharded chunked run with telemetry records the MESH identity
+        (shape + axis names, not just a device count) in its manifest, and
+        its in-scan counter totals — all-reduced across that mesh inside
+        the jitted program — reach the sink (ISSUE 3 / ROADMAP multi-host
+        aggregation item)."""
+        from p2pmicrogrid_tpu.parallel import init_shared_state
+        from p2pmicrogrid_tpu.parallel.mesh import make_mesh, scenario_sharding
+        from p2pmicrogrid_tpu.telemetry import MemorySink, Telemetry
+
+        cfg = _cfg(impl="tabular", S=8, A=3)
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        mesh = make_mesh()
+        sink = MemorySink()
+        tel = Telemetry(run_id="mesh-run", sinks=[sink])
+        train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=2,
+            scenario_sharding=scenario_sharding(mesh), telemetry=tel,
+        )
+        assert tel.manifest["mesh_shape"] == [mesh.devices.size]
+        assert tel.manifest["mesh_axis_names"] == ["data"]
+        dc_events = [
+            r for r in sink.records if r.get("kind") == "device_counters"
+        ]
+        assert len(dc_events) == 1
+        assert dc_events[0]["market_residual_wh"] > 0.0
+
     def test_sharded_composes_with_chunk_parallel(self):
         """scenario_sharding (each chunk's scenario axis over the mesh) and
         chunk_parallel (C chunks vmapped side by side) are orthogonal axes of

@@ -98,6 +98,42 @@ class TestTrainResume:
         assert data["2-multi-agent-com-rounds-1-hetero"]["train"] > 0
 
 
+class TestTelemetryWarehouse:
+    def test_train_eval_query_join_round_trip(self, tmp_path, monkeypatch, capsys):
+        """The warehouse loop end-to-end through the CLI: train streams
+        telemetry into the results DB, eval registers the join anchor, and
+        telemetry-query returns the joined row linking the run's gauges to
+        the eval cost by config_hash."""
+        monkeypatch.setenv("P2P_TELEMETRY", "1")
+        monkeypatch.setenv("P2P_TELEMETRY_DIR", str(tmp_path / "runs"))
+        db = str(tmp_path / "w.db")
+        common = [
+            "--agents", "2", "--episodes", "2", "--seed", "3",
+            "--results-db", db, "--model-dir", str(tmp_path / "m"),
+        ]
+        assert main(["train", *common]) == 0
+        assert main(["eval", *common]) == 0
+        capsys.readouterr()
+        assert main(["telemetry-query", "--results-db", db, "--gauges"]) == 0
+        rows = [
+            json.loads(l)
+            for l in capsys.readouterr().out.splitlines() if l.strip()
+        ]
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["config_hash"]
+        assert row["eval_setting"] == "2-multi-agent-com-rounds-1-hetero"
+        assert row["total_cost_eur"] is not None
+        # The training run's compile profile rode into the same store.
+        assert row["gauges"]["profile.episode_scan.flops"] > 0
+        # analyse surfaces the same join as a digest.
+        capsys.readouterr()
+        assert main(["analyse", "--results-db", db]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["telemetry"]["runs"] == 1
+        assert len(out["telemetry"]["joined_eval_rows"]) == 1
+
+
 class TestPlacement:
     def test_crossover_decisions(self):
         """Crossover-driven auto-placement (train/placement.py): CPU-wins

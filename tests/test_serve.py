@@ -394,6 +394,141 @@ class TestLoadgen:
             assert isinstance(r["vs_baseline"], (int, float))
 
 
+class TestServeTracing:
+    """Per-request trace records (ISSUE 3): enqueue->dispatch wait, bucket,
+    padding and batch service span flow through the telemetry sinks — into
+    the SQLite warehouse when one is attached."""
+
+    def test_queue_emits_per_request_traces(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry import MemorySink, Telemetry
+
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        sink = MemorySink()
+        tel = Telemetry(run_id="t", sinks=[sink])
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8, telemetry=tel)
+        engine.warmup(include_step=False)
+        obs = _obs(5)
+        with MicroBatchQueue(engine, max_wait_s=0.01) as q:
+            futs = [q.submit(obs[i]) for i in range(5)]
+            for fut in futs:
+                fut.result(timeout=30)
+        traces = [r for r in sink.records if r.get("kind") == "serve_request"]
+        assert len(traces) == 5
+        for t in traces:
+            assert t["source"] == "queue"
+            assert t["wait_ms"] >= 0
+            assert t["service_ms"] > 0
+            assert t["latency_ms"] >= t["wait_ms"]
+            assert t["bucket"] >= t["batch_size"]
+            assert t["padded_rows"] == t["bucket"] - t["batch_size"]
+        # The coalescing wait also aggregates as a histogram.
+        assert tel.summary()["histograms"]["serve.queue_wait_ms"]["count"] == 5
+
+    def test_plan_open_loop_records_batch_schedule(self):
+        arrivals = np.array([0.0, 0.0, 0.0, 0.0])
+        res = plan_open_loop(
+            arrivals, lambda i, j: 1.0, max_batch=2, max_wait_s=0.0
+        )
+        assert res.batch_starts == [0, 2]
+        assert res.service_s == [1.0, 1.0]
+        # Batch 2 dispatches when the server frees (t=1), not at arrival.
+        assert res.dispatch_s == [0.0, 1.0]
+
+    def test_serve_bench_traces_reach_sqlite_warehouse(self, tmp_path):
+        """Acceptance: serve-bench emits per-request trace records into the
+        same store training telemetry lands in."""
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import (
+            SqliteSink,
+            Telemetry,
+            set_current,
+        )
+
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        db = str(tmp_path / "r.db")
+        tel = Telemetry(
+            run_id="serve-test", sinks=[SqliteSink(db)],
+            manifest={"config_hash": "serve-cfg", "created": "t"},
+        )
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4, telemetry=tel)
+        set_current(tel)
+        try:
+            serve_bench(
+                engine, rate_hz=5000.0, n_requests=32, max_batch=4,
+                max_wait_s=0.001, seed=3, emit=tel.emit,
+            )
+        finally:
+            set_current(None)
+            tel.close()
+        with ResultsStore(db) as store:
+            traces = store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_points "
+                "WHERE kind='serve_request'"
+            ).fetchone()[0]
+            assert traces == 32
+            # The headline metric row is queryable next to the traces.
+            (p99,) = store.con.execute(
+                "SELECT value FROM telemetry_points "
+                "WHERE kind='metric' AND name='serve_bench'"
+            ).fetchone()
+            assert p99 > 0
+            # Per-bucket compile profiles (warmup hooks) landed as gauges.
+            buckets = store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_points WHERE kind='gauge' "
+                "AND name LIKE 'profile.serve_bucket_%.flops'"
+            ).fetchone()[0]
+            assert buckets >= 1
+
+    def test_sinkless_serve_bench_skips_traces(self, tmp_path):
+        """Without sinks (plain serve_bench call), no per-request events are
+        built — rows still come back."""
+        from p2pmicrogrid_tpu.telemetry import Telemetry, set_current
+
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+        tel = Telemetry(run_id="t")
+        set_current(tel)
+        try:
+            rows = serve_bench(
+                engine, rate_hz=5000.0, n_requests=16, max_batch=4,
+                max_wait_s=0.001, emit=None,
+            )
+        finally:
+            set_current(None)
+        assert rows[-1]["metric"] == "serve_bench"
+
+    def test_warmup_profiles_each_bucket(self, tmp_path):
+        """Acceptance: HLO flops + peak-memory gauges appear for at least
+        one serve padding bucket."""
+        from p2pmicrogrid_tpu.telemetry import Telemetry
+
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        tel = Telemetry(run_id="t")
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4, telemetry=tel)
+        engine.warmup(include_step=False)
+        g = tel.summary()["gauges"]
+        for b in (1, 2, 4):
+            assert g[f"profile.serve_bucket_{b}.flops"] > 0
+            assert g[f"profile.serve_bucket_{b}.peak_bytes"] > 0
+
+    def test_warmup_profile_kill_switch(self, tmp_path, monkeypatch):
+        from p2pmicrogrid_tpu.telemetry import Telemetry
+
+        monkeypatch.setenv("P2P_PROFILE", "0")
+        cfg = _cfg("tabular")
+        bundle = export_policy_bundle(cfg, _trained_state(cfg), str(tmp_path / "b"))
+        tel = Telemetry(run_id="t")
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4, telemetry=tel)
+        engine.warmup(include_step=False)
+        assert not any(
+            k.startswith("profile.") for k in tel.summary()["gauges"]
+        )
+
+
 class TestServeCli:
     def test_serve_bench_cli_one_json_per_line(self, capfd):
         from p2pmicrogrid_tpu.cli import main

@@ -482,6 +482,417 @@ class TestCompareRuns:
         assert main(["telemetry-report", "--compare", a, str(tmp_path / "x")]) == 1
 
 
+class TestSqliteSink:
+    """The telemetry warehouse: events/aggregates/spans stream into the
+    results store's SQLite tables, keyed by the manifest's config_hash so
+    one SQL join links a run's telemetry to its eval rows (ISSUE 3)."""
+
+    def _run(self, db, config_hash="cfg-1", run_id="run-1"):
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        tel = Telemetry(
+            run_id=run_id, sinks=[SqliteSink(db, batch=4)],
+            manifest={
+                "created": "2026-01-01T00:00:00", "config_hash": config_hash,
+                "git_rev": "rev-1", "setting": "2-agent", "backend": "cpu",
+                "device_count": 8,
+            },
+        )
+        tel.counter("device.comfort_violations", 7)
+        tel.gauge("profile.episode_scan.flops", 1234.0)
+        tel.histogram("serve.batch_ms", 1.5)
+        tel.histogram("serve.batch_ms", 2.5)
+        tel.event("device_counters", episode=0, phase="train", trade_wh=3.0)
+        tel.emit({"metric": "serve_bench", "value": 9.0, "unit": "ms",
+                  "vs_baseline": 1.1})
+        with tel.span("train_block", episodes=2):
+            pass
+        tel.close()
+        return tel
+
+    def test_round_trip_events_to_tables(self, tmp_path):
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        db = str(tmp_path / "r.db")
+        self._run(db)
+        with ResultsStore(db) as store:
+            runs = store.con.execute(
+                "SELECT run_id, config_hash, git_rev, setting "
+                "FROM telemetry_runs"
+            ).fetchall()
+            assert runs == [("run-1", "cfg-1", "rev-1", "2-agent")]
+            kinds = dict(
+                store.con.execute(
+                    "SELECT kind, COUNT(*) FROM telemetry_points "
+                    "GROUP BY kind"
+                ).fetchall()
+            )
+            # Streamed events + the close()-time aggregate explosion.
+            assert kinds["device_counters"] == 1
+            assert kinds["metric"] == 1
+            assert kinds["counter"] == 1
+            assert kinds["gauge"] == 1
+            assert kinds["histogram"] == 1
+            spans = store.con.execute(
+                "SELECT name, depth FROM telemetry_spans"
+            ).fetchall()
+            assert spans == [("train_block", 0)]
+            # The metric row kept its name/value as queryable columns.
+            (val,) = store.con.execute(
+                "SELECT value FROM telemetry_points "
+                "WHERE kind='metric' AND name='serve_bench'"
+            ).fetchone()
+            assert val == 9.0
+            assert store.get_run_gauges("run-1") == {
+                "profile.episode_scan.flops": 1234.0
+            }
+
+    def test_schema_version_migration_from_fresh_and_legacy_db(self, tmp_path):
+        import sqlite3
+
+        from p2pmicrogrid_tpu.data.results import (
+            TELEMETRY_SCHEMA_VERSION,
+            ResultsStore,
+            ensure_telemetry_schema,
+        )
+
+        # Fresh DB: open stamps the version and creates the tables.
+        db = str(tmp_path / "fresh.db")
+        with ResultsStore(db) as store:
+            (v,) = store.con.execute("PRAGMA user_version").fetchone()
+            assert v == TELEMETRY_SCHEMA_VERSION
+
+        # Legacy pre-warehouse DB (classic tables, version 0): migrates in
+        # place on open, keeping its rows.
+        legacy = str(tmp_path / "legacy.db")
+        con = sqlite3.connect(legacy)
+        con.execute(
+            "CREATE TABLE training_progress (setting text, "
+            "implementation text, episode integer, reward real, error real)"
+        )
+        con.execute(
+            "INSERT INTO training_progress VALUES ('s', 'tabular', 0, 1.0, 0.1)"
+        )
+        con.commit()
+        assert con.execute("PRAGMA user_version").fetchone() == (0,)
+        con.close()
+        with ResultsStore(legacy) as store:
+            assert store.con.execute(
+                "PRAGMA user_version"
+            ).fetchone() == (TELEMETRY_SCHEMA_VERSION,)
+            assert store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_runs"
+            ).fetchone() == (0,)
+            assert len(store.get_training_progress()) == 1
+            # Idempotent re-ensure.
+            assert ensure_telemetry_schema(store.con) == TELEMETRY_SCHEMA_VERSION
+
+    def test_join_telemetry_to_eval_on_config_hash(self, tmp_path):
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        db = str(tmp_path / "r.db")
+        self._run(db, config_hash="cfg-A", run_id="run-A")
+        self._run(db, config_hash="cfg-ORPHAN", run_id="run-orphan")
+        with ResultsStore(db) as store:
+            store.log_eval_run(
+                "2-agent", "tabular", False, config_hash="cfg-A",
+                git_rev="rev-1", n_days=2, total_cost_eur=-1.25,
+            )
+            rows = store.query_telemetry_joined()
+        # Exactly ONE joined row: the matching config_hash pair; the orphan
+        # run joins nothing.
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["run_id"] == "run-A"
+        assert row["config_hash"] == "cfg-A"
+        assert row["eval_setting"] == "2-agent"
+        assert row["total_cost_eur"] == pytest.approx(-1.25)
+        assert row["n_gauges"] == 1
+
+    def test_cli_telemetry_query_returns_joined_row(self, tmp_path, capsys):
+        """Acceptance: `telemetry-query` prints a single joined row linking
+        a training run's telemetry gauges to its eval result by
+        config_hash."""
+        from p2pmicrogrid_tpu.cli import main
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        db = str(tmp_path / "r.db")
+        self._run(db, config_hash="cfg-J", run_id="run-J")
+        with ResultsStore(db) as store:
+            store.log_eval_run(
+                "2-agent", "tabular", False, config_hash="cfg-J",
+                n_days=1, total_cost_eur=0.5,
+            )
+        assert main(["telemetry-query", "--results-db", db, "--gauges"]) == 0
+        lines = [
+            json.loads(l) for l in capsys.readouterr().out.splitlines() if l
+        ]
+        assert len(lines) == 1
+        assert lines[0]["config_hash"] == "cfg-J"
+        assert lines[0]["total_cost_eur"] == 0.5
+        assert lines[0]["gauges"]["profile.episode_scan.flops"] == 1234.0
+        # --sql escape hatch.
+        assert main([
+            "telemetry-query", "--results-db", db,
+            "--sql", "SELECT COUNT(*) AS n FROM telemetry_spans",
+        ]) == 0
+        out = capsys.readouterr().out
+        assert json.loads(out.splitlines()[-1])["n"] == 1
+
+    def test_sink_threaded_emit(self, tmp_path):
+        """The serve microbatch worker emits from its own thread; the sink
+        must not corrupt or drop rows."""
+        import threading
+
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        db = str(tmp_path / "r.db")
+        tel = Telemetry(run_id="t", sinks=[SqliteSink(db, batch=8)],
+                        manifest={"config_hash": "x", "created": "t"})
+
+        def emit_many(tag):
+            for i in range(50):
+                tel.event("serve_request", tag=tag, request=i)
+
+        threads = [
+            threading.Thread(target=emit_many, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tel.close()
+        with ResultsStore(db) as store:
+            (n,) = store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_points "
+                "WHERE kind='serve_request'"
+            ).fetchone()
+        assert n == 200
+
+
+class TestMeshCounters:
+    """Multi-host metric aggregation (ROADMAP): per-device partial counters
+    psum across the mesh INSIDE the jitted program — exercised on the
+    virtual 8-device CPU mesh."""
+
+    def _partials(self, n):
+        from p2pmicrogrid_tpu.telemetry import DeviceCounters
+
+        return DeviceCounters(
+            nonfinite_q=jnp.arange(n, dtype=jnp.int32),
+            nonfinite_loss=jnp.ones((n,), jnp.int32),
+            comfort_violations=jnp.full((n,), 2, jnp.int32),
+            market_residual_wh=jnp.arange(n, dtype=jnp.float32) * 1.5,
+            trade_wh=jnp.ones((n,), jnp.float32),
+        )
+
+    def test_mesh_sum_matches_host_sum_1d(self):
+        from p2pmicrogrid_tpu.parallel import make_mesh
+        from p2pmicrogrid_tpu.telemetry import dc_mesh_sum
+
+        mesh = make_mesh()
+        n = mesh.devices.size
+        tot = dc_mesh_sum(self._partials(n), mesh)
+        d = dc_to_dict(tot)
+        assert d["nonfinite_q"] == sum(range(n))
+        assert d["comfort_violations"] == 2 * n
+        assert d["market_residual_wh"] == pytest.approx(1.5 * sum(range(n)))
+        # The reduction ran in-program: the result is a replicated device
+        # array (every device holds the global total), not a host sum.
+        assert tot.nonfinite_q.sharding.is_fully_replicated
+
+    def test_mesh_sum_matches_host_sum_hybrid(self):
+        """The 2-D (dcn x data) pod mesh: psum spans BOTH axes."""
+        from p2pmicrogrid_tpu.parallel import make_hybrid_mesh
+        from p2pmicrogrid_tpu.telemetry import dc_mesh_sum
+
+        mesh = make_hybrid_mesh(dcn_size=2)
+        n = mesh.devices.size
+        d = dc_to_dict(dc_mesh_sum(self._partials(n), mesh))
+        assert d["nonfinite_q"] == sum(range(n))
+        assert d["trade_wh"] == pytest.approx(float(n))
+
+    def test_dc_psum_inside_shard_map(self):
+        """dc_psum is usable INSIDE a collective context: each shard
+        contributes its local partial and every shard sees the global
+        total."""
+        from functools import partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from p2pmicrogrid_tpu.parallel import make_mesh
+        from p2pmicrogrid_tpu.telemetry import dc_psum
+
+        mesh = make_mesh()
+        n = mesh.devices.size
+        dc = self._partials(n)
+
+        @jax.jit
+        @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P())
+        def global_totals(dc):
+            local = jax.tree_util.tree_map(lambda x: x.sum(axis=0), dc)
+            return dc_psum(local, "data")
+
+        d = dc_to_dict(global_totals(dc))
+        assert d["nonfinite_q"] == sum(range(n))
+
+    def test_mesh_manifest_records_shape_and_axes(self):
+        from p2pmicrogrid_tpu.parallel import make_hybrid_mesh, mesh_manifest
+
+        m = mesh_manifest(make_hybrid_mesh(dcn_size=2))
+        assert m["mesh_shape"] == [2, 4]
+        assert m["mesh_axis_names"] == ["dcn", "data"]
+        assert m["mesh_device_count"] == 8
+
+    def test_compare_identity_block_surfaces_mesh_shape(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry.report import compare_runs
+
+        dirs = []
+        for name, shape in (("a", [8]), ("b", [2, 4])):
+            tel = Telemetry.create(name, root=str(tmp_path))
+            tel.annotate_manifest(
+                mesh_shape=shape, mesh_axis_names=["data"], config_hash="h"
+            )
+            tel.close()
+            dirs.append(tel.run_dir)
+        text = compare_runs(*dirs)
+        assert "mesh_shape" in text
+        assert "[2, 4]" in text and "DIFFERS" in text
+
+
+class TestProfiling:
+    def test_profile_jitted_gauges_and_event(self):
+        from p2pmicrogrid_tpu.telemetry import MemorySink, profile_jitted
+
+        tel = Telemetry(run_id="t", sinks=[MemorySink()])
+        f = jax.jit(lambda x: jnp.tanh(x @ x.T).sum())
+        m = profile_jitted(
+            f, jnp.ones((16, 16)), label="unit", telemetry=tel,
+            extra={"note": "test"},
+        )
+        assert m["flops"] > 0
+        assert m["peak_bytes"] > 0
+        g = tel.summary()["gauges"]
+        assert g["profile.unit.flops"] == m["flops"]
+        assert g["profile.unit.peak_bytes"] == m["peak_bytes"]
+        events = [
+            r for r in tel.sinks[0].records if r["kind"] == "compile_profile"
+        ]
+        assert len(events) == 1 and events[0]["note"] == "test"
+
+    def test_profile_and_compile_returns_runnable_executable(self):
+        from p2pmicrogrid_tpu.telemetry import profile_and_compile
+
+        f = jax.jit(lambda x: x * 2.0)
+        x = jnp.arange(4, dtype=jnp.float32)
+        compiled, m = profile_and_compile(f, x, label="unit")
+        assert m["flops"] > 0
+        np.testing.assert_allclose(np.asarray(compiled(x)), np.arange(4) * 2.0)
+        # Non-jitted callables pass through untouched.
+        fn, m2 = profile_and_compile(lambda x: x, x, label="plain")
+        assert m2 == {} and fn(1) == 1
+
+    def test_kill_switch(self, monkeypatch):
+        from p2pmicrogrid_tpu.telemetry import profiling_enabled
+
+        monkeypatch.setenv("P2P_PROFILE", "0")
+        assert not profiling_enabled()
+        monkeypatch.setenv("P2P_PROFILE", "1")
+        assert profiling_enabled()
+
+    def test_train_community_profiles_episode_scan(self, tmp_path):
+        """Acceptance: HLO flops + peak-memory gauges appear for the
+        episode scan of a telemetry-attached training run."""
+        from p2pmicrogrid_tpu.data import synthetic_traces
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.train import (
+            init_policy_state,
+            make_policy,
+            train_community,
+        )
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=2),
+            train=TrainConfig(implementation="tabular", max_episodes=2),
+        )
+        traces = synthetic_traces(n_days=1, start_day=11).normalized()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        tel = Telemetry.create("profile-train", cfg=cfg, root=str(tmp_path))
+        train_community(
+            cfg, policy, ps, traces, ratings, jax.random.PRNGKey(0),
+            telemetry=tel,
+        )
+        tel.close()
+        summary = json.load(open(os.path.join(tel.run_dir, "summary.json")))
+        g = summary["gauges"]
+        assert g["profile.episode_scan.flops"] > 0
+        assert g["profile.episode_scan.peak_bytes"] > 0
+
+
+class TestReportDegradation:
+    def test_truncated_jsonl_line_skipped_with_warning(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry.report import load_run, render_run
+
+        tel = Telemetry.create("trunc", root=str(tmp_path))
+        tel.event("health", episode=0, greedy_cost_eur=1.0,
+                  greedy_reward=-1.0, status="healthy")
+        tel.close()
+        # Simulate a run killed mid-write: a truncated trailing line.
+        with open(os.path.join(tel.run_dir, "metrics.jsonl"), "a") as f:
+            f.write('{"ts": 1.0, "kind": "hea')
+        data = load_run(tel.run_dir)
+        assert any("truncated" in w for w in data["warnings"])
+        # The valid events still load; the render carries the warning.
+        assert any(e["kind"] == "health" for e in data["events"])
+        text = render_run(tel.run_dir)
+        assert "WARNING" in text and "health" in text
+
+    def test_empty_run_dir_renders(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry.report import render_run
+
+        run = tmp_path / "empty-run"
+        run.mkdir()
+        text = render_run(str(run))
+        assert "no manifest.json" in text
+
+    def test_corrupt_manifest_and_summary_warn(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry.report import load_run
+
+        run = tmp_path / "bad-run"
+        run.mkdir()
+        (run / "manifest.json").write_text("{not json")
+        (run / "summary.json").write_text("")
+        data = load_run(str(run))
+        assert data["manifest"] is None and data["summary"] is None
+        assert len(data["warnings"]) == 2
+
+    def test_cli_report_survives_partial_run(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+
+        run = tmp_path / "partial"
+        run.mkdir()
+        (run / "metrics.jsonl").write_text('{"ts": 1.0, "kind": "x"}\n{"tr')
+        assert main(["telemetry-report", str(run)]) == 0
+        out = capsys.readouterr().out
+        assert "WARNING" in out
+
+    def test_compare_with_partial_run(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry.report import compare_runs
+
+        tel = Telemetry.create("ok", root=str(tmp_path))
+        tel.counter("c", 1)
+        tel.close()
+        partial = tmp_path / "partial"
+        partial.mkdir()
+        (partial / "manifest.json").write_text("{broken")
+        text = compare_runs(tel.run_dir, str(partial))
+        assert "WARNING (B)" in text
+
+
 class TestReport:
     def test_render_run_smoke(self, tmp_path):
         tel = Telemetry.create("report-test", root=str(tmp_path))

@@ -34,6 +34,14 @@ the contracts executable:
 * Serve-bench captures (``artifacts/SERVE_*.jsonl``): metric rows, same
   schema as the bench captures.
 
+* Results databases (``*.db``/``*.sqlite`` at the root and under
+  ``artifacts/``): when a DB carries telemetry warehouse tables
+  (``data/results.py``), its ``PRAGMA user_version`` must match the
+  expected telemetry schema version, the three telemetry tables must all
+  exist together, and every ``telemetry_points``/``telemetry_spans`` row
+  must reference a ``telemetry_runs`` row (orphan-free foreign keys —
+  SQLite does not enforce them unless asked, so drift is silent).
+
 Exit status: 0 when everything validates, 1 with one problem per line on
 stderr otherwise. Stdlib-only — runs with the accelerator stack down.
 """
@@ -253,6 +261,79 @@ def check_run_dir(run_dir: str, problems: list) -> None:
             problems.append(f"{where}/trace.json: unreadable ({err})")
 
 
+# Keep in sync with p2pmicrogrid_tpu/data/results.py:TELEMETRY_SCHEMA_VERSION
+# (hardcoded so this tool stays stdlib-only and runs without the package).
+EXPECTED_TELEMETRY_SCHEMA_VERSION = 1
+
+_TELEMETRY_TABLES = ("telemetry_runs", "telemetry_points", "telemetry_spans")
+
+# Where results DBs live (shared by check_all and main's summary count).
+RESULTS_DB_GLOBS = (
+    "*.db", "*.sqlite",
+    os.path.join("artifacts", "*.db"), os.path.join("artifacts", "*.sqlite"),
+)
+
+
+def check_results_db(path: str, problems: list) -> None:
+    """Validate one results DB's telemetry warehouse tables."""
+    import sqlite3
+
+    where = os.path.relpath(path)
+    try:
+        con = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
+    except sqlite3.Error as err:
+        problems.append(f"{where}: unreadable ({err})")
+        return
+    try:
+        try:
+            tables = {
+                row[0]
+                for row in con.execute(
+                    "SELECT name FROM sqlite_master WHERE type='table'"
+                )
+            }
+        except sqlite3.DatabaseError as err:
+            problems.append(f"{where}: not a SQLite database ({err})")
+            return
+        present = [t for t in _TELEMETRY_TABLES if t in tables]
+        if not present:
+            return  # pre-warehouse DB: nothing to validate
+        missing = [t for t in _TELEMETRY_TABLES if t not in tables]
+        if missing:
+            problems.append(
+                f"{where}: telemetry tables incomplete — has "
+                f"{present}, missing {missing}"
+            )
+            return
+        (version,) = con.execute("PRAGMA user_version").fetchone()
+        if version != EXPECTED_TELEMETRY_SCHEMA_VERSION:
+            problems.append(
+                f"{where}: telemetry schema version {version}, expected "
+                f"{EXPECTED_TELEMETRY_SCHEMA_VERSION}"
+            )
+        for table in ("telemetry_points", "telemetry_spans"):
+            (orphans,) = con.execute(
+                f"SELECT COUNT(*) FROM {table} t WHERE NOT EXISTS "
+                "(SELECT 1 FROM telemetry_runs r WHERE r.run_id = t.run_id)"
+            ).fetchone()
+            if orphans:
+                problems.append(
+                    f"{where}: {orphans} {table} row(s) reference no "
+                    "telemetry_runs row (orphaned run_id)"
+                )
+        if "eval_runs" in tables:
+            (null_hash,) = con.execute(
+                "SELECT COUNT(*) FROM eval_runs WHERE config_hash IS NULL"
+            ).fetchone()
+            if null_hash:
+                problems.append(
+                    f"{where}: {null_hash} eval_runs row(s) carry no "
+                    "config_hash (unjoinable)"
+                )
+    finally:
+        con.close()
+
+
 def check_all(repo_root: str, strict_tail: bool = False) -> list:
     """All problems found under ``repo_root`` (empty list = clean)."""
     problems: list = []
@@ -272,6 +353,9 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
         for bundle_dir in sorted(glob.glob(os.path.join(repo_root, root, "*"))):
             if os.path.isdir(bundle_dir):
                 check_bundle_dir(bundle_dir, problems)
+    for pattern in RESULTS_DB_GLOBS:
+        for path in sorted(glob.glob(os.path.join(repo_root, pattern))):
+            check_results_db(path, problems)
     return problems
 
 
@@ -298,9 +382,13 @@ def main(argv=None) -> int:
     n_bundles = len(
         glob.glob(os.path.join(root, "bundles", "*"))
     ) + len(glob.glob(os.path.join(root, "artifacts", "bundles", "*")))
+    n_dbs = sum(
+        len(glob.glob(os.path.join(root, pat))) for pat in RESULTS_DB_GLOBS
+    )
     print(
         f"checked {n_bench} bench captures, {n_runs} telemetry runs, "
-        f"{n_bundles} policy bundles: {len(problems)} problem(s)"
+        f"{n_bundles} policy bundles, {n_dbs} results DBs: "
+        f"{len(problems)} problem(s)"
     )
     return 1 if problems else 0
 
