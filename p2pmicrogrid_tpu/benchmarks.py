@@ -26,6 +26,11 @@ driver parses the LAST line, so the north-star config-4 entry prints last:
    per episode via 80 chunks of 128 (run 2 side by side, ``chunk_parallel``)
    through one compiled program with on-device scenario synthesis and
    chunk-delta averaging (bench_northstar).
+8. ``chunked_pipeline`` sync vs async training-driver comparison on one
+   chunked program (same seeds): ``vs_baseline`` is the async/sync speedup
+   (the per-episode host round trip the depth-2 pipeline removes), the
+   payload carries both drivers' ``train.host_blocked_fraction`` and a
+   ``bit_identical`` final-state check.
 
 ``vs_baseline`` for throughput lines compares against a sequential NumPy
 re-implementation of the reference's eager per-slot, per-agent loop
@@ -794,6 +799,106 @@ def bench_northstar() -> dict:
     }
 
 
+def bench_chunked_pipeline() -> dict:
+    """Sync vs async chunked-driver comparison (the PR-4 episode pipeline).
+
+    Runs the SAME chunked program (same seeds, same compiled episode
+    shapes) through the synchronous driver (``pipeline=False`` — a blocking
+    readback per episode, the pre-pipeline behavior) and the async depth-2
+    driver (donated carry, lagged readback, jitted key schedule), from
+    identical fresh inits. The async path must produce a bit-identical
+    final policy state — reported as ``bit_identical`` — so the row is both
+    a perf number and a live correctness check. ``vs_baseline`` is the
+    async/sync speedup (the host gap the pipeline removed; ~1.0 on hosts
+    with no dispatch round trip, larger over the tunneled runtime);
+    ``train.host_blocked_fraction`` for both drivers rides the payload.
+    """
+    import jax
+
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+    from p2pmicrogrid_tpu.parallel.scenarios import (
+        make_chunked_episode_runner,
+        make_shared_episode_fn,
+        train_scenarios_chunked,
+    )
+    from p2pmicrogrid_tpu.telemetry import Telemetry
+    from p2pmicrogrid_tpu.train import make_policy
+
+    A, S, K, episodes = 20, 16, 8, 4
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S),
+        train=TrainConfig(implementation="tabular"),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    policy = make_policy(cfg)
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S),
+        n_scenarios=S,
+    )
+    slots = cfg.sim.slots_per_day
+
+    results = {}
+    for mode, pipelined in (("sync", False), ("async", True)):
+        runner = make_chunked_episode_runner(
+            cfg, episode_fn, K, donate=pipelined
+        )
+        tel = Telemetry(run_id=f"bench-pipeline-{mode}")
+        ps = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+        # Warm the exact measured program (compile + first episode).
+        ps, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,
+            pipeline=pipelined, donate=pipelined,
+        )
+        ps, _, _, secs = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=episodes, n_chunks=K, episode0=1,
+            episode_fn=episode_fn, runner=runner,
+            pipeline=pipelined, donate=pipelined, telemetry=tel,
+        )
+        results[mode] = {
+            "steps_per_sec": episodes * slots * S * K / secs,
+            "host_blocked_fraction": tel.summary()["gauges"].get(
+                "train.host_blocked_fraction"
+            ),
+            "final_state": ps,
+        }
+
+    bit_identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(results["sync"]["final_state"]),
+            jax.tree_util.tree_leaves(results["async"]["final_state"]),
+        )
+    )
+    sync_rate = results["sync"]["steps_per_sec"]
+    async_rate = results["async"]["steps_per_sec"]
+    return {
+        "metric": (
+            f"chunked_pipeline_env_steps_per_sec_{A}agent_{S}x{K}scenario"
+        ),
+        "value": round(async_rate, 1),
+        "unit": _chip_unit(),
+        # The pipeline's own baseline is the sync driver on the same
+        # program: the ratio IS the host gap removed.
+        "vs_baseline": round(async_rate / sync_rate, 3),
+        "sync_env_steps_per_sec": round(sync_rate, 1),
+        "async_env_steps_per_sec": round(async_rate, 1),
+        "host_blocked_fraction_sync": results["sync"]["host_blocked_fraction"],
+        "host_blocked_fraction_async": results["async"][
+            "host_blocked_fraction"
+        ],
+        "bit_identical": bool(bit_identical),
+        "chunks_per_episode": K,
+        "chunk_scenarios": S,
+        "episodes_measured": episodes,
+    }
+
+
 def converged_episode(
     prices: np.ndarray, window: int, band_abs: float = 0.002, band_rel: float = 0.02
 ) -> int:
@@ -1057,6 +1162,7 @@ BENCHES = {
     "scale": bench_scale,
     "cfg5": bench_cfg5,
     "cfg4": bench_cfg4,
+    "chunked_pipeline": bench_chunked_pipeline,
     # North star last: the driver parses the final JSON line, and the
     # full-aggregate 1000x10240 number is the headline.
     "northstar": bench_northstar,
@@ -1067,7 +1173,10 @@ BENCHES = {
 # mid-run. The 1000-agent and 2048-scenario programs are orders of magnitude
 # slower on CPU — retrying those would hang the suite for hours, worse than
 # the error row they'd otherwise produce.
-CPU_RETRYABLE = {"cfg1", "cfg2", "cfg3", "cfg5", "convergence", "convergence_fast"}
+CPU_RETRYABLE = {
+    "cfg1", "cfg2", "cfg3", "cfg5", "convergence", "convergence_fast",
+    "chunked_pipeline",
+}
 
 
 def _run_one(name: str) -> dict:

@@ -292,6 +292,7 @@ def cmd_train(args) -> int:
         device_ctx = _cpu_placement_ctx()
 
     print(f"setting: {cfg.setting} ({cfg.train.implementation})")
+    pipeline = getattr(args, "pipeline", True)
     from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
 
     # With a results DB, the run's telemetry ALSO streams into its SQLite
@@ -306,7 +307,7 @@ def cmd_train(args) -> int:
             result = train_community(
                 cfg, policy, pol_state, train_traces, ratings, key,
                 progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
-                telemetry=tel,
+                telemetry=tel, pipeline=pipeline,
             )
     finally:
         # Close even on a crashed run: the partial record is the evidence.
@@ -516,6 +517,18 @@ def _cmd_train_scenarios(args) -> int:
                     point.greedy_cost_eur, point.greedy_reward, point.status,
                 )
 
+    pipeline = getattr(args, "pipeline", True)
+    # The async drivers lag callback consumption by one episode; episodes
+    # whose callback READS the carry (checkpoint saves, in-loop health
+    # evals) must drain synchronously so the state they see is alive and
+    # episode-exact (parallel/scenarios.py:_run_episode_loop).
+    save_every = cfg.train.save_episodes
+
+    def carry_sync(ep, _save=save_every, _health=health_every):
+        if (ep + 1) % _save == 0:
+            return True
+        return _health > 0 and chunks <= 1 and ep % _health == 0
+
     with _profile_ctx(args):
         if chunks > 1 and health_every > 0:
             from p2pmicrogrid_tpu.train.health import train_chunked_with_health
@@ -526,6 +539,8 @@ def _cmd_train_scenarios(args) -> int:
                 episode_cb=episode_cb, chunk_parallel=chunk_parallel,
                 mitigate=basin_mitigate,
                 health_cb=health_cb, monitor=monitor,
+                pipeline=pipeline, carry_sync=carry_sync,
+                results_db=args.results_db,
             )
         elif chunks > 1:
             from p2pmicrogrid_tpu.parallel import train_scenarios_chunked
@@ -534,6 +549,7 @@ def _cmd_train_scenarios(args) -> int:
                 cfg, policy, pol_state, ratings, key, n_episodes,
                 n_chunks=chunks, episode0=episode0, episode_cb=episode_cb,
                 chunk_parallel=chunk_parallel,
+                pipeline=pipeline, carry_sync=carry_sync,
             )
         elif args.shared:
             if health_every > 0:
@@ -563,11 +579,13 @@ def _cmd_train_scenarios(args) -> int:
             pol_state, _, rewards, _, seconds = train_scenarios_shared(
                 cfg, policy, pol_state, arrays, ratings, key, n_episodes,
                 replay_s=scen_state, episode0=episode0, episode_cb=episode_cb,
+                pipeline=pipeline, carry_sync=carry_sync,
             )
         else:
             pol_state, rewards, _, seconds = train_scenarios_independent(
                 cfg, policy, pol_state, arrays, ratings, key, n_episodes,
                 episode0=episode0, episode_cb=episode_cb,
+                pipeline=pipeline, carry_sync=carry_sync,
             )
     if monitor is not None and monitor.basin_entries:
         print(
@@ -644,6 +662,9 @@ def cmd_multi(args) -> int:
         cfg, policy, pol_state, arrays, ratings, key,
         n_episodes=n_episodes, replay_s=scen_state,
         episode0=episode0, episode_cb=episode_cb,
+        pipeline=getattr(args, "pipeline", True),
+        # The windowed callback reads the carry at the checkpoint cadence.
+        carry_sync=lambda ep: (ep + 1) % cfg.train.save_episodes == 0,
     )
     save_checkpoint(ckpt_dir, pol_state, cfg.train.max_episodes - 1)
     if args.timing_json:
@@ -1284,8 +1305,16 @@ def cmd_serve_bench(args) -> int:
         set_current(tel)
         try:
             engine = PolicyEngine(
-                bundle_dir=bundle, max_batch=args.max_batch, telemetry=tel
+                bundle_dir=bundle, max_batch=args.max_batch, telemetry=tel,
+                device=getattr(args, "serve_device", "auto"),
             )
+            if engine.device is not None:
+                print(
+                    f"serve-bench: engine placed on {engine.device.platform}"
+                    f": {engine.placement_reason}",
+                    file=sys.stderr,
+                    flush=True,
+                )
             # Serve rows join on the BUNDLE's training config identity: the
             # engine serves the exported checkpoint's config, which may
             # differ from the CLI flags' freshly built cfg.
@@ -1347,6 +1376,62 @@ def cmd_telemetry_report(args) -> int:
     return 0
 
 
+def _watch_telemetry_join(con, args) -> int:
+    """``telemetry-query --watch``: tail mode over the warehouse join.
+
+    Polls the config-hash join every ``--interval`` seconds and streams
+    rows as JSON lines as they appear or CHANGE (a run's point/gauge counts
+    grow while its training streams, so an updated join row re-emits with
+    the fresh counts — the live view of the new pipeline gauges landing).
+    Missing warehouse tables (the DB predates its first SqliteSink write)
+    read as empty and polling continues. Runs until interrupted, or for
+    ``--max-polls`` polls when set (0 = forever).
+    """
+    import sqlite3
+    import time as _time
+
+    from p2pmicrogrid_tpu.data.results import TELEMETRY_JOIN_SQL
+
+    # Keyed by join identity, storing only the LAST emitted serialization
+    # per (telemetry run, eval row) pair — a forever-tail stays bounded by
+    # the number of distinct joined pairs, not by how often their
+    # point/gauge counts tick.
+    last_emitted: dict = {}
+    polls = 0
+    try:
+        while True:
+            try:
+                cur = con.execute(TELEMETRY_JOIN_SQL)
+                cols = [d[0] for d in cur.description]
+                rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+            except sqlite3.OperationalError as err:
+                # Pre-warehouse DB (tables not created yet): keep polling
+                # until the first SqliteSink write creates them.
+                if "no such table" not in str(err):
+                    print(f"SQL error: {err}", file=sys.stderr)
+                    return 1
+                rows = []
+            except sqlite3.Error as err:
+                # A corrupted/non-database file must not spin silently.
+                print(f"SQL error: {err}", file=sys.stderr)
+                return 1
+            for row in rows:
+                row_key = (
+                    row.get("run_id"), row.get("eval_setting"),
+                    row.get("implementation"), row.get("is_testing"),
+                )
+                line = json.dumps(row, sort_keys=True, default=float)
+                if last_emitted.get(row_key) != line:
+                    last_emitted[row_key] = line
+                    print(line, flush=True)
+            polls += 1
+            if args.max_polls and polls >= args.max_polls:
+                return 0
+            _time.sleep(max(args.interval, 0.0))
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_telemetry_query(args) -> int:
     """Query the SQLite telemetry warehouse.
 
@@ -1354,8 +1439,9 @@ def cmd_telemetry_query(args) -> int:
     pair sharing a ``config_hash``, with the run's point/gauge counts and
     the eval's total cost; ``--gauges`` inlines each joined run's gauge
     points (compile profiles, throughput, replay saturation). ``--sql``
-    runs arbitrary read-only SQL instead. Output: one JSON object per row
-    (machine-greppable, like the bench suites).
+    runs arbitrary read-only SQL instead. ``--watch`` polls the join and
+    streams new/updated rows as they land (tail mode). Output: one JSON
+    object per row (machine-greppable, like the bench suites).
     """
     import sqlite3
 
@@ -1377,6 +1463,11 @@ def cmd_telemetry_query(args) -> int:
         cols = [d[0] for d in cur.description] if cur.description else []
         return [dict(zip(cols, r)) for r in cur.fetchall()]
 
+    if getattr(args, "watch", False):
+        try:
+            return _watch_telemetry_join(con, args)
+        finally:
+            con.close()
     try:
         if args.sql:
             rows = select(args.sql)
@@ -1666,6 +1757,14 @@ def main(argv=None) -> int:
                         "measured faster on host XLA-CPU there "
                         "(artifacts/CROSSOVER_r03.json); 'default' pins the "
                         "default backend; 'cpu' forces host XLA-CPU")
+    p.add_argument("--pipeline", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="async episode pipeline (default on): dispatch "
+                        "episode e+1 with a donated device carry before "
+                        "reading back episode e's metrics — bit-identical "
+                        "final policy state, no per-episode host round trip "
+                        "(README 'Training pipeline'); --no-pipeline is the "
+                        "synchronous escape hatch")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser(
@@ -1687,6 +1786,8 @@ def main(argv=None) -> int:
     p.add_argument("--device", choices=["auto", "default", "cpu"],
                    default="auto",
                    help="see train --device (auto placement applies here too)")
+    p.add_argument("--pipeline", action=argparse.BooleanOptionalAction,
+                   default=True, help="see train --pipeline")
     p.set_defaults(fn=cmd_single, scenario_index=0)
 
     p = sub.add_parser("multi", help="multi-community training with "
@@ -1696,6 +1797,8 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint for this setting and "
                         "continue from there")
+    p.add_argument("--pipeline", action=argparse.BooleanOptionalAction,
+                   default=True, help="see train --pipeline")
     p.set_defaults(fn=cmd_multi)
 
     p = sub.add_parser("eval", help="evaluate a trained community per day")
@@ -1824,6 +1927,12 @@ def main(argv=None) -> int:
                    help="seed for the Poisson arrivals and synthetic "
                         "observations (default 0; --seed stays the model "
                         "config seed)")
+    p.add_argument("--serve-device", choices=["auto", "default", "cpu"],
+                   default="auto", dest="serve_device",
+                   help="engine placement: auto (default) serves tiny "
+                        "communities from host XLA-CPU per the measured "
+                        "crossover (train/placement.py), like training "
+                        "does; 'default' pins the default backend")
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser(
@@ -1841,6 +1950,17 @@ def main(argv=None) -> int:
     p.add_argument("--gauges", action="store_true",
                    help="inline each joined run's gauge points "
                         "(profile.*, train.*, replay.*) into its row")
+    p.add_argument("--watch", action="store_true",
+                   help="tail mode: poll the warehouse join and stream "
+                        "new/updated rows as JSON lines until interrupted "
+                        "(pairs with the async pipeline's live train.* "
+                        "gauges)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--watch poll interval in seconds (default 2)")
+    p.add_argument("--max-polls", type=_nonneg_int, default=0,
+                   dest="max_polls",
+                   help="--watch: stop after this many polls (0 = forever; "
+                        "scripts/tests use it for bounded tails)")
     p.set_defaults(fn=cmd_telemetry_query)
 
     p = sub.add_parser("analyse", help="statistics + figures from a results DB")

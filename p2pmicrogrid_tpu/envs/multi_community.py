@@ -102,15 +102,19 @@ def make_multi_community_episode_fn(
     policy,
     arrays_c: EpisodeArrays,
     ratings: AgentRatings,
+    donate: bool = False,
 ) -> Callable:
     """Jitted episode over C communities (leading axis of ``arrays_c``) with
-    shared policy parameters and inter-community trading."""
+    shared policy parameters and inter-community trading. ``donate``: see
+    ``make_shared_episode_fn`` (the carry updates in place; a donated carry
+    is consumed by the call)."""
     return make_shared_episode_fn(
         cfg,
         policy,
         arrays_c,
         ratings,
         settlement_hook=make_inter_community_settlement(cfg),
+        donate=donate,
     )
 
 
@@ -125,15 +129,25 @@ def train_multi_community(
     replay_s=None,
     episode0: int = 0,
     episode_cb: Optional[Callable] = None,
+    pipeline: bool = True,
+    telemetry=None,
+    carry_sync: Optional[Callable] = None,
 ) -> Tuple[object, object, np.ndarray, np.ndarray, float]:
     """Train C communities with inter-community trading (shared parameters).
 
     Same contract as ``train_scenarios_shared`` (returns pol_state,
     scen_state, rewards, losses, seconds) — communities are the leading
     axis of ``arrays_c`` (build with ``stack_scenario_arrays`` over one trace
-    draw per community).
+    draw per community). ``pipeline``/``carry_sync``: the async depth-2
+    driver and its carry-read sync predicate (see
+    ``train_scenarios_shared``); the episode program is built donation-clean
+    when pipelining, so ``episode_cb`` callbacks that READ the carry need
+    ``carry_sync`` episodes (the ``multi`` CLI wires its checkpoint
+    cadence).
     """
-    episode_fn = make_multi_community_episode_fn(cfg, policy, arrays_c, ratings)
+    episode_fn = make_multi_community_episode_fn(
+        cfg, policy, arrays_c, ratings, donate=pipeline
+    )
     return train_scenarios_shared(
         cfg,
         policy,
@@ -146,6 +160,10 @@ def train_multi_community(
         episode_fn=episode_fn,
         episode0=episode0,
         episode_cb=episode_cb,
+        pipeline=pipeline,
+        donate=pipeline,
+        telemetry=telemetry,
+        carry_sync=carry_sync,
     )
 
 

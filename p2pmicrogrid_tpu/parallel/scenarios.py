@@ -23,6 +23,7 @@ community.py:279-287).
 
 from __future__ import annotations
 
+import functools
 import time as _time
 from typing import Callable, NamedTuple, Optional, Tuple
 
@@ -135,6 +136,8 @@ def stack_scenario_arrays(
     through the device tunnel (~0.1 s/scenario — hours at the 10k-scenario
     north star; this builds S=10k in seconds).
     """
+    # host-sync: traces are host-built numpy arrays (no device values) —
+    # this whole builder runs once per training call, off the episode loop.
     times = np.asarray(traces.time)
     if not (times == times[:1]).all():
         raise ValueError("scenario traces must share one slot/time grid")
@@ -145,9 +148,10 @@ def stack_scenario_arrays(
     # viewed as [S*T, P]) — the profile-assignment/rating rule stays in ONE
     # place (data/traces.py) while everything is still a single vectorized
     # pass with one device transfer per leaf.
-    S, T = np.asarray(traces.load).shape[:2]
+    S, T = np.asarray(traces.load).shape[:2]  # host-sync: host numpy traces
     flat = TraceSet(
         *(
+            # host-sync: host numpy traces, one-time array build.
             np.asarray(leaf).reshape((S * T,) + np.asarray(leaf).shape[2:])
             for leaf in traces
         )
@@ -167,12 +171,77 @@ def stack_scenario_arrays(
     roll = lambda x: np.moveaxis(next_slot(np.moveaxis(x, 1, 0)), 0, 1)
     return EpisodeArrays(
         time=jnp.asarray(times),
-        t_out=jnp.asarray(np.asarray(traces.t_out)),
+        t_out=jnp.asarray(np.asarray(traces.t_out)),  # host-sync: host trace
         load_w=jnp.asarray(load_w),
         pv_w=jnp.asarray(pv_w),
         next_time=jnp.asarray(roll(times[:, :, None])[:, :, 0]),
         next_load_w=jnp.asarray(roll(load_w)),
         next_pv_w=jnp.asarray(roll(pv_w)),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _episode_key_schedule(key: jax.Array, n_episodes: int) -> jax.Array:
+    """The per-episode key chain of the host loop — ``key, k =
+    jax.random.split(key)`` repeated — computed as ONE jitted scan instead of
+    n_episodes tiny host dispatches. Bit-identical to the sequential chain
+    (same split ops in the same order; tests assert it). Returns [E, 2]."""
+
+    def body(k, _):
+        ks = jax.random.split(k)
+        return ks[0], ks[1]
+
+    _, keys = jax.lax.scan(body, key, None, length=n_episodes)
+    return keys
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def chunk_key_schedule(
+    key: jax.Array, episode0, n_episodes: int, n_chunks: int
+) -> jax.Array:
+    """All (episode, chunk) keys of a chunked run in ONE jitted program:
+    ``fold_in(fold_in(key, episode0 + e), c)`` for every e < n_episodes,
+    c < n_chunks — replacing the per-episode host loop of K eager fold_in
+    dispatches (bit-identical; tests assert equality with the stacked host
+    loop). Returns [E, K, 2]."""
+
+    def per_episode(e):
+        ke = jax.random.fold_in(key, e)
+        return jax.vmap(lambda c: jax.random.fold_in(ke, c))(
+            jnp.arange(n_chunks)
+        )
+
+    return jax.vmap(per_episode)(episode0 + jnp.arange(n_episodes))
+
+
+def _copy_carry(carry):
+    """Defensive device copy of a carry about to enter a donating loop: the
+    loop's first dispatch consumes the COPY, so the caller's passed-in state
+    stays valid (one extra allocation per train call; every in-loop episode
+    still updates in place)."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, carry
+    )
+
+
+def _apply_decay(decay: Callable, carry):
+    """Exploration decay on a loop carry: a bare pol_state decays directly;
+    a plain-tuple carry (pol_state, scen_state, ...) decays its head."""
+    if isinstance(carry, tuple) and not hasattr(carry, "_fields"):
+        pol_state, rest = carry[0], carry[1:]
+        return (decay(pol_state),) + rest
+    return decay(carry)
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_decay(decay: Callable, donate: bool) -> Callable:
+    """Jitted (optionally donating) exploration decay — the decay is already
+    a pure jax fn; jitting folds its ops into one dispatch and, with
+    ``donate``, updates the carry in place so it never leaves the device
+    between episodes. Cached per decay callable (one per ``make_policy``)."""
+    return jax.jit(
+        lambda carry: _apply_decay(decay, carry),
+        donate_argnums=(0,) if donate else (),
     )
 
 
@@ -185,41 +254,79 @@ def _run_episode_loop(
     decay_every: Optional[int],
     episode0: int,
     episode_cb: Optional[Callable] = None,
+    pipeline: bool = True,
+    donate: bool = False,
+    telemetry=None,
+    carry_sync: Optional[Callable[[int], bool]] = None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Shared host loop: run episodes, decay on the reference cadence.
 
     ``episode_fn(carry, key) -> (carry, (rewards [S], losses [S]))``.
     ``episode_cb(episode_index, reward [S], loss [S], carry)`` is invoked per
-    episode (progress records, checkpointing — the carry is the live learner
-    state). Returns (carry, rewards [episodes, S], losses [episodes, S],
-    seconds).
+    episode (progress records, checkpointing — the carry is that episode's
+    learner state). Returns (carry, rewards [episodes, S],
+    losses [episodes, S], seconds).
+
+    ``pipeline`` (default) runs the depth-2 software pipeline: episode e+1
+    is dispatched BEFORE episode e's rewards/losses are read back
+    (telemetry/async_drain.py), so the device never idles on the host round
+    trip; ``pipeline=False`` is the synchronous escape hatch (identical
+    values — only readback timing moves). ``episode_cb`` consumption is
+    lagged by one episode under the pipeline; its reward/loss VALUES are
+    exactly the sync driver's.
+
+    ``donate`` declares that ``episode_fn`` was built with a donated carry
+    (``make_*_episode_fn(donate=True)``): the loop takes a defensive copy of
+    the incoming carry (callers may keep using their passed-in state) and
+    every in-loop episode then updates the carry buffers in place. Under
+    donation a lagged ``episode_cb`` receives a carry whose buffers may
+    already be consumed by the next dispatch — callbacks that READ the carry
+    (checkpointing, evals) must run at episodes where ``carry_sync(ep)`` is
+    true: the loop then drains synchronously before the next dispatch, so
+    the carry they see is alive and episode-exact.
     """
-    rewards, losses = [], []
+    from p2pmicrogrid_tpu.telemetry.async_drain import AsyncDrain
+
+    keys = _episode_key_schedule(key, n_episodes)
+    if donate:
+        carry = _copy_carry(carry)
+    decay_fn = _jitted_decay(policy.decay, donate)
+    drain = AsyncDrain(depth=2 if pipeline else 1, telemetry=telemetry)
+
+    rewards: list = [None] * n_episodes
+    losses: list = [None] * n_episodes
     start = _time.time()
-    for e in range(n_episodes):
-        key, k = jax.random.split(key)
-        # A collect_device_metrics episode_fn appends a DeviceCounters
-        # element; this loop records rewards/losses either way (callers
-        # wanting the counters drive the episode_fn themselves or go through
-        # the chunked trainer's telemetry path).
-        carry, ys = episode_fn(carry, k)
-        r, l = ys[0], ys[1]
-        if decay_every and (episode0 + e) % decay_every == 0:
-            carry = _decay_carry(policy, carry)
-        r, l = np.asarray(r), np.asarray(l)
-        rewards.append(r)
-        losses.append(l)
+
+    def consume(e, host, carry_e):
+        r, l = host
+        rewards[e] = r
+        losses[e] = l
         if episode_cb:
-            episode_cb(episode0 + e, r, l, carry)
+            episode_cb(episode0 + e, r, l, carry_e)
+
+    for e in range(n_episodes):
+        with drain.dispatch_span(episode=episode0 + e):
+            # A collect_device_metrics episode_fn appends a DeviceCounters
+            # element; this loop records rewards/losses either way (callers
+            # wanting the counters drive the episode_fn themselves or go
+            # through the chunked trainer's telemetry path).
+            carry, ys = episode_fn(carry, keys[e])
+            if decay_every and (episode0 + e) % decay_every == 0:
+                carry = decay_fn(carry)
+        drain.push(e, (ys[0], ys[1]), lambda e_, host, c=carry: consume(e_, host, c))
+        if carry_sync is not None and carry_sync(episode0 + e):
+            drain.flush()
+    drain.flush()
+    # host-sync: end-of-loop barrier so the returned timing is honest.
     jax.block_until_ready(carry)
+    drain.finish()
     return carry, np.stack(rewards), np.stack(losses), _time.time() - start
 
 
 def _decay_carry(policy: Policy, carry):
-    if isinstance(carry, tuple) and not hasattr(carry, "_fields"):
-        pol_state, rest = carry[0], carry[1:]
-        return (policy.decay(pol_state),) + rest
-    return policy.decay(carry)
+    """Eager form of the carry decay (kept for direct/test callers; the
+    training loops dispatch the jitted ``_jitted_decay`` equivalent)."""
+    return _apply_decay(policy.decay, carry)
 
 
 # --- independent mode -------------------------------------------------------
@@ -230,14 +337,18 @@ def make_independent_episode_fn(
     policy: Policy,
     arrays_s: EpisodeArrays,
     ratings: AgentRatings,
+    donate: bool = False,
 ) -> Callable:
     """Jitted: one training episode for each of S independent learners.
 
     Signature: (pol_state_s, key) -> (pol_state_s, (rewards [S], losses [S])).
+    ``donate`` donates the carry: the S stacked learner states update in
+    place (callers must not reuse a consumed ``pol_state_s`` — see the
+    README "Training pipeline" donation contract).
     """
     n_scenarios = arrays_s.time.shape[0]
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def episode(pol_state_s, key):
         keys = jax.random.split(key, n_scenarios)
 
@@ -268,6 +379,10 @@ def train_scenarios_independent(
     episode_fn: Optional[Callable] = None,
     episode0: int = 0,
     episode_cb: Optional[Callable] = None,
+    pipeline: bool = True,
+    donate: Optional[bool] = None,
+    telemetry=None,
+    carry_sync: Optional[Callable[[int], bool]] = None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """S independent learners, one device program per episode.
 
@@ -276,9 +391,18 @@ def train_scenarios_independent(
     ``episode_fn`` (``make_independent_episode_fn``) to reuse its compiled
     program across calls. Returns (final states [S,...], rewards
     [episodes, S], losses [episodes, S], seconds).
+
+    ``pipeline``/``donate``/``carry_sync``: see ``_run_episode_loop`` — the
+    default is the depth-2 async pipeline; when this function builds its own
+    episode program it builds it donation-clean (a prebuilt ``episode_fn``
+    keeps whatever donation it was built with; declare it via ``donate``).
     """
+    if donate is None:
+        donate = pipeline and episode_fn is None
     if episode_fn is None:
-        episode_fn = make_independent_episode_fn(cfg, policy, arrays_s, ratings)
+        episode_fn = make_independent_episode_fn(
+            cfg, policy, arrays_s, ratings, donate=donate
+        )
     return _run_episode_loop(
         episode_fn,
         pol_state_s,
@@ -288,6 +412,10 @@ def train_scenarios_independent(
         cfg.train.min_episodes_criterion,
         episode0,
         episode_cb,
+        pipeline=pipeline,
+        donate=donate,
+        telemetry=telemetry,
+        carry_sync=carry_sync,
     )
 
 
@@ -717,8 +845,16 @@ def make_shared_episode_fn(
     arrays_fn: Optional[Callable] = None,
     n_scenarios: Optional[int] = None,
     collect_device_metrics: bool = False,
+    donate: bool = False,
 ) -> Callable:
     """Jitted: one shared-parameter training episode over S scenarios.
+
+    ``donate`` donates the ``(pol_state, scen_state)`` carry: the policy
+    trees AND the per-scenario replay (multi-GB at the north star) update in
+    place instead of round-tripping fresh allocations every episode. A
+    donated carry is CONSUMED by the call — callers must not reuse it (the
+    training drivers take a defensive copy of the state they are handed, so
+    their public API is unaffected; see README "Training pipeline").
 
     Signature: ((pol_state, scen_state), key) -> ((pol_state, scen_state),
     (rewards [S], losses [S])). ``scen_state`` is None for tabular, a
@@ -817,7 +953,7 @@ def make_shared_episode_fn(
             loss,
         )
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def episode(carry, key):
         pol_state, scen_state = carry
         k_phys, k_scan, k_gen = jax.random.split(key, 3)
@@ -884,6 +1020,10 @@ def train_scenarios_shared(
     episode_fn: Optional[Callable] = None,
     episode0: int = 0,
     episode_cb: Optional[Callable] = None,
+    pipeline: bool = True,
+    donate: Optional[bool] = None,
+    telemetry=None,
+    carry_sync: Optional[Callable[[int], bool]] = None,
 ) -> Tuple[object, object, np.ndarray, np.ndarray, float]:
     """One shared learner over S scenarios: per slot, vmapped dynamics produce
     per-scenario transitions and a single averaged update is applied.
@@ -895,9 +1035,22 @@ def train_scenarios_shared(
 
     Returns (pol_state, scen_state, rewards [episodes, S],
     losses [episodes, S], seconds).
+
+    ``pipeline`` (default) dispatches episode e+1 before reading back
+    episode e (the async depth-2 driver; ``False`` is the synchronous escape
+    hatch — bit-identical results). When this function builds its own
+    episode program it builds it with a donated carry so the replay updates
+    in place; a prebuilt ``episode_fn`` keeps its own donation, declared via
+    ``donate``. ``carry_sync(ep) -> bool`` marks episodes whose
+    ``episode_cb`` READS the carry (checkpointing/evals): the loop drains
+    synchronously there so the carry is alive and episode-exact.
     """
+    if donate is None:
+        donate = pipeline and episode_fn is None
     if episode_fn is None:
-        episode_fn = make_shared_episode_fn(cfg, policy, arrays_s, ratings)
+        episode_fn = make_shared_episode_fn(
+            cfg, policy, arrays_s, ratings, donate=donate
+        )
     carry, rewards, losses, seconds = _run_episode_loop(
         episode_fn,
         (pol_state, replay_s),
@@ -907,6 +1060,10 @@ def train_scenarios_shared(
         cfg.train.min_episodes_criterion,
         episode0,
         episode_cb,
+        pipeline=pipeline,
+        donate=donate,
+        telemetry=telemetry,
+        carry_sync=carry_sync,
     )
     pol_state, scen_state = carry
     return pol_state, scen_state, rewards, losses, seconds
@@ -922,6 +1079,7 @@ def make_chunked_episode_runner(
     warmup_fn: Optional[Callable] = None,
     chunk_parallel: int = 1,
     collect_device_metrics: bool = False,
+    donate: bool = False,
 ) -> Callable:
     """The jitted K-chunk episode: ONE device call — a ``lax.scan`` over
     chunk keys whose body runs the chunk episode from θ₀ and accumulates its
@@ -963,6 +1121,12 @@ def make_chunked_episode_runner(
     C=1 is the measured optimum again (206k vs 80.8k scenario-steps/s on
     the K=8 probe, artifacts/WIDTH_SWEEP_r05.json); C>1 remains available
     for shapes where width wins.
+
+    ``donate`` donates ``theta0``: the episode's starting parameters are
+    consumed and the update lands in the same buffers — the donation-clean
+    mode the async training pipeline runs (callers must not reuse a
+    ``theta0`` they passed to a donating runner; ``train_scenarios_chunked``
+    copies its incoming state once so ITS callers are unaffected).
     """
     C = chunk_parallel
     if C < 1 or n_chunks % C != 0:
@@ -1002,7 +1166,7 @@ def make_chunked_episode_runner(
         fill = jnp.zeros(()) if fill is None else fill
         return theta_c, r, l, ys[2], fill
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def run_chunks(theta0, chunk_keys):
         dc_tot = dc_zero() if collect_device_metrics else None
         if C == 1:
@@ -1082,6 +1246,9 @@ def train_scenarios_chunked(
     scenario_sharding=None,
     chunk_parallel: int = 1,
     telemetry=None,
+    pipeline: bool = True,
+    donate: Optional[bool] = None,
+    carry_sync: Optional[Callable[[int], bool]] = None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
     Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
@@ -1129,6 +1296,23 @@ def train_scenarios_chunked(
     ``make_shared_episode_fn``; disable with ``DDPGConfig.lr_auto_scale=False``
     or explicit CLI lr flags). A custom prebuilt ``episode_fn`` carries
     whatever lrs its own config had at build time.
+
+    ``pipeline`` (default) runs the depth-2 async driver: episode e+1's
+    K-chunk program is dispatched BEFORE episode e's rewards/losses/device
+    counters are read back, and the per-episode chunk keys come from one
+    jitted ``chunk_key_schedule`` program instead of K eager ``fold_in``
+    dispatches per episode. ``pipeline=False`` is the synchronous escape
+    hatch — the final policy state is bit-identical either way (dispatch
+    order never changes; only readback timing moves). When this function
+    builds its own runner it builds it donation-clean (``theta0`` updates in
+    place episode-to-episode; the incoming ``pol_state`` is defensively
+    copied once so callers may keep using it). A caller-prebuilt ``runner``
+    fixes its own donation — declare it with ``donate`` so the loop copies
+    the incoming state and guards callback carry access accordingly.
+    ``carry_sync(ep) -> bool`` marks episodes whose ``episode_cb`` reads the
+    carry (checkpoint cadence): the loop drains synchronously there. A
+    custom ``chunk_key_fn`` keeps the host-side key loop (tests collapse
+    chunks onto one draw with it).
     """
     S = cfg.sim.n_scenarios
     if scenario_sharding is not None and (
@@ -1177,46 +1361,74 @@ def train_scenarios_chunked(
                 cfg, policy, None, ratings, arrays_fn=arrays_fn,
                 n_scenarios=S, record_only=True,
             )
-    if chunk_key_fn is None:
-        chunk_key_fn = lambda k, e, c: jax.random.fold_in(
-            jax.random.fold_in(k, e), c
-        )
+    if donate is None:
+        donate = pipeline and runner is None
     if runner is None:
         runner = make_chunked_episode_runner(
             cfg, episode_fn, n_chunks, warmup_fn=warmup_fn,
             chunk_parallel=chunk_parallel, collect_device_metrics=collect,
+            donate=donate,
         )
     run_chunks = runner
-
-    decay_every = cfg.train.min_episodes_criterion
-    rewards, losses = [], []
-    start = _time.time()
-    for e in range(n_episodes):
-        chunk_keys = jnp.stack(
+    if donate:
+        # The donating runner consumes theta0 in place; copy once so the
+        # caller's passed-in state survives this call (README "Training
+        # pipeline" donation contract).
+        pol_state = _copy_carry(pol_state)
+    if chunk_key_fn is None:
+        # ONE jitted program computes every (episode, chunk) key up front —
+        # the replacement for K eager fold_in dispatches per episode.
+        all_keys = chunk_key_schedule(key, episode0, n_episodes, n_chunks)
+        keys_for = lambda e: all_keys[e]
+    else:
+        keys_for = lambda e: jnp.stack(
             [chunk_key_fn(key, episode0 + e, c) for c in range(n_chunks)]
         )
-        out = run_chunks(pol_state, chunk_keys)
-        pol_state, r, l = out[:3]
-        if len(out) > 3 and telemetry is not None:
+    decay_fn = _jitted_decay(policy.decay, donate)
+
+    from p2pmicrogrid_tpu.telemetry.async_drain import AsyncDrain
+
+    drain = AsyncDrain(depth=2 if pipeline else 1, telemetry=telemetry)
+    decay_every = cfg.train.min_episodes_criterion
+    rewards: list = [None] * n_episodes
+    losses: list = [None] * n_episodes
+    start = _time.time()
+
+    def consume(e, host, carry_e):
+        r, l = host[0], host[1]
+        if len(host) > 2 and telemetry is not None:
             from p2pmicrogrid_tpu.telemetry.device_metrics import dc_to_dict
 
-            dcd = dc_to_dict(out[3])
+            dcd = dc_to_dict(host[2])
             # One gauge per episode: chunks train the same slot count from
             # fresh replays, so per-chunk fills agree — the mean is the
             # per-episode saturation (ROADMAP replay-saturation item).
-            fill = float(np.asarray(out[4]).mean())
+            fill = float(host[3].mean())
             telemetry.record_device_counters(dcd)
             telemetry.gauge("replay.fill_fraction", fill)
             telemetry.event(
                 "device_counters", episode=episode0 + e, phase="train",
                 replay_fill_fraction=round(fill, 4), **dcd,
             )
-        if decay_every and (episode0 + e) % decay_every == 0:
-            pol_state = policy.decay(pol_state)
-        r, l = np.asarray(r), np.asarray(l)
-        rewards.append(r)
-        losses.append(l)
+        rewards[e] = r
+        losses[e] = l
         if episode_cb:
-            episode_cb(episode0 + e, r, l, pol_state)
+            episode_cb(episode0 + e, r, l, carry_e)
+
+    for e in range(n_episodes):
+        with drain.dispatch_span(episode=episode0 + e):
+            out = run_chunks(pol_state, keys_for(e))
+            pol_state = out[0]
+            if decay_every and (episode0 + e) % decay_every == 0:
+                pol_state = decay_fn(pol_state)
+        payload = out[1:3] if len(out) <= 3 or telemetry is None else out[1:]
+        drain.push(
+            e, payload, lambda e_, host, c=pol_state: consume(e_, host, c)
+        )
+        if carry_sync is not None and carry_sync(episode0 + e):
+            drain.flush()
+    drain.flush()
+    # host-sync: end-of-loop barrier so the returned timing is honest.
     jax.block_until_ready(pol_state)
+    drain.finish()
     return pol_state, np.stack(rewards), np.stack(losses), _time.time() - start

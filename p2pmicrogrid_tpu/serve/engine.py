@@ -85,6 +85,7 @@ class PolicyEngine:
         params: Optional[dict] = None,
         max_batch: int = 256,
         telemetry=None,
+        device: str = "auto",
     ):
         import jax
         import jax.numpy as jnp
@@ -97,11 +98,33 @@ class PolicyEngine:
             raise ValueError("pass bundle_dir, or both manifest and params")
         if max_batch < 1 or max_batch & (max_batch - 1):
             raise ValueError(f"max_batch must be a power of two, got {max_batch}")
+        if device not in ("auto", "default", "cpu"):
+            raise ValueError(
+                f"device must be 'auto', 'default' or 'cpu', got {device!r}"
+            )
         self.manifest = manifest
         self.max_batch = max_batch
         self.telemetry = telemetry
         self.n_agents = int(manifest["n_agents"])
         self._impl = manifest["implementation"]
+        # Crossover-driven placement (train/placement.py): tiny communities'
+        # greedy passes are dispatch-bound and measured faster on host
+        # XLA-CPU — 'auto' serves them from there the way training places
+        # itself; 'default' pins the default backend, 'cpu' forces host CPU.
+        self.device = None
+        self.placement_reason = "default backend"
+        if device == "cpu":
+            try:
+                self.device = jax.devices("cpu")[0]
+                self.placement_reason = "pinned by device='cpu'"
+            except RuntimeError:
+                self.placement_reason = "host XLA-CPU backend unavailable"
+        elif device == "auto":
+            from p2pmicrogrid_tpu.train.placement import pick_serve_device
+
+            self.device, self.placement_reason = pick_serve_device(
+                self._impl, self.n_agents
+            )
         # Serving computes in float32 regardless of the on-disk dtype: a
         # float16 bundle halves storage/transfer, not arithmetic precision.
         self.params = jax.tree_util.tree_map(
@@ -110,6 +133,16 @@ class PolicyEngine:
             ),
             params,
         )
+        if self.device is not None:
+            # Committed params pin every bucket program to the chosen
+            # device (uncommitted obs inputs follow the committed operand).
+            self.params = jax.device_put(self.params, self.device)
+            if self.telemetry is not None:
+                self.telemetry.event(
+                    "serve_placement",
+                    device=str(self.device),
+                    reason=self.placement_reason,
+                )
         self._act_raw = self._build_act_fn()
         # One jitted callable; XLA caches one executable per bucket shape.
         self._act_jit = jax.jit(self._act_raw)
@@ -242,10 +275,13 @@ class PolicyEngine:
                 )
                 if compiled is not self._act_jit:
                     self._compiled[b] = compiled
+                # host-sync: warmup compile boundary (pre-traffic).
                 jax.block_until_ready(compiled(self.params, obs))
             else:
+                # host-sync: warmup compile boundary (pre-traffic).
                 jax.block_until_ready(self._act_jit(self.params, obs))
             if include_step:
+                # host-sync: warmup compile boundary (pre-traffic).
                 jax.block_until_ready(
                     self._step_jit(self.params, self.init_sessions(b), obs)[1]
                 )
@@ -253,6 +289,7 @@ class PolicyEngine:
         return warmed
 
     def _check_obs(self, obs: np.ndarray) -> np.ndarray:
+        # host-sync: caller-supplied host observations, not device values.
         obs = np.asarray(obs, dtype=np.float32)
         if obs.ndim != 3 or obs.shape[1:] != (self.n_agents, 4):
             raise ValueError(
@@ -289,6 +326,8 @@ class PolicyEngine:
         # program; avoids a cold jit-cache compile next to it).
         act = self._compiled.get(bucket, self._act_jit)
         out = act(self.params, obs)
+        # host-sync: the per-batch serving latency boundary — requests
+        # need their answers NOW; serve latency IS this sync.
         jax.block_until_ready(out)
         secs = time.perf_counter() - t0
         self.stats["rows"] += b
@@ -299,7 +338,7 @@ class PolicyEngine:
             self.telemetry.counter("serve.batches")
             self.telemetry.counter("serve.padded_rows", bucket - b)
             self.telemetry.histogram("serve.batch_ms", secs * 1e3)
-        return np.asarray(out[:b])
+        return np.asarray(out[:b])  # host-sync: result delivery
 
     @property
     def padding_waste(self) -> float:
@@ -316,12 +355,18 @@ class PolicyEngine:
         return Sessions(hp_frac=hp, slots=sessions.slots + jnp.int32(1)), hp
 
     def init_sessions(self, n: int) -> Sessions:
+        import jax
         import jax.numpy as jnp
 
-        return Sessions(
+        sessions = Sessions(
             hp_frac=jnp.zeros((n, self.n_agents), jnp.float32),
             slots=jnp.zeros((n,), jnp.int32),
         )
+        if self.device is not None:
+            # Sessions ride the donated step next to the committed params —
+            # they must live on the same (placement-chosen) device.
+            sessions = jax.device_put(sessions, self.device)
+        return sessions
 
     def step(self, sessions: Sessions, obs):
         """Advance ``n`` sessions one slot: act on obs [n, A, 4], record the
@@ -360,7 +405,7 @@ class PolicyEngine:
             )
         new, hp = self._step_jit(self.params, sessions, obs)
         new = Sessions(hp_frac=new.hp_frac[:n], slots=new.slots[:n])
-        return new, np.asarray(hp[:n])
+        return new, np.asarray(hp[:n])  # host-sync: result delivery
 
 
 class MicroBatchQueue:
@@ -384,6 +429,7 @@ class MicroBatchQueue:
         self._thread.start()
 
     def submit(self, obs_row) -> Future:
+        # host-sync: caller-supplied host observation row.
         obs_row = np.asarray(obs_row, dtype=np.float32)
         fut: Future = Future()
         with self._cv:
@@ -417,6 +463,7 @@ class MicroBatchQueue:
                 out = self.engine.act(np.stack([row for row, _, _ in batch]))
                 service_s = time.monotonic() - dispatch_t
                 for i, (_, fut, _) in enumerate(batch):
+                    # host-sync: result delivery to the waiting future.
                     fut.set_result(np.asarray(out[i]))
             except Exception as err:  # noqa: BLE001 — fail the waiters, not the loop
                 for _, fut, _ in batch:

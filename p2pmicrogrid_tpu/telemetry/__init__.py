@@ -3,6 +3,11 @@
 See ``registry.py`` for the design; README "Observability" for usage.
 """
 
+from p2pmicrogrid_tpu.telemetry.async_drain import (
+    AsyncDrain,
+    resolve_host,
+    start_host_copy,
+)
 from p2pmicrogrid_tpu.telemetry.device_metrics import (
     DeviceCounters,
     dc_add,
@@ -36,6 +41,9 @@ from p2pmicrogrid_tpu.telemetry.registry import (
 from p2pmicrogrid_tpu.telemetry.spans import Span, SpanRecorder
 
 __all__ = [
+    "AsyncDrain",
+    "resolve_host",
+    "start_host_copy",
     "DeviceCounters",
     "dc_add",
     "dc_from_slot",

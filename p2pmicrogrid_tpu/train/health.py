@@ -347,6 +347,9 @@ def train_chunked_with_health(
     health_cb: Optional[Callable] = None,
     s_eval: int = 8,
     telemetry="auto",
+    pipeline: bool = True,
+    carry_sync: Optional[Callable] = None,
+    results_db: Optional[str] = None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float, HealthMonitor]:
     """``train_scenarios_chunked`` with the health surface on.
 
@@ -380,6 +383,22 @@ def train_chunked_with_health(
     ``phase: "train"``) plus the per-chunk replay fill fraction as the
     ``replay.fill_fraction`` gauge. An auto-created telemetry is closed
     (summary + Chrome trace written) before returning.
+
+    ``results_db``: path to a results SQLite store — an auto-created
+    telemetry additionally streams into its warehouse tables via a
+    ``SqliteSink`` (the same ``--results-db`` contract the single-scenario
+    ``train`` command has; a caller-supplied ``telemetry`` keeps its own
+    sinks and ignores this).
+
+    ``pipeline`` (default) runs each training block through the async
+    depth-2 driver (donated carries, lagged readback —
+    ``train_scenarios_chunked``); health evals sit at block BOUNDARIES and
+    consume the fully-drained block state, so basin/health decisions that
+    gate training (the lr-boost program switch) are unchanged by the
+    pipeline — only within-block telemetry/callback readback is lagged.
+    ``pipeline=False`` is the synchronous escape hatch. ``carry_sync`` is
+    forwarded to the chunked driver for callbacks that read the carry
+    mid-block (checkpoint cadence).
     """
     from p2pmicrogrid_tpu.parallel.scenarios import (
         make_chunked_episode_runner,
@@ -401,11 +420,17 @@ def train_chunked_with_health(
 
     owns_telemetry = False
     if telemetry == "auto":
-        from p2pmicrogrid_tpu.telemetry import Telemetry
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
 
+        # With a results DB the run's telemetry ALSO lands in its SQLite
+        # warehouse tables (keyed by config_hash) — the chunked/health path
+        # now honours the same --results-db contract as `train`
+        # (ROADMAP warehouse follow-on).
+        extra_sinks = [SqliteSink(results_db)] if results_db else ()
         telemetry = Telemetry.maybe_create(
             "train-chunked",
             cfg=cfg,
+            extra_sinks=extra_sinks,
             extra_manifest={
                 "n_episodes": n_episodes,
                 "n_chunks": n_chunks,
@@ -442,6 +467,7 @@ def train_chunked_with_health(
         runner = make_chunked_episode_runner(
             run_cfg, episode_fn, n_chunks, warmup_fn=warmup_fn,
             chunk_parallel=chunk_parallel, collect_device_metrics=collect,
+            donate=pipeline,
         )
         return runner, episode_fn
 
@@ -509,6 +535,8 @@ def train_chunked_with_health(
                     episode0=episode0 + done, episode_cb=episode_cb,
                     episode_fn=episode_fn, runner=runner,
                     telemetry=telemetry,
+                    pipeline=pipeline, donate=pipeline,
+                    carry_sync=carry_sync,
                 )
             if telemetry is not None:
                 telemetry.event(

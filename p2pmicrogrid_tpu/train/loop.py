@@ -14,6 +14,8 @@ Evaluation vmaps the per-day runs into one device call.
 from __future__ import annotations
 
 import collections
+import contextlib
+import functools
 import statistics
 import time as _time
 from dataclasses import dataclass, field
@@ -75,9 +77,16 @@ def make_train_step(
     ratings: AgentRatings,
     block: Optional[int] = None,
     collect_device_metrics: bool = False,
+    donate: bool = False,
 ) -> Callable:
     """Jitted function running ``block`` training episodes (defaults to
     ``episodes_per_jit_block``).
+
+    ``donate`` donates the policy-state argument: the learner trees update
+    in place block-to-block instead of allocating fresh buffers every call.
+    A donated ``pol_state`` is CONSUMED — callers must not reuse it
+    (``train_community`` copies its incoming state once, so its public API
+    is unaffected; see README "Training pipeline").
 
     Each episode starts from a freshly drawn physical state (the reference
     re-randomizes indoor temperatures on every reset, heating.py:145-152) and
@@ -109,7 +118,7 @@ def make_train_step(
         dc = out[3] if collect_device_metrics else None
         return pol_state, phys, reward, loss, dc
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_block(pol_state, episode0, key):
         keys = jax.random.split(key, block)
 
@@ -182,6 +191,7 @@ def train_community(
     checkpoint_cb: Optional[Callable[[int, object], None]] = None,
     verbose: bool = False,
     telemetry=None,
+    pipeline: bool = True,
 ) -> TrainResult:
     """The reference's training driver (community.py:248-298).
 
@@ -195,6 +205,16 @@ def train_community(
     a ``train_block`` span, and the in-program device counters (NaN/comfort/
     market totals accumulated inside the jitted block) are reduced and
     recorded per block as ``device.*`` counters.
+
+    ``pipeline`` (default) runs the depth-2 async driver: block b+1 is
+    dispatched (with a DONATED policy-state carry — the learner trees update
+    in place) before block b's rewards/losses/counters are read back, so the
+    device never idles on the host round trip; progress records and windowed
+    averages consume the lagged results with exactly the sync driver's
+    values. Blocks ending on a checkpoint boundary drain synchronously
+    BEFORE the next dispatch, so ``checkpoint_cb`` always sees live,
+    episode-exact state. ``pipeline=False`` is the synchronous escape hatch
+    (bit-identical final state; only readback timing moves).
     """
     t = cfg.train
     arrays = build_episode_arrays(cfg, traces, ratings)
@@ -205,13 +225,25 @@ def train_community(
 
     collect_dc = telemetry is not None
     train_block = make_train_step(
-        cfg, policy, arrays, ratings, collect_device_metrics=collect_dc
+        cfg, policy, arrays, ratings, collect_device_metrics=collect_dc,
+        donate=pipeline,
     )
     block = t.episodes_per_jit_block
 
     result = TrainResult(pol_state=pol_state, phys=None)
     window_r = collections.deque(maxlen=t.min_episodes_criterion)
     window_l = collections.deque(maxlen=t.min_episodes_criterion)
+
+    if pipeline:
+        # The donating block program consumes its carry; copy once so the
+        # caller's passed-in state survives (README donation contract).
+        from p2pmicrogrid_tpu.parallel.scenarios import _copy_carry
+
+        pol_state = _copy_carry(pol_state)
+
+    from p2pmicrogrid_tpu.telemetry.async_drain import AsyncDrain
+
+    drain = AsyncDrain(depth=2 if pipeline else 1, telemetry=telemetry)
 
     start = _time.time()
     episode = t.starting_episodes
@@ -222,11 +254,44 @@ def train_community(
         if size not in step_fns:
             step_fns[size] = make_train_step(
                 cfg, policy, arrays, ratings, block=size,
-                collect_device_metrics=collect_dc,
+                collect_device_metrics=collect_dc, donate=pipeline,
             )
         return step_fns[size]
 
-    import contextlib
+    def consume_block(episode0_b, host, pol_state_b):
+        rewards, losses = host[0], host[1]
+        if collect_dc:
+            from p2pmicrogrid_tpu.telemetry import dc_to_dict
+
+            telemetry.record_device_counters(dc_to_dict(host[2]))
+        for i in range(rewards.shape[0]):
+            window_r.append(float(rewards[i]))
+            window_l.append(float(losses[i]))
+            result.episode_rewards.append(float(rewards[i]))
+            result.episode_losses.append(float(losses[i]))
+            ep = episode0_b + i
+
+            # Exploration decay already happened in-block; emit the progress
+            # record on the same cadence (community.py:279-288).
+            if ep % t.min_episodes_criterion == 0:
+                avg_r = statistics.mean(window_r)
+                avg_l = statistics.mean(window_l)
+                result.progress.append((ep, avg_r, avg_l))
+                if progress_cb:
+                    progress_cb(ep, avg_r, avg_l)
+                if telemetry is not None:
+                    telemetry.event(
+                        "progress", episode=ep, avg_reward=avg_r, avg_error=avg_l
+                    )
+                if verbose:
+                    print(f"episode {ep}: avg reward {avg_r:.3f}, avg error {avg_l:.3f}")
+
+            # Episode-exact: block ends are aligned to the save cadence
+            # below, so pol_state_b here IS the state after episode ep (the
+            # loop drains synchronously before the next dispatch can donate
+            # it whenever a block ends on a save boundary).
+            if (ep + 1) % t.save_episodes == 0 and checkpoint_cb:
+                checkpoint_cb(ep, pol_state_b)
 
     profiled = False
     while episode < t.max_episodes:
@@ -265,54 +330,30 @@ def train_community(
                            "slots_per_episode": arrays.n_slots},
                 )
                 step_fns[step_size] = step_fn
-        span = (
+        block_span = (
             telemetry.span("train_block", episode0=episode, episodes=step_size)
             if telemetry is not None
             else contextlib.nullcontext()
         )
-        with span:
+        with block_span, drain.dispatch_span(episode=episode):
             out = step_fn(pol_state, jnp.asarray(episode), k_block)
-            pol_state, phys, rewards, losses = out[:4]
-            if collect_dc:
-                jax.block_until_ready(rewards)
-        if collect_dc:
-            from p2pmicrogrid_tpu.telemetry import dc_to_dict
-
-            telemetry.record_device_counters(dc_to_dict(out[4]))
-        rewards = np.asarray(rewards)
-        losses = np.asarray(losses)
-
-        for i in range(rewards.shape[0]):
-            window_r.append(float(rewards[i]))
-            window_l.append(float(losses[i]))
-            result.episode_rewards.append(float(rewards[i]))
-            result.episode_losses.append(float(losses[i]))
-            ep = episode + i
-
-            # Exploration decay already happened in-block; emit the progress
-            # record on the same cadence (community.py:279-288).
-            if ep % t.min_episodes_criterion == 0:
-                avg_r = statistics.mean(window_r)
-                avg_l = statistics.mean(window_l)
-                result.progress.append((ep, avg_r, avg_l))
-                if progress_cb:
-                    progress_cb(ep, avg_r, avg_l)
-                if telemetry is not None:
-                    telemetry.event(
-                        "progress", episode=ep, avg_reward=avg_r, avg_error=avg_l
-                    )
-                if verbose:
-                    print(f"episode {ep}: avg reward {avg_r:.3f}, avg error {avg_l:.3f}")
-
-            # Episode-exact: block ends are aligned to the save cadence
-            # above, so pol_state here IS the state after episode ep.
-            if (ep + 1) % t.save_episodes == 0 and checkpoint_cb:
-                checkpoint_cb(ep, pol_state)
-
+            pol_state, phys = out[0], out[1]
+        payload = out[2:4] + ((out[4],) if collect_dc else ())
+        drain.push(
+            episode,
+            payload,
+            lambda e0, host, ps=pol_state: consume_block(e0, host, ps),
+        )
+        if checkpoint_cb and (episode + step_size) % t.save_episodes == 0:
+            # This block's consumption will checkpoint: drain before the
+            # next dispatch donates the state the callback must serialize.
+            drain.flush()
         episode += step_size
 
-    # Block until the device is done so the timing is honest.
+    drain.flush()
+    # host-sync: end-of-run barrier so the timing is honest.
     jax.block_until_ready(pol_state)
+    drain.finish()
     result.train_seconds = _time.time() - start
     result.env_steps = (episode - t.starting_episodes) * arrays.n_slots
     result.pol_state = pol_state

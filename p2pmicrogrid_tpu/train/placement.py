@@ -45,6 +45,48 @@ def sequential_cpu_advantage(
     return table[nearest]
 
 
+def pick_serve_device(
+    implementation: str, n_agents: int, default_backend: Optional[str] = None
+) -> Tuple[Optional[object], str]:
+    """(device-to-serve-on or None, human-readable reason) — the serving
+    counterpart of ``pick_train_device``.
+
+    The serve engine's per-bucket programs are the same per-slot forward
+    passes the crossover sweep measured dispatch-bound at small community
+    sizes: a tiny community's [B, A, 4] greedy pass cannot fill an
+    accelerator, so inside the measured CPU-wins region the engine serves
+    from host XLA-CPU the way training places itself
+    (artifacts/CROSSOVER_r03.json). ``PolicyEngine(device=...)`` overrides.
+
+    Honest caveat: the table was measured on B=1 sequential TRAINING
+    programs, not padded serve batches — a large ``max_batch`` bucket can
+    fill an accelerator where the sequential program could not, so for
+    high-throughput serving pin ``device='default'`` (or serve-bench
+    ``--serve-device default``) until a serve-specific crossover is
+    measured (ROADMAP serving follow-on).
+    """
+    import jax
+
+    backend = default_backend or jax.default_backend()
+    if backend == "cpu":
+        return None, "default backend is already host XLA-CPU"
+    ratio = sequential_cpu_advantage(implementation, n_agents)
+    if ratio is None:
+        return None, (
+            f"no measured CPU advantage for {implementation} at "
+            f"{n_agents} agents"
+        )
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        return None, "host XLA-CPU backend unavailable"
+    return cpu, (
+        f"{implementation} at {n_agents} agents measured {1 / ratio:.0f}x "
+        f"faster on host XLA-CPU than on {backend} "
+        "(artifacts/CROSSOVER_r03.json); override with device='default'"
+    )
+
+
 def pick_train_device(
     cfg, default_backend: Optional[str] = None
 ) -> Tuple[Optional[object], str]:

@@ -1,0 +1,517 @@
+"""Async episode pipeline (PR 4): donated carries, device-side key
+schedules, depth-2 lagged readback, and the host-sync static check.
+
+The contract under test: the async driver produces BIT-IDENTICAL final
+policy state to the synchronous escape hatch for fixed seeds (dispatch
+order never changes — only readback timing moves), lagged callbacks see
+exactly the sync driver's values one episode late, and donation never
+invalidates a caller's passed-in state (the drivers copy-on-entry).
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    DDPGConfig,
+    DQNConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.parallel import (
+    init_shared_state,
+    make_scenario_traces,
+    stack_scenario_arrays,
+    train_scenarios_chunked,
+)
+from p2pmicrogrid_tpu.parallel.scenarios import (
+    _episode_key_schedule,
+    chunk_key_schedule,
+    make_shared_episode_fn,
+    train_scenarios_shared,
+)
+from p2pmicrogrid_tpu.telemetry import AsyncDrain, MemorySink, Telemetry
+from p2pmicrogrid_tpu.train import make_policy
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _cfg(impl="tabular", S=2, A=2, **kw):
+    return default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S),
+        train=TrainConfig(implementation=impl),
+        dqn=DQNConfig(buffer_size=16, batch_size=4),
+        ddpg=DDPGConfig(buffer_size=32, batch_size=2, share_across_agents=True),
+        **kw,
+    )
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+class TestKeySchedules:
+    def test_chunk_schedule_matches_fold_in_stack(self):
+        """The jitted [E, K] schedule is bit-identical to the host loop of
+        fold_in(fold_in(key, e), c) stacks it replaces."""
+        key = jax.random.PRNGKey(3)
+        sched = np.asarray(chunk_key_schedule(key, 5, 4, 3))
+        assert sched.shape[:2] == (4, 3)
+        for e in range(4):
+            for c in range(3):
+                ref = jax.random.fold_in(jax.random.fold_in(key, 5 + e), c)
+                assert np.array_equal(sched[e, c], np.asarray(ref))
+
+    def test_episode_schedule_matches_split_chain(self):
+        """One jitted scan reproduces the sequential `key, k = split(key)`
+        chain of the old host loop, bit-for-bit."""
+        key = jax.random.PRNGKey(9)
+        refs, k = [], key
+        for _ in range(5):
+            k, sub = jax.random.split(k)
+            refs.append(np.asarray(sub))
+        assert np.array_equal(
+            np.asarray(_episode_key_schedule(key, 5)), np.stack(refs)
+        )
+
+
+class TestAsyncDrain:
+    def test_depth2_lags_consumption_by_one_dispatch(self):
+        """The drain-order contract: episode e's consume runs AFTER episode
+        e+1 was dispatched, in FIFO order, with a full flush at the end."""
+        events = []
+        drain = AsyncDrain(depth=2)
+        for e in range(3):
+            events.append(("dispatch", e))
+            drain.push(e, (np.float32(e),), lambda tag, host: events.append(("drain", tag)))
+        drain.flush()
+        assert events == [
+            ("dispatch", 0), ("dispatch", 1), ("drain", 0),
+            ("dispatch", 2), ("drain", 1), ("drain", 2),
+        ]
+
+    def test_depth1_is_synchronous(self):
+        events = []
+        drain = AsyncDrain(depth=1)
+        for e in range(2):
+            events.append(("dispatch", e))
+            drain.push(e, (np.float32(e),), lambda tag, host: events.append(("drain", tag)))
+        assert events == [
+            ("dispatch", 0), ("drain", 0), ("dispatch", 1), ("drain", 1),
+        ]
+
+    def test_resolves_device_arrays_and_records_metrics(self):
+        tel = Telemetry(run_id="t", sinks=[MemorySink()])
+        drain = AsyncDrain(depth=2, telemetry=tel)
+        got = {}
+        for e in range(3):
+            drain.push(
+                e,
+                (jnp.full((2,), e, jnp.float32), None),
+                lambda tag, host: got.update({tag: host}),
+            )
+        assert drain.finish() >= 0.0
+        assert sorted(got) == [0, 1, 2]
+        r, none = got[1]
+        assert isinstance(r, np.ndarray) and np.array_equal(r, [1.0, 1.0])
+        assert none is None
+        s = tel.summary()
+        assert "train.host_blocked_fraction" in s["gauges"]
+        assert s["gauges"]["train.pipeline_depth"] == 2.0
+        # 3 dispatches -> 2 gap samples; a span pair per drained episode.
+        assert s["histograms"]["train.dispatch_gap_ms"]["count"] == 2
+        assert s["spans"]["pipeline_drain"]["count"] == 3
+
+    def test_rejects_zero_depth(self):
+        with pytest.raises(ValueError, match="depth"):
+            AsyncDrain(depth=0)
+
+
+class TestBitExactness:
+    """Acceptance: async driver == sync driver, bit for bit, fixed seeds."""
+
+    def test_chunked_tabular_sync_vs_async(self):
+        cfg = _cfg("tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        sync, r_s, l_s, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=3, n_chunks=2, pipeline=False,
+        )
+        anc, r_a, l_a, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=3, n_chunks=2, pipeline=True,
+        )
+        assert _leaves_equal(sync, anc)
+        np.testing.assert_array_equal(r_s, r_a)
+        np.testing.assert_array_equal(l_s, l_a)
+        # Donation safety: the caller's state survived the donating driver
+        # (defensive copy-on-entry) — readable, and still the init values.
+        _ = np.asarray(jax.tree_util.tree_leaves(ps)[0])
+
+    def test_shared_dqn_sync_vs_async(self):
+        cfg = _cfg("dqn")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        traces = make_scenario_traces(cfg, seed=0)
+        arrays = stack_scenario_arrays(cfg, traces, ratings)
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        out_s = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(2), 3,
+            replay_s=scen, pipeline=False,
+        )
+        out_a = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(2), 3,
+            replay_s=scen, pipeline=True,
+        )
+        # Policy params, per-scenario replay, and reward/loss records all
+        # match bit-for-bit (donation + lagged readback change nothing).
+        assert _leaves_equal(out_s[:2], out_a[:2])
+        np.testing.assert_array_equal(out_s[2], out_a[2])
+        np.testing.assert_array_equal(out_s[3], out_a[3])
+        _ = np.asarray(jax.tree_util.tree_leaves(ps)[0])
+
+
+class TestDonationSafety:
+    def test_escape_hatch_episode_fn_does_not_donate(self):
+        """pipeline=False builds a non-donating program: the same carry can
+        drive it twice (no use-after-donate on the escape-hatch path)."""
+        cfg = _cfg("tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        arrays = stack_scenario_arrays(
+            cfg, make_scenario_traces(cfg, seed=0), ratings
+        )
+        fn = make_shared_episode_fn(cfg, policy, arrays, ratings, donate=False)
+        carry = init_shared_state(cfg, jax.random.PRNGKey(0))
+        a1, _ = fn(carry, jax.random.PRNGKey(1))
+        a2, _ = fn(carry, jax.random.PRNGKey(1))  # carry still alive
+        assert _leaves_equal(a1, a2)
+
+    def test_donating_episode_fn_consumes_its_carry(self):
+        """donate=True consumes the carry in place: reusing it is a loud
+        use-after-donate error, not silent corruption."""
+        cfg = _cfg("tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        arrays = stack_scenario_arrays(
+            cfg, make_scenario_traces(cfg, seed=0), ratings
+        )
+        fn = make_shared_episode_fn(cfg, policy, arrays, ratings, donate=True)
+        carry = init_shared_state(cfg, jax.random.PRNGKey(0))
+        carry2, _ = fn(carry, jax.random.PRNGKey(1))
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(jax.tree_util.tree_leaves(carry)[0]) + 0
+        # The returned carry is the live one.
+        _ = np.asarray(jax.tree_util.tree_leaves(carry2)[0])
+
+
+class TestLaggedCallbacks:
+    def test_episode_cb_values_match_sync_in_order(self):
+        cfg = _cfg("tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+
+        def record_into(log):
+            return lambda ep, r, l, carry: log.append((ep, r.copy(), l.copy()))
+
+        log_s, log_a = [], []
+        train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=3, n_chunks=2, pipeline=False,
+            episode_cb=record_into(log_s),
+        )
+        train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=3, n_chunks=2, pipeline=True,
+            episode_cb=record_into(log_a),
+        )
+        assert [e for e, _, _ in log_a] == [0, 1, 2]
+        for (es, rs, ls), (ea, ra, la) in zip(log_s, log_a):
+            assert es == ea
+            np.testing.assert_array_equal(rs, ra)
+            np.testing.assert_array_equal(ls, la)
+
+    def test_lagged_carry_is_donated_unless_carry_sync(self):
+        """The drain-order contract made observable: under donation, the
+        carry a LAGGED callback sees was consumed by the next episode's
+        dispatch — except at the final flush, and except at episodes a
+        carry_sync predicate forces a synchronous drain for."""
+        cfg = _cfg("tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+
+        def probe(log):
+            def cb(ep, r, l, carry):
+                try:
+                    np.asarray(jax.tree_util.tree_leaves(carry)[0]) + 0
+                    log.append((ep, True))
+                except RuntimeError:
+                    log.append((ep, False))
+            return cb
+
+        alive = []
+        train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=3, n_chunks=2, pipeline=True, episode_cb=probe(alive),
+        )
+        # Episodes 0 and 1 drained one dispatch late (carry donated);
+        # episode 2 drained at the final flush (carry alive).
+        assert alive == [(0, False), (1, False), (2, True)]
+
+        synced = []
+        train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=3, n_chunks=2, pipeline=True, episode_cb=probe(synced),
+            carry_sync=lambda ep: True,
+        )
+        assert synced == [(0, True), (1, True), (2, True)]
+
+
+class TestTrainCommunityPipeline:
+    def test_bit_exact_and_checkpoints_episode_exact(self):
+        from p2pmicrogrid_tpu.data import synthetic_traces
+        from p2pmicrogrid_tpu.train import (
+            init_policy_state,
+            train_community,
+        )
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=2),
+            train=TrainConfig(
+                implementation="tabular", max_episodes=4,
+                episodes_per_jit_block=2, save_episodes=2,
+            ),
+        )
+        traces = synthetic_traces(n_days=1, start_day=11).normalized()
+        ratings = make_ratings(cfg, np.random.default_rng(42))
+        policy = make_policy(cfg)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+
+        def saver(log):
+            return lambda ep, s: log.append(
+                (ep, np.asarray(jax.tree_util.tree_leaves(s)[0]).copy())
+            )
+
+        ck_s, ck_a = [], []
+        res_s = train_community(
+            cfg, policy, ps, traces, ratings, jax.random.PRNGKey(0),
+            pipeline=False, checkpoint_cb=saver(ck_s),
+        )
+        tel = Telemetry(run_id="t", sinks=[MemorySink()])
+        res_a = train_community(
+            cfg, policy, ps, traces, ratings, jax.random.PRNGKey(0),
+            pipeline=True, checkpoint_cb=saver(ck_a), telemetry=tel,
+        )
+        assert _leaves_equal(res_s.pol_state, res_a.pol_state)
+        assert res_s.episode_rewards == res_a.episode_rewards
+        # Checkpoints fire at the same episodes with the same (live,
+        # episode-exact) state: the pipeline drains synchronously before
+        # the next dispatch can donate a to-be-checkpointed carry.
+        assert [e for e, _ in ck_a] == [e for e, _ in ck_s] == [1, 3]
+        for (_, a), (_, b) in zip(ck_s, ck_a):
+            np.testing.assert_array_equal(a, b)
+        s = tel.summary()
+        assert "train.host_blocked_fraction" in s["gauges"]
+        assert "pipeline_drain" in s["spans"]
+        assert "train_block" in s["spans"]
+
+
+class TestChunkedTelemetry:
+    def test_pipeline_gauges_spans_and_lagged_device_counters(self):
+        """The default chunked driver with telemetry keeps its device-counter
+        events (now consumed lagged) and gains the pipeline observability."""
+        cfg = _cfg("tabular")
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        policy = make_policy(cfg)
+        from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+
+        ps = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+        sink = MemorySink()
+        tel = Telemetry(run_id="t", sinks=[sink])
+        train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=2, n_chunks=2, telemetry=tel, pipeline=True,
+        )
+        s = tel.summary()
+        assert "train.host_blocked_fraction" in s["gauges"]
+        assert "replay.fill_fraction" in s["gauges"]
+        assert "train.dispatch_gap_ms" in s["histograms"]
+        assert s["spans"]["pipeline_dispatch"]["count"] == 2
+        assert s["spans"]["pipeline_drain"]["count"] == 2
+        dc_events = [
+            r for r in sink.records if r.get("kind") == "device_counters"
+        ]
+        assert [r["episode"] for r in dc_events] == [0, 1]
+
+
+@pytest.fixture(scope="module")
+def host_sync_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_host_sync", os.path.join(REPO, "tools", "check_host_sync.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCheckHostSync:
+    def test_repo_hot_paths_are_clean(self, host_sync_checker):
+        """Acceptance: the checker runs clean on the shipped code."""
+        assert host_sync_checker.check_host_sync(os.path.abspath(REPO)) == []
+
+    def test_flags_unannotated_readback(self, host_sync_checker, tmp_path):
+        rel = host_sync_checker.HOT_PATH_FILES[0]
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True)
+        path.write_text(
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    return np.asarray(x)\n"
+        )
+        problems = host_sync_checker.check_host_sync(str(tmp_path))
+        assert len(problems) == 1 and "np.asarray" in problems[0]
+
+    def test_annotated_and_string_mentions_pass(self, host_sync_checker, tmp_path):
+        rel = host_sync_checker.HOT_PATH_FILES[0]
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True)
+        path.write_text(
+            '"""Docs may discuss np.asarray( and block_until_ready( freely."""\n'
+            "import numpy as np\n"
+            "def f(x, y):\n"
+            "    # host-sync: test fixture annotation.\n"
+            "    a = np.asarray(x)\n"
+            "    b = np.asarray(y)  # host-sync: inline annotation\n"
+            "    return a, b\n"
+        )
+        assert host_sync_checker.check_host_sync(str(tmp_path)) == []
+
+    def test_wired_into_check_all(self, host_sync_checker, tmp_path):
+        """check_artifacts_schema.check_all sweeps host-sync problems too."""
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(REPO, "tools", "check_artifacts_schema.py"),
+        )
+        schema = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(schema)
+        rel = host_sync_checker.HOT_PATH_FILES[0]
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True)
+        path.write_text("import numpy as np\nx = np.asarray([1])\n")
+        problems = schema.check_all(str(tmp_path))
+        assert any("un-annotated blocking readback" in p for p in problems)
+
+
+class TestWatchMode:
+    def test_cli_watch_streams_joined_rows_once(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import SqliteSink
+
+        db = str(tmp_path / "r.db")
+        tel = Telemetry(
+            run_id="run-W", sinks=[SqliteSink(db)],
+            manifest={"config_hash": "cfg-W", "created": "now"},
+        )
+        tel.gauge("train.host_blocked_fraction", 0.01)
+        tel.event("progress", episode=1)
+        tel.close()
+        with ResultsStore(db) as store:
+            store.log_eval_run(
+                "2-agent", "tabular", False, config_hash="cfg-W",
+                n_days=1, total_cost_eur=0.5,
+            )
+        rc = main([
+            "telemetry-query", "--results-db", db,
+            "--watch", "--max-polls", "2", "--interval", "0",
+        ])
+        assert rc == 0
+        lines = [
+            json.loads(l) for l in capsys.readouterr().out.splitlines() if l
+        ]
+        # Two polls, one joined row: emitted exactly once (deduped tail).
+        assert len(lines) == 1
+        assert lines[0]["run_id"] == "run-W"
+        assert lines[0]["config_hash"] == "cfg-W"
+
+    def test_cli_watch_survives_pre_warehouse_db(self, tmp_path, capsys):
+        import sqlite3
+
+        from p2pmicrogrid_tpu.cli import main
+
+        db = str(tmp_path / "plain.db")
+        sqlite3.connect(db).close()  # empty DB: no warehouse tables yet
+        rc = main([
+            "telemetry-query", "--results-db", db,
+            "--watch", "--max-polls", "1", "--interval", "0",
+        ])
+        assert rc == 0
+        assert capsys.readouterr().out.strip() == ""
+
+    def test_cli_watch_fails_loud_on_corrupt_db(self, tmp_path, capsys):
+        """A non-database file must exit with an error, not spin silently
+        (only 'no such table' reads as pre-warehouse)."""
+        from p2pmicrogrid_tpu.cli import main
+
+        db = tmp_path / "corrupt.db"
+        db.write_text("this is not a sqlite database, not even close......")
+        rc = main([
+            "telemetry-query", "--results-db", str(db),
+            "--watch", "--max-polls", "0", "--interval", "0",
+        ])
+        assert rc == 1
+
+
+class TestServePlacement:
+    def test_pick_serve_device_on_cpu_backend(self):
+        from p2pmicrogrid_tpu.train.placement import pick_serve_device
+
+        dev, reason = pick_serve_device("tabular", 2)
+        assert dev is None and "host XLA-CPU" in reason
+
+    def test_engine_honours_device_pin(self, tmp_path):
+        from p2pmicrogrid_tpu.serve import PolicyEngine, export_policy_bundle
+        from p2pmicrogrid_tpu.train import init_policy_state
+
+        cfg = _cfg("tabular", S=1)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        eng = PolicyEngine(bundle_dir=bundle, max_batch=4, device="cpu")
+        assert eng.device is not None and eng.device.platform == "cpu"
+        out = eng.act(np.zeros((3, 2, 4), np.float32))
+        assert out.shape == (3, 2)
+        sessions = eng.init_sessions(2)
+        sessions, hp = eng.step(sessions, np.zeros((2, 2, 4), np.float32))
+        assert hp.shape == (2, 2)
+
+    def test_engine_rejects_unknown_device(self, tmp_path):
+        from p2pmicrogrid_tpu.serve import PolicyEngine, export_policy_bundle
+        from p2pmicrogrid_tpu.train import init_policy_state
+
+        cfg = _cfg("tabular", S=1)
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        bundle = export_policy_bundle(cfg, ps, str(tmp_path / "b"))
+        with pytest.raises(ValueError, match="device"):
+            PolicyEngine(bundle_dir=bundle, max_batch=4, device="tpu9000")
+
+
+def test_bench_registry_includes_chunked_pipeline():
+    from p2pmicrogrid_tpu.benchmarks import BENCHES, CPU_RETRYABLE
+
+    assert "chunked_pipeline" in BENCHES
+    assert "chunked_pipeline" in CPU_RETRYABLE
+    assert list(BENCHES)[-1] == "northstar"  # headline row stays last

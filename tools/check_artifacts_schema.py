@@ -356,6 +356,25 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
     for pattern in RESULTS_DB_GLOBS:
         for path in sorted(glob.glob(os.path.join(repo_root, pattern))):
             check_results_db(path, problems)
+    # Host-sync hygiene rides the same sweep (tools/check_host_sync.py):
+    # hot-path modules may not grow un-annotated blocking readbacks. The
+    # checker skips roots without the package files, so artifact-only scan
+    # roots (tests' tmp dirs) are unaffected.
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_host_sync",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "check_host_sync.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        problems.extend(mod.check_host_sync(repo_root))
+    except Exception as err:  # noqa: BLE001 — artifact checks still count
+        problems.append(f"check_host_sync unavailable: {err}")
     return problems
 
 

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Static check: hot-path modules must not grow un-annotated host syncs.
+
+The async episode pipeline (PR 4) exists because blocking readbacks crept
+into every training driver one ``np.asarray(...)`` at a time — each one
+looked harmless, and together they serialized dispatch against the full
+host round trip per episode (~0.1 s over the tunneled runtime). This
+checker makes that regression class executable: the hot-path modules below
+may only contain blocking-readback constructs on lines that carry an
+explicit ``# host-sync: <why>`` annotation (same line, or in the comment
+block immediately above). New un-annotated sites fail tier-1
+(tests/test_pipeline.py) and ``check_artifacts_schema.py --root``'s
+``check_all`` sweep.
+
+Flagged constructs (conservative, string-level — the point is to force a
+human to write down WHY a sync is on the hot path, not to prove one
+exists):
+
+* ``np.asarray(`` on a possibly-device value (``jnp.asarray`` — a
+  host->device transfer, not a readback — is NOT flagged),
+* ``jax.device_get(``,
+* ``block_until_ready(``,
+* ``.item()``.
+
+Whitelisted sites in-tree today: the pipeline's own drain resolve
+(telemetry/async_drain.py — copies were started asynchronously at dispatch
+time), end-of-loop timing barriers, the serve engine's intentional
+per-batch latency boundary, and host-side numpy array construction that
+never touches a device value.
+
+Exit status: 0 when clean, 1 with one problem per line on stderr.
+Stdlib-only — runs with the accelerator stack down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import re
+import sys
+import tokenize
+
+# The modules on the dispatch hot path: training drivers, the episode env,
+# the serving engine, and the async drain itself.
+HOT_PATH_FILES = (
+    os.path.join("p2pmicrogrid_tpu", "parallel", "scenarios.py"),
+    os.path.join("p2pmicrogrid_tpu", "train", "loop.py"),
+    os.path.join("p2pmicrogrid_tpu", "envs", "community.py"),
+    os.path.join("p2pmicrogrid_tpu", "serve", "engine.py"),
+    os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
+)
+
+ANNOTATION = "host-sync:"
+
+PATTERNS = (
+    # np.asarray on device values blocks; jnp.asarray is host->device.
+    (re.compile(r"(?<!j)np\.asarray\("), "np.asarray("),
+    (re.compile(r"jax\.device_get\("), "jax.device_get("),
+    (re.compile(r"block_until_ready\("), "block_until_ready("),
+    (re.compile(r"\.item\(\)"), ".item()"),
+)
+
+
+def _annotated(lines: list, i: int) -> bool:
+    """True when line ``i`` carries the annotation inline or in the
+    contiguous comment block immediately above it."""
+    if ANNOTATION in lines[i]:
+        return True
+    j = i - 1
+    while j >= 0 and lines[j].lstrip().startswith("#"):
+        if ANNOTATION in lines[j]:
+            return True
+        j -= 1
+    return False
+
+
+def _code_only(source: str) -> list:
+    """The source's lines with every string literal and comment blanked —
+    docstrings DISCUSSING ``np.asarray`` must not trip the check, and the
+    annotation lookup runs on the original lines separately."""
+    lines = [list(l) for l in source.splitlines()]
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type not in (tokenize.STRING, tokenize.COMMENT):
+                continue
+            (sr, sc), (er, ec) = tok.start, tok.end
+            for r in range(sr - 1, er):
+                if r >= len(lines):
+                    break
+                c0 = sc if r == sr - 1 else 0
+                c1 = ec if r == er - 1 else len(lines[r])
+                for c in range(c0, min(c1, len(lines[r]))):
+                    lines[r][c] = " "
+    except (tokenize.TokenError, IndentationError):
+        pass  # best-effort: unparseable files fall back to raw lines
+    return ["".join(l) for l in lines]
+
+
+def check_file(path: str, rel: str, problems: list) -> None:
+    try:
+        with open(path) as f:
+            source = f.read()
+    except OSError as err:
+        problems.append(f"{rel}: unreadable ({err})")
+        return
+    lines = source.splitlines()
+    for i, line in enumerate(_code_only(source)):
+        for pattern, label in PATTERNS:
+            if pattern.search(line) and not _annotated(lines, i):
+                problems.append(
+                    f"{rel}:{i + 1}: un-annotated blocking readback "
+                    f"({label!r}) on a hot-path module — route it through "
+                    "the async drain (telemetry/async_drain.py) or annotate "
+                    "the line with '# host-sync: <why this must block>'"
+                )
+                break
+
+
+def check_host_sync(repo_root: str) -> list:
+    """All problems found in the hot-path modules under ``repo_root``
+    (empty list = clean). Files absent under ``repo_root`` are skipped, so
+    the check composes with artifact-only scan roots."""
+    problems: list = []
+    for rel in HOT_PATH_FILES:
+        path = os.path.join(repo_root, rel)
+        if os.path.exists(path):
+            check_file(path, rel, problems)
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--root",
+        default=os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."),
+        help="repo root to scan (default: the checkout containing this script)",
+    )
+    args = parser.parse_args(argv)
+    root = os.path.abspath(args.root)
+    problems = check_host_sync(root)
+    for p in problems:
+        print(p, file=sys.stderr)
+    n_files = sum(
+        os.path.exists(os.path.join(root, rel)) for rel in HOT_PATH_FILES
+    )
+    print(
+        f"checked {n_files} hot-path module(s): {len(problems)} "
+        "un-annotated host sync(s)"
+    )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
