@@ -33,7 +33,15 @@ Subcommands:
   serve-bench
             drive the batched inference engine with an open-loop Poisson
             request stream and print p50/p95/p99 latency, throughput and
-            padding-waste as one JSON object per line (serve/loadgen.py)
+            padding-waste as one JSON object per line (serve/loadgen.py);
+            --network runs the same schedule over real sockets against an
+            in-process serve gateway and reports wire percentiles + shed
+            rate
+  serve-gateway
+            run the HTTP serving gateway over one or more policy bundles:
+            POST /v1/act, /healthz, /readyz, /stats, POST /admin/swap
+            (hot-swap + A/B split), admission control, drain-before-exit
+            (serve/gateway.py)
 """
 
 from __future__ import annotations
@@ -1291,6 +1299,66 @@ def cmd_serve_bench(args) -> int:
                 file=sys.stderr,
                 flush=True,
             )
+        if getattr(args, "network", False):
+            # Wire-level mode: the same open-loop schedule, fired over real
+            # sockets at an in-process gateway (its per-bundle telemetry —
+            # per-request serve_request traces keyed by the bundle's
+            # config_hash — streams into --results-db via build_gateway).
+            from p2pmicrogrid_tpu.serve import (
+                AdmissionConfig,
+                GatewayServer,
+                build_gateway,
+                serve_bench_network,
+            )
+
+            gateway = build_gateway(
+                [bundle],
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1e3,
+                results_db=args.results_db,
+                device=getattr(args, "serve_device", "auto"),
+                admission=AdmissionConfig(
+                    max_queue_depth=args.max_queue_depth,
+                    wait_budget_ms=args.wait_budget_ms,
+                ),
+                run_name="serve-bench-net",
+            )
+            server = GatewayServer(gateway)
+            try:
+                host, port = server.start()
+                default = gateway.registry.get(gateway.registry.default_hash)
+                print(
+                    f"serve-bench: gateway on {host}:{port} serving bundle "
+                    f"{default.config_hash}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
+                def emit(row):
+                    sink.emit(row)
+                    if default.telemetry is not None:
+                        default.telemetry.emit(row)
+
+                serve_bench_network(
+                    host, port,
+                    n_agents=default.engine.n_agents,
+                    rate_hz=args.rate,
+                    n_requests=args.requests,
+                    n_households=args.households,
+                    seed=args.bench_seed,
+                    slo_ms=args.slo_ms,
+                    emit=emit,
+                    extra_headline={
+                        "config_hash": default.config_hash,
+                        "implementation": default.implementation,
+                        "n_agents": default.engine.n_agents,
+                        "max_batch": args.max_batch,
+                        "max_wait_ms": round(args.max_wait_ms, 3),
+                    },
+                )
+            finally:
+                server.stop()  # drains in-flight, closes queues + telemetry
+            return 0
         # The stdout sink carries ONLY metric rows (the driver contract);
         # event-stream records (per-request traces, compile profiles) go to
         # the telemetry's own sinks — the SQLite warehouse when requested.
@@ -1334,6 +1402,94 @@ def cmd_serve_bench(args) -> int:
         finally:
             set_current(None)
             tel.close()
+    return 0
+
+
+def cmd_serve_gateway(args) -> int:
+    """Run the HTTP serving gateway over one or more policy bundles.
+
+    The network front of the serving stack (serve/gateway.py): remote
+    households POST observations to ``/v1/act`` and get greedy actions,
+    coalesced through the same microbatch queue serve-bench measures.
+    Multiple ``--bundle`` flags register multiple bundles in the hot-swap
+    registry (first = default); ``POST /admin/swap`` retargets or splits
+    traffic at runtime. Without ``--bundle``, a fresh-init bundle for the
+    configured setting is exported first (the smoke path).
+
+    Prints one ``gateway_listening`` JSON line (host, resolved port,
+    registered bundle hashes) once the socket accepts, then serves until
+    SIGINT/Ctrl-C (or ``--serve-seconds``), drains in-flight requests, and
+    optionally writes the final ``/stats`` snapshot to ``--stats-out``
+    (the ``GATEWAY_STATS_*.json`` capture schema).
+    """
+    import asyncio
+
+    from p2pmicrogrid_tpu.serve import AdmissionConfig, build_gateway
+
+    bundles = list(args.bundle or [])
+    if not bundles:
+        import tempfile
+
+        import jax
+
+        from p2pmicrogrid_tpu.serve import export_policy_bundle
+        from p2pmicrogrid_tpu.train import init_policy_state
+
+        cfg = _build_cfg(args)
+        tmp = tempfile.mkdtemp(prefix="p2p-bundle-")
+        ps = init_policy_state(cfg, jax.random.PRNGKey(cfg.train.seed))
+        bundles = [export_policy_bundle(cfg, ps, tmp)]
+        print(
+            f"serve-gateway: no --bundle given; exported a fresh-init "
+            f"{cfg.train.implementation} bundle to {bundles[0]}",
+            file=sys.stderr,
+            flush=True,
+        )
+    gateway = build_gateway(
+        bundles,
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1e3,
+        results_db=args.results_db,
+        device=getattr(args, "serve_device", "auto"),
+        admission=AdmissionConfig(
+            max_queue_depth=args.max_queue_depth,
+            wait_budget_ms=args.wait_budget_ms,
+            retry_after_s=args.retry_after_s,
+        ),
+        host=args.host,
+        port=args.port,
+    )
+
+    async def run() -> None:
+        host, port = await gateway.start()
+        print(
+            json.dumps(
+                {
+                    "kind": "gateway_listening",
+                    "host": host,
+                    "port": port,
+                    "bundles": gateway.registry.hashes,
+                    "default": gateway.registry.default_hash,
+                }
+            ),
+            flush=True,
+        )
+        try:
+            if args.serve_seconds > 0:
+                await asyncio.sleep(args.serve_seconds)
+            else:
+                await asyncio.Event().wait()  # until cancelled (Ctrl-C)
+        finally:
+            await gateway.stop(drain=True)
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    if args.stats_out:
+        with open(args.stats_out, "w") as f:
+            json.dump(gateway.stats_snapshot(), f, indent=2)
+        print(f"serve-gateway: stats -> {args.stats_out}", file=sys.stderr)
     return 0
 
 
@@ -1449,6 +1605,46 @@ def cmd_telemetry_query(args) -> int:
         TELEMETRY_JOIN_SQL,
         TELEMETRY_SCHEMA_VERSION,
     )
+
+    if getattr(args, "compact", False):
+        # Retention pass (the ONE write mode this command has): roll
+        # per-request serve telemetry older than the window into
+        # per-bucket aggregates so a long-running gateway's warehouse
+        # stays bounded. Opens read-write, on an existing DB only.
+        import os
+
+        from p2pmicrogrid_tpu.data.results import compact_serve_telemetry
+
+        if not os.path.exists(args.results_db):
+            print(f"no such results DB: {args.results_db}", file=sys.stderr)
+            return 1
+        con = sqlite3.connect(args.results_db)
+        try:
+            try:
+                summary = compact_serve_telemetry(
+                    con, older_than_s=args.older_than_hours * 3600.0
+                )
+            except sqlite3.OperationalError as err:
+                if "no such table" in str(err):
+                    summary = {"rows_compacted": 0, "aggregates_written": 0}
+                else:
+                    print(f"SQL error: {err}", file=sys.stderr)
+                    return 1
+            except sqlite3.Error as err:
+                print(f"SQL error: {err}", file=sys.stderr)
+                return 1
+            print(
+                json.dumps(
+                    {
+                        "compacted": summary,
+                        "older_than_hours": args.older_than_hours,
+                        "results_db": args.results_db,
+                    }
+                )
+            )
+            return 0
+        finally:
+            con.close()
 
     # Read-only open: querying must never create a DB, run migrations, or
     # let --sql mutate the warehouse.
@@ -1933,7 +2129,74 @@ def main(argv=None) -> int:
                         "communities from host XLA-CPU per the measured "
                         "crossover (train/placement.py), like training "
                         "does; 'default' pins the default backend")
+    p.add_argument("--network", action="store_true",
+                   help="wire-level mode: start an in-process serve gateway "
+                        "on an ephemeral port and fire the same open-loop "
+                        "schedule over real sockets; the headline row "
+                        "carries wire p50/p95/p99 and the admission-control "
+                        "shed rate")
+    p.add_argument("--households", type=int, default=16,
+                   help="--network: distinct simulated household ids cycling "
+                        "over the request stream (default 16)")
+    p.add_argument("--max-queue-depth", type=int, default=256,
+                   dest="max_queue_depth",
+                   help="--network: admission-control queue-depth budget "
+                        "(429 at/above it; default 256)")
+    p.add_argument("--wait-budget-ms", type=float, default=50.0,
+                   dest="wait_budget_ms",
+                   help="--network: admission-control p95 coalescing-wait "
+                        "budget in ms (default 50)")
     p.set_defaults(fn=cmd_serve_bench)
+
+    p = sub.add_parser(
+        "serve-gateway",
+        help="run the HTTP serving gateway: POST /v1/act over the "
+             "microbatch queue, /healthz /readyz /stats, hot-swap + A/B "
+             "via POST /admin/swap, admission control, drain on exit",
+    )
+    _add_common(p)
+    p.add_argument("--bundle", action="append",
+                   help="policy bundle directory; repeat to register "
+                        "multiple bundles in the hot-swap registry (first "
+                        "is the default). Omitted: export a fresh-init "
+                        "bundle for the configured setting")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default 127.0.0.1)")
+    p.add_argument("--port", type=_nonneg_int, default=8377,
+                   help="bind port; 0 picks an ephemeral port, printed in "
+                        "the gateway_listening line (default 8377)")
+    p.add_argument("--max-batch", type=_pow2_int, default=64,
+                   dest="max_batch",
+                   help="microbatch coalescing cap per bundle; power of two "
+                        "(default 64)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   dest="max_wait_ms",
+                   help="max coalescing wait for the oldest queued request, "
+                        "ms (default 2)")
+    p.add_argument("--max-queue-depth", type=int, default=256,
+                   dest="max_queue_depth",
+                   help="admission control: shed (429 + Retry-After) when a "
+                        "bundle's queue depth reaches this (default 256)")
+    p.add_argument("--wait-budget-ms", type=float, default=50.0,
+                   dest="wait_budget_ms",
+                   help="admission control: shed when the recent p95 "
+                        "coalescing wait exceeds this budget, ms "
+                        "(default 50)")
+    p.add_argument("--retry-after-s", type=float, default=1.0,
+                   dest="retry_after_s",
+                   help="Retry-After header value on shed responses, "
+                        "seconds (default 1)")
+    p.add_argument("--serve-device", choices=["auto", "default", "cpu"],
+                   default="auto", dest="serve_device",
+                   help="engine placement (see serve-bench)")
+    p.add_argument("--serve-seconds", type=float, default=0.0,
+                   dest="serve_seconds",
+                   help="serve for this many seconds then drain and exit "
+                        "(0 = until Ctrl-C; smoke tests use a bounded run)")
+    p.add_argument("--stats-out", dest="stats_out",
+                   help="write the final /stats snapshot JSON here on exit "
+                        "(the GATEWAY_STATS_*.json capture schema)")
+    p.set_defaults(fn=cmd_serve_gateway)
 
     p = sub.add_parser(
         "telemetry-query",
@@ -1961,6 +2224,16 @@ def main(argv=None) -> int:
                    dest="max_polls",
                    help="--watch: stop after this many polls (0 = forever; "
                         "scripts/tests use it for bounded tails)")
+    p.add_argument("--compact", action="store_true",
+                   help="retention pass instead of a query: roll "
+                        "per-request serve_request telemetry older than "
+                        "--older-than-hours into per-bucket aggregate "
+                        "points (bounds a long-running gateway's "
+                        "warehouse); prints a JSON summary")
+    p.add_argument("--older-than-hours", type=float, default=24.0,
+                   dest="older_than_hours",
+                   help="--compact: keep this many hours of per-request "
+                        "rows raw (default 24)")
     p.set_defaults(fn=cmd_telemetry_query)
 
     p = sub.add_parser("analyse", help="statistics + figures from a results DB")

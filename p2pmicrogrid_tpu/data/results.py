@@ -123,6 +123,161 @@ _TELEMETRY_DDL = [
 ]
 
 
+def compact_serve_telemetry(
+    con: sqlite3.Connection,
+    older_than_s: float,
+    now: Optional[float] = None,
+) -> dict:
+    """Roll per-request ``serve_request`` telemetry_points older than
+    ``older_than_s`` seconds into per-(run, bucket) aggregate points.
+
+    A long-running gateway emits one ``serve_request`` row per served
+    request — unbounded growth for exactly the table that matters most in
+    production (ROADMAP warehouse follow-on). Compaction keeps the recent
+    window raw (per-request debugging stays possible) and replaces the
+    old tail with ``serve_request_agg`` points: one per (run_id, padding
+    bucket) per compaction pass, carrying the request count (``value``),
+    wait/service/latency stats and the compacted time window, so SLO
+    queries over history still work — at per-bucket resolution instead of
+    per-request.
+
+    Idempotent over already-compacted history (aggregates are a different
+    ``kind`` and are never re-compacted). Returns
+    ``{"rows_compacted": n, "aggregates_written": m}``.
+
+    Memory stays flat in the number of compacted rows — the whole point
+    is warehouses too big to hold: the cursor streams, per-group stats
+    keep exact count/mean/max plus a fixed-size deterministic reservoir
+    for the percentiles (exact whenever a group has <= 4096 rows), and
+    deletion reuses the selection predicate instead of materializing row
+    keys. One assumption: the retention window must exceed the sinks'
+    flush latency (seconds), or rows flushed between the scan and the
+    delete could be dropped un-aggregated.
+    """
+    import json as _json
+    import random as _random
+
+    now = _time.time() if now is None else now
+    cutoff = now - max(float(older_than_s), 0.0)
+
+    reservoir_k = 4096
+    rng = _random.Random(0)
+
+    class _Stream:
+        """Exact n/mean/max + reservoir-sampled percentiles."""
+
+        __slots__ = ("n", "total", "max", "sample")
+
+        def __init__(self):
+            self.n, self.total, self.max, self.sample = 0, 0.0, None, []
+
+        def add(self, v: float) -> None:
+            self.n += 1
+            self.total += v
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self.sample) < reservoir_k:
+                self.sample.append(v)
+            else:
+                j = rng.randrange(self.n)
+                if j < reservoir_k:
+                    self.sample[j] = v
+
+        def stats(self) -> dict:
+            if not self.n:
+                return {}
+            a = np.asarray(self.sample, dtype=float)
+            return {
+                "mean": round(self.total / self.n, 3),
+                "p50": round(float(np.percentile(a, 50)), 3),
+                "p95": round(float(np.percentile(a, 95)), 3),
+                "max": round(float(self.max), 3),
+            }
+
+    groups: dict = {}
+    n_rows = 0
+    cursor = con.execute(
+        "SELECT run_id, ts, attrs_json FROM telemetry_points "
+        "WHERE kind = 'serve_request' AND ts IS NOT NULL AND ts < ?",
+        (cutoff,),
+    )
+    for run_id, ts, attrs_json in cursor:
+        n_rows += 1
+        try:
+            attrs = _json.loads(attrs_json) if attrs_json else {}
+        except ValueError:
+            attrs = {}
+        key = (run_id, int(attrs.get("bucket", -1)))
+        g = groups.get(key)
+        if g is None:
+            g = groups[key] = {
+                "n": 0, "ts_min": ts, "ts_max": ts, "padded_rows": 0,
+                "wait_ms": _Stream(), "service_ms": _Stream(),
+                "latency_ms": _Stream(),
+            }
+        g["n"] += 1
+        g["ts_min"] = min(g["ts_min"], ts)
+        g["ts_max"] = max(g["ts_max"], ts)
+        for field_name in ("wait_ms", "service_ms", "latency_ms"):
+            v = attrs.get(field_name)
+            if isinstance(v, (int, float)):
+                g[field_name].add(float(v))
+        pr = attrs.get("padded_rows")
+        if isinstance(pr, (int, float)):
+            g["padded_rows"] += int(pr)
+    if not n_rows:
+        return {"rows_compacted": 0, "aggregates_written": 0}
+
+    # Aggregate rows live in a disjoint seq namespace: a LIVE SqliteSink
+    # for the same run keeps its own in-memory counter (starting at 0), so
+    # allocating MAX(seq)+1 here would collide with the sink's next insert
+    # and silently drop its telemetry from then on. Seqs at/above this
+    # base are unreachable by a streaming sink (it would need 2^40 points
+    # per run), so compacting a live warehouse is safe.
+    agg_seq_base = 1 << 40
+    agg_rows = []
+    next_seq: dict = {}
+    for (run_id, bucket), g in sorted(groups.items()):
+        if run_id not in next_seq:
+            (max_seq,) = con.execute(
+                "SELECT COALESCE(MAX(seq), -1) FROM telemetry_points "
+                "WHERE run_id = ? AND seq >= ?",
+                (run_id, agg_seq_base),
+            ).fetchone()
+            next_seq[run_id] = max(max_seq + 1, agg_seq_base)
+        attrs = {
+            "bucket": bucket,
+            "requests": g["n"],
+            "padded_rows": g["padded_rows"],
+            "ts_min": round(g["ts_min"], 3),
+            "ts_max": round(g["ts_max"], 3),
+            "wait_ms": g["wait_ms"].stats(),
+            "service_ms": g["service_ms"].stats(),
+            "latency_ms": g["latency_ms"].stats(),
+        }
+        agg_rows.append(
+            (
+                run_id, next_seq[run_id], round(g["ts_max"], 3),
+                "serve_request_agg", f"bucket_{bucket}",
+                float(g["n"]), _json.dumps(attrs),
+            )
+        )
+        next_seq[run_id] += 1
+
+    with con:
+        con.executemany(
+            "INSERT INTO telemetry_points VALUES (?,?,?,?,?,?,?)", agg_rows
+        )
+        deleted = con.execute(
+            "DELETE FROM telemetry_points WHERE kind = 'serve_request' "
+            "AND ts IS NOT NULL AND ts < ?",
+            (cutoff,),
+        ).rowcount
+    return {
+        "rows_compacted": int(deleted),
+        "aggregates_written": len(agg_rows),
+    }
+
+
 def ensure_telemetry_schema(con: sqlite3.Connection) -> int:
     """Create or migrate the telemetry warehouse tables on ``con``.
 
@@ -434,6 +589,16 @@ class ResultsStore:
                     _time.strftime("%Y-%m-%dT%H:%M:%S%z"),
                 ),
             )
+
+    def compact_serve_telemetry(
+        self, older_than_hours: float, now: Optional[float] = None
+    ) -> dict:
+        """Retention policy entry point (``telemetry-query --compact``):
+        roll per-request serve telemetry older than ``older_than_hours``
+        into per-bucket aggregates. See ``compact_serve_telemetry``."""
+        return compact_serve_telemetry(
+            self.con, older_than_s=older_than_hours * 3600.0, now=now
+        )
 
     def get_eval_runs(self):
         return self._read("eval_runs")

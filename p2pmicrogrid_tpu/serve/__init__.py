@@ -12,13 +12,25 @@ trained policy given its observation:
                power-of-two padding buckets of pre-compiled programs, with
                stateful per-household sessions and a microbatching queue.
 * ``loadgen``  open-loop Poisson request streams + latency/throughput/
-               padding-waste reporting (the ``serve-bench`` CLI command).
+               padding-waste reporting (the ``serve-bench`` CLI command),
+               plus the wire-level network mode (``serve-bench --network``).
+* ``registry`` multi-bundle routing table keyed by manifest config_hash:
+               atomic hot-swap, percentage-split A/B, household pinning.
+* ``gateway``  the network front (``serve-gateway`` CLI): asyncio HTTP/1.1
+               endpoints bridging remote households into the microbatch
+               queue, with admission control and drain-before-close.
 """
 
 from p2pmicrogrid_tpu.serve.engine import (
     MicroBatchQueue,
     PolicyEngine,
     Sessions,
+)
+from p2pmicrogrid_tpu.serve.gateway import (
+    AdmissionConfig,
+    GatewayServer,
+    ServeGateway,
+    build_gateway,
 )
 from p2pmicrogrid_tpu.serve.export import (
     BUNDLE_FORMAT_VERSION,
@@ -29,18 +41,29 @@ from p2pmicrogrid_tpu.serve.export import (
 from p2pmicrogrid_tpu.serve.loadgen import (
     plan_open_loop,
     poisson_arrivals,
+    run_network_loadgen,
     serve_bench,
+    serve_bench_network,
 )
+from p2pmicrogrid_tpu.serve.registry import BundleRegistry, ServingBundle
 
 __all__ = [
+    "AdmissionConfig",
     "BUNDLE_FORMAT_VERSION",
+    "BundleRegistry",
+    "GatewayServer",
     "MicroBatchQueue",
     "PolicyEngine",
+    "ServeGateway",
+    "ServingBundle",
     "Sessions",
+    "build_gateway",
     "export_bundle_from_checkpoint",
     "export_policy_bundle",
     "load_policy_bundle",
     "plan_open_loop",
     "poisson_arrivals",
+    "run_network_loadgen",
     "serve_bench",
+    "serve_bench_network",
 ]

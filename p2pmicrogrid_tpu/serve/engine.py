@@ -47,7 +47,8 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -122,8 +123,12 @@ class PolicyEngine:
         elif device == "auto":
             from p2pmicrogrid_tpu.train.placement import pick_serve_device
 
+            # Batch-width-aware: the serve-specific crossover table decides
+            # when one exists; wide-batch configs without a serve
+            # measurement stay on the default backend (the B=1 training
+            # table only governs max_batch=1 serving).
             self.device, self.placement_reason = pick_serve_device(
-                self._impl, self.n_agents
+                self._impl, self.n_agents, max_batch=self.max_batch
             )
         # Serving computes in float32 regardless of the on-disk dtype: a
         # float16 bundle halves storage/transfer, not arithmetic precision.
@@ -425,8 +430,23 @@ class MicroBatchQueue:
         self._pending: list = []  # (obs_row, Future)
         self._cv = threading.Condition()
         self._closed = False
+        # Bounded window of recent enqueue->dispatch waits, as
+        # (monotonic dispatch instant, wait ms) — the admission-control
+        # signal the serve gateway sheds on. Timestamped so readers can
+        # age samples out: only dispatches refresh this window, and a
+        # gateway shedding on a stale p95 would otherwise never admit the
+        # traffic that could refresh it (permanent shed). A deque, not the
+        # telemetry histogram: histograms grow unbounded over a
+        # long-running server and may not be attached at all.
+        self.recent_wait_ms: deque = deque(maxlen=512)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+
+    @property
+    def depth(self) -> int:
+        """Requests queued but not yet dispatched (admission signal)."""
+        with self._cv:
+            return len(self._pending)
 
     def submit(self, obs_row) -> Future:
         # host-sync: caller-supplied host observation row.
@@ -460,15 +480,31 @@ class MicroBatchQueue:
                 del self._pending[: self.max_batch]
             try:
                 dispatch_t = time.monotonic()
+                for _, _, t_enq in batch:
+                    self.recent_wait_ms.append(
+                        (dispatch_t, (dispatch_t - t_enq) * 1e3)
+                    )
                 out = self.engine.act(np.stack([row for row, _, _ in batch]))
                 service_s = time.monotonic() - dispatch_t
                 for i, (_, fut, _) in enumerate(batch):
-                    # host-sync: result delivery to the waiting future.
-                    fut.set_result(np.asarray(out[i]))
+                    # A caller may have given up mid-batch (the gateway's
+                    # request timeout cancels through wrap_future);
+                    # delivering to a cancelled future raises and must not
+                    # abort delivery to the batch's OTHER waiters.
+                    if fut.cancelled():
+                        continue
+                    try:
+                        # host-sync: result delivery to the waiting future.
+                        fut.set_result(np.asarray(out[i]))
+                    except InvalidStateError:
+                        pass  # cancelled between the check and delivery
             except Exception as err:  # noqa: BLE001 — fail the waiters, not the loop
                 for _, fut, _ in batch:
                     if not fut.done():
-                        fut.set_exception(err)
+                        try:
+                            fut.set_exception(err)
+                        except InvalidStateError:
+                            pass  # lost a cancellation race
                 continue
             try:
                 # AFTER result delivery, and fenced off: a sink hiccup (a

@@ -1,0 +1,699 @@
+"""Serve gateway: asyncio HTTP/1.1 network front over the inference engine.
+
+The engine + microbatch queue (serve/engine.py) serve in-process callers;
+real households are remote. This module is the wire between them — a
+stdlib-only (asyncio, no aiohttp) HTTP/1.1 server whose handlers submit
+into the SAME ``MicroBatchQueue`` the serve-bench SLO planner models, so
+the coalescing/padding-bucket behavior — and therefore the measured
+latency percentiles — transfer unchanged to network serving.
+
+Endpoints:
+
+* ``POST /v1/act``     ``{"household": id, "obs": [A][4] | [B][A][4]}`` ->
+                       ``{"actions": [A] | [B][A], "config_hash": ...}``.
+                       Each obs row is one queue submit: concurrent
+                       households coalesce into one padded engine batch
+                       exactly as in-process callers do.
+* ``GET  /healthz``    process liveness (200 once the server accepts).
+* ``GET  /readyz``     traffic readiness (503 while draining/bundle-less).
+* ``GET  /stats``      gateway + per-bundle snapshot (the schema
+                       ``tools/check_artifacts_schema.py`` validates for
+                       committed ``GATEWAY_STATS_*.json`` captures).
+* ``POST /admin/swap`` atomic default hot-swap and/or percentage-split A/B
+                       (``registry.BundleRegistry`` semantics).
+* ``POST /admin/drain``stop admitting act requests; in-flight complete.
+
+Design points:
+
+* **Admission control.** Accepting every request under overload just moves
+  queueing into the kernel and blows the tail; production batched servers
+  shed instead (PAPERS.md: Orca/AlpaServe). Before submitting, the gateway
+  checks the routed bundle's queue depth and recent p95 coalescing wait
+  against the configured budgets and answers ``429 Retry-After`` when
+  either is crossed — the shed rate is a headline serve-bench --network
+  stat, not a hidden failure mode.
+* **Telemetry joins on the SERVING bundle.** Every bundle gets its own
+  telemetry whose manifest carries that bundle's config_hash, and the
+  queue's existing per-request ``serve_request`` trace path streams into
+  it — so warehouse rows attribute each request to the exact config that
+  answered it, across swaps.
+* **Drain before close.** ``stop()`` (and SIGTERM handling in the CLI)
+  flips readiness, rejects new act requests with 503, waits for in-flight
+  requests to resolve, then closes queues/telemetry. A rolling restart
+  loses zero admitted requests.
+* **Bit-exact over the wire.** Responses serialize float32 actions through
+  JSON float64 repr, which round-trips binary32 exactly — the end-to-end
+  test asserts network responses byte-equal to a direct
+  ``PolicyEngine.act`` on the same observations.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from p2pmicrogrid_tpu.serve.registry import BundleRegistry, ServingBundle
+
+_JSON_HEADERS = (("Content-Type", "application/json"),)
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 413: "Payload Too Large",
+    429: "Too Many Requests", 500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """Load-shedding budgets for ``POST /v1/act``.
+
+    A request is shed (429 + ``Retry-After``) when the routed bundle's
+    queue depth reaches ``max_queue_depth``, or when the queue's recent
+    p95 enqueue->dispatch wait (over >= ``min_wait_samples`` samples)
+    exceeds ``wait_budget_ms``. ``max_request_rows`` bounds one request's
+    batch (413 above it); ``max_body_bytes`` bounds the HTTP body.
+    """
+
+    max_queue_depth: int = 256
+    wait_budget_ms: float = 50.0
+    retry_after_s: float = 1.0
+    min_wait_samples: int = 32
+    # Only wait samples younger than this enter the p95: the window is
+    # refreshed by dispatches, and shed requests never dispatch — without
+    # expiry, one overload burst would shed ALL traffic forever.
+    wait_window_s: float = 30.0
+    max_request_rows: int = 64
+    max_body_bytes: int = 1 << 20
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str, retry_after_s=None):
+        super().__init__(message)
+        self.status = status
+        self.payload = {"error": message}
+        self.retry_after_s = retry_after_s
+
+
+class ServeGateway:
+    """Asyncio HTTP front over a ``BundleRegistry``.
+
+    ``own_bundles=True`` makes ``stop()`` close the registry's queues and
+    telemetry (set by ``build_gateway``, which created them)."""
+
+    def __init__(
+        self,
+        registry: BundleRegistry,
+        admission: Optional[AdmissionConfig] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout_s: float = 30.0,
+        own_bundles: bool = False,
+    ):
+        self.registry = registry
+        self.admission = admission or AdmissionConfig()
+        self.host = host
+        self.port = port
+        self.request_timeout_s = request_timeout_s
+        self.own_bundles = own_bundles
+        self.created = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        self._t0 = time.monotonic()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._draining = False
+        self._inflight = 0
+        self._idle = asyncio.Event()
+        self._idle.set()
+        self.stats = {
+            "requests": 0, "act_requests": 0, "act_rows": 0, "act_ok": 0,
+            "shed": 0, "http_errors": 0, "swaps": 0, "drained": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> Tuple[str, int]:
+        """Bind and accept; returns (host, port) — port resolved when 0."""
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting act requests; already-admitted ones complete."""
+        self._draining = True
+        self.stats["drained"] += 1
+
+    async def drain(self, timeout_s: float = 30.0) -> None:
+        """``begin_drain`` then wait until no act request is in flight."""
+        self.begin_drain()
+        if self._inflight:
+            try:
+                await asyncio.wait_for(self._idle.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Drain (optionally), stop accepting, close owned bundles."""
+        if drain:
+            await self.drain(timeout_s)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if self.own_bundles:
+            self.registry.close_all()
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    async def _handle_connection(self, reader, writer) -> None:
+        try:
+            while True:
+                try:
+                    # The framing reads are bounded too: a client that
+                    # stalls mid-request (short body vs Content-Length) or
+                    # idles a keep-alive connection would otherwise pin a
+                    # handler task and socket forever.
+                    request = await asyncio.wait_for(
+                        self._read_request(reader), self.request_timeout_s
+                    )
+                except asyncio.TimeoutError:
+                    break
+                except _HttpError as err:
+                    # Framing-level failure (bad request line, oversized
+                    # body): answer it, then close — the stream position
+                    # is unknown, so the connection cannot be reused.
+                    self.stats["requests"] += 1
+                    self.stats["http_errors"] += 1
+                    await self._send(
+                        writer, err.status, err.payload, [], False
+                    )
+                    break
+                if request is None:
+                    break
+                method, path, headers, body = request
+                self.stats["requests"] += 1
+                try:
+                    status, payload, extra = await self._route(
+                        method, path, body
+                    )
+                except _HttpError as err:
+                    status, payload = err.status, err.payload
+                    extra = (
+                        [("Retry-After", f"{err.retry_after_s:g}")]
+                        if err.retry_after_s is not None else []
+                    )
+                    if status != 429:
+                        self.stats["http_errors"] += 1
+                except Exception as err:  # noqa: BLE001 — a handler bug must
+                    # answer 500, not kill the connection loop for every
+                    # other household multiplexed onto this server.
+                    status = 500
+                    payload = {"error": f"{type(err).__name__}: {err}"}
+                    extra = []
+                    self.stats["http_errors"] += 1
+                keep_alive = headers.get("connection", "").lower() != "close"
+                await self._send(writer, status, payload, extra, keep_alive)
+                if not keep_alive:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass  # client went away mid-request
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    _MAX_HEADERS = 128
+
+    async def _read_request(self, reader):
+        """One HTTP/1.1 request: (method, path, headers, body), or None on
+        a cleanly closed connection."""
+        try:
+            line = await reader.readline()
+        except ValueError:
+            # asyncio's stream limit (64 KiB) overran mid-line
+            # (LimitOverrunError is a ValueError): an abusive or broken
+            # client, not a server fault.
+            raise _HttpError(400, "request line too long") from None
+        if not line:
+            return None
+        parts = line.decode("latin-1").split()
+        if len(parts) < 3:
+            raise _HttpError(400, "malformed request line")
+        method, path = parts[0].upper(), parts[1]
+        headers = {}
+        while True:
+            if len(headers) >= self._MAX_HEADERS:
+                # An endless header stream would grow this dict without
+                # ever reaching the body-size check — cap it.
+                raise _HttpError(400, "too many headers")
+            try:
+                h = await reader.readline()
+            except ValueError:
+                raise _HttpError(400, "header line too long") from None
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        try:
+            length = int(headers.get("content-length", 0))
+        except ValueError:
+            raise _HttpError(400, "malformed Content-Length") from None
+        if length > self.admission.max_body_bytes:
+            raise _HttpError(
+                413,
+                f"body {length} bytes exceeds the "
+                f"{self.admission.max_body_bytes}-byte limit",
+            )
+        body = await reader.readexactly(length) if length else b""
+        return method, path, headers, body
+
+    async def _send(
+        self, writer, status: int, payload: dict, extra_headers, keep_alive
+    ) -> None:
+        body = json.dumps(payload).encode()
+        headers = [
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+            f"Content-Length: {len(body)}",
+            f"Connection: {'keep-alive' if keep_alive else 'close'}",
+        ]
+        headers.extend(f"{k}: {v}" for k, v in _JSON_HEADERS)
+        headers.extend(f"{k}: {v}" for k, v in extra_headers)
+        writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+
+    # -- routing -------------------------------------------------------------
+
+    async def _route(self, method: str, path: str, body: bytes):
+        if path == "/healthz":
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            return 200, {"ok": True, "uptime_s": self.uptime_s}, []
+        if path == "/readyz":
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            ready = not self._draining and self.registry.default_hash
+            if not ready:
+                return 503, {
+                    "ready": False,
+                    "reason": "draining" if self._draining else "no bundles",
+                }, []
+            return 200, {"ready": True}, []
+        if path == "/stats":
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            return 200, self.stats_snapshot(), []
+        if path == "/v1/act":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            return await self._act(body)
+        if path == "/admin/swap":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            return self._swap(body)
+        if path == "/admin/drain":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            self.begin_drain()
+            return 200, {"draining": True, "inflight": self._inflight}, []
+        raise _HttpError(404, f"no route {path}")
+
+    @staticmethod
+    def _parse_json(body: bytes) -> dict:
+        try:
+            doc = json.loads(body.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as err:
+            raise _HttpError(400, f"body is not valid JSON: {err}") from None
+        if not isinstance(doc, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        return doc
+
+    def _parse_obs(self, doc: dict, n_agents: int):
+        """(obs [B, A, 4] float32, batched: bool) from the request body."""
+        if "obs" not in doc:
+            raise _HttpError(400, "missing 'obs'")
+        try:
+            # host-sync: caller-supplied JSON observations, not device values.
+            obs = np.asarray(doc["obs"], dtype=np.float32)
+        except (TypeError, ValueError) as err:
+            raise _HttpError(400, f"obs is not numeric: {err}") from None
+        batched = obs.ndim == 3
+        if obs.ndim == 2:
+            obs = obs[None]
+        if obs.ndim != 3 or obs.shape[1:] != (n_agents, 4):
+            raise _HttpError(
+                400,
+                f"obs must be [{n_agents}, 4] or [B, {n_agents}, 4] "
+                f"for this bundle, got {list(obs.shape)}",
+            )
+        if obs.shape[0] > self.admission.max_request_rows:
+            raise _HttpError(
+                413,
+                f"batch of {obs.shape[0]} exceeds the "
+                f"{self.admission.max_request_rows}-row request limit",
+            )
+        return obs, batched
+
+    def _admit(self, bundle: ServingBundle) -> None:
+        """Raise 429 when the routed bundle's queue is over budget."""
+        adm = self.admission
+        depth = bundle.queue.depth
+        if depth >= adm.max_queue_depth:
+            self.stats["shed"] += 1
+            raise _HttpError(
+                429,
+                f"queue depth {depth} at/above budget {adm.max_queue_depth}",
+                retry_after_s=adm.retry_after_s,
+            )
+        now = time.monotonic()
+        waits = [
+            w for t, w in list(bundle.queue.recent_wait_ms)
+            if now - t <= adm.wait_window_s
+        ]
+        if len(waits) >= adm.min_wait_samples:
+            p95 = float(np.percentile(waits, 95))
+            if p95 > adm.wait_budget_ms:
+                self.stats["shed"] += 1
+                raise _HttpError(
+                    429,
+                    f"p95 queue wait {p95:.1f} ms over the "
+                    f"{adm.wait_budget_ms:g} ms budget",
+                    retry_after_s=adm.retry_after_s,
+                )
+
+    async def _act(self, body: bytes):
+        self.stats["act_requests"] += 1
+        if self._draining:
+            raise _HttpError(
+                503, "gateway is draining",
+                retry_after_s=self.admission.retry_after_s,
+            )
+        doc = self._parse_json(body)
+        household = doc.get("household")
+        if household is not None and not isinstance(household, str):
+            raise _HttpError(400, "household must be a string")
+        try:
+            bundle = self.registry.route(household)
+        except RuntimeError as err:
+            raise _HttpError(503, str(err)) from None
+        obs, batched = self._parse_obs(doc, bundle.engine.n_agents)
+        self._admit(bundle)
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            futures = [bundle.queue.submit(row) for row in obs]
+            rows = await asyncio.wait_for(
+                asyncio.gather(*(asyncio.wrap_future(f) for f in futures)),
+                timeout=self.request_timeout_s,
+            )
+        except asyncio.TimeoutError:
+            raise _HttpError(
+                500, f"inference timed out after {self.request_timeout_s:g}s"
+            ) from None
+        except RuntimeError as err:
+            # ONLY the queue's own shutdown-race signal is a retriable 503;
+            # other RuntimeErrors include engine faults (XlaRuntimeError
+            # subclasses RuntimeError) which must answer 500 — a client
+            # retrying a permanently broken engine on 503 never stops.
+            if "queue is closed" in str(err):
+                raise _HttpError(503, str(err)) from None
+            raise
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
+        self.stats["act_rows"] += len(rows)
+        self.stats["act_ok"] += 1
+        # float32 -> Python float (binary64) is exact, and json round-trips
+        # binary64 — network actions are bit-identical to engine.act's.
+        actions: List = [[float(a) for a in row] for row in rows]
+        return 200, {
+            "actions": actions if batched else actions[0],
+            "config_hash": bundle.config_hash,
+        }, []
+
+    def _swap(self, body: bytes):
+        doc = self._parse_json(body)
+        new_default = doc.get("config_hash")
+        split = doc.get("split", "__absent__")
+        if new_default is None and split == "__absent__":
+            raise _HttpError(400, "pass 'config_hash' and/or 'split'")
+        # Validate the WHOLE request before mutating anything: a combined
+        # swap+split must not retarget the default (and clear every
+        # household pin) and then 404 on the split half — the operator
+        # would read the error as "nothing happened" while traffic had
+        # already re-routed. Handlers run on one event loop, so nothing
+        # races between this validation and the mutations below.
+        hashes = self.registry.hashes
+        arm = percent = None
+        if new_default is not None:
+            if not isinstance(new_default, str):
+                raise _HttpError(400, "config_hash must be a string")
+            if new_default not in hashes:
+                raise _HttpError(
+                    404, f"unknown config_hash: {new_default}"
+                )
+        if split != "__absent__" and split is not None:
+            if not isinstance(split, dict):
+                raise _HttpError(
+                    400, "split must be {'config_hash':, 'percent':} or null"
+                )
+            arm = split.get("config_hash")
+            if not isinstance(arm, str):
+                raise _HttpError(400, "split config_hash must be a string")
+            if arm not in hashes:
+                raise _HttpError(404, f"unknown config_hash: {arm}")
+            try:
+                percent = float(split.get("percent", 0.0))
+            except (TypeError, ValueError):
+                raise _HttpError(400, "split percent must be a number") from None
+            if not 0.0 < percent < 100.0:
+                raise _HttpError(
+                    400, f"percent must be in (0, 100), got {percent:g}"
+                )
+            effective_default = (
+                new_default if new_default is not None
+                else self.registry.default_hash
+            )
+            if arm == effective_default:
+                raise _HttpError(
+                    400, "split arm must differ from the default bundle"
+                )
+        try:
+            if new_default is not None:
+                self.registry.swap(new_default)
+                self.stats["swaps"] += 1
+            if split != "__absent__":
+                if split is None:
+                    self.registry.clear_split()
+                else:
+                    self.registry.set_split(arm, percent)
+        except KeyError as err:  # backstop — pre-validated above
+            raise _HttpError(
+                404, f"unknown config_hash: {err.args[0]}"
+            ) from None
+        except (ValueError, TypeError) as err:
+            raise _HttpError(400, str(err)) from None
+        return 200, {
+            "default": self.registry.default_hash,
+            "split": (
+                {"config_hash": self.registry.split[0],
+                 "percent": self.registry.split[1]}
+                if self.registry.split else None
+            ),
+            "bundles": self.registry.hashes,
+        }, []
+
+    # -- stats ---------------------------------------------------------------
+
+    @property
+    def uptime_s(self) -> float:
+        return round(time.monotonic() - self._t0, 3)
+
+    def stats_snapshot(self) -> dict:
+        """The ``/stats`` document (and the committed ``GATEWAY_STATS_*``
+        capture schema tools/check_artifacts_schema.py validates)."""
+        reg = self.registry.stats()
+        return {
+            "kind": "gateway_stats",
+            "created": self.created,
+            "uptime_s": self.uptime_s,
+            "draining": self._draining,
+            "default": reg["default"],
+            "split": reg["split"],
+            "swap_count": reg["swap_count"],
+            "gateway": dict(self.stats, inflight=self._inflight),
+            "admission": {
+                "max_queue_depth": self.admission.max_queue_depth,
+                "wait_budget_ms": self.admission.wait_budget_ms,
+                "retry_after_s": self.admission.retry_after_s,
+                "max_request_rows": self.admission.max_request_rows,
+                "shed_total": self.stats["shed"],
+            },
+            "bundles": reg["bundles"],
+        }
+
+
+# -- construction -------------------------------------------------------------
+
+
+def build_gateway(
+    bundle_dirs,
+    max_batch: int = 64,
+    max_wait_s: float = 0.002,
+    results_db: Optional[str] = None,
+    device: str = "auto",
+    admission: Optional[AdmissionConfig] = None,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    warmup: bool = True,
+    run_name: str = "gateway",
+) -> ServeGateway:
+    """Load each bundle dir into an engine + queue + per-bundle telemetry
+    and return a gateway owning them (first bundle is the default).
+
+    With ``results_db``, every bundle's telemetry streams into the SQLite
+    warehouse keyed by THAT bundle's config_hash — the per-request
+    ``serve_request`` traces the microbatch queue already emits become
+    SQL-joinable to the training/eval rows of the config being served.
+    """
+    from p2pmicrogrid_tpu.serve.engine import MicroBatchQueue, PolicyEngine
+    from p2pmicrogrid_tpu.serve.export import load_policy_bundle
+    from p2pmicrogrid_tpu.telemetry import (
+        SqliteSink,
+        Telemetry,
+        run_manifest,
+    )
+    from p2pmicrogrid_tpu.telemetry.registry import run_stamp
+
+    if not bundle_dirs:
+        raise ValueError("pass at least one bundle directory")
+    registry = BundleRegistry()
+    stamp = run_stamp()
+    pending_tel = pending_queue = None
+    try:
+        for i, bundle_dir in enumerate(bundle_dirs):
+            manifest, params = load_policy_bundle(bundle_dir)
+            config_hash = manifest.get("config_hash")
+            pending_tel = Telemetry(
+                run_id=f"{run_name}-{stamp}-{i}",
+                sinks=[SqliteSink(results_db)] if results_db else [],
+                manifest=run_manifest(
+                    extra={
+                        "config_hash": config_hash,
+                        "setting": manifest.get("setting"),
+                        "serve_bundle": bundle_dir,
+                        "serve_role": "default" if i == 0 else "candidate",
+                    }
+                ),
+            )
+            engine = PolicyEngine(
+                manifest=manifest, params=params, max_batch=max_batch,
+                telemetry=pending_tel, device=device,
+            )
+            if warmup:
+                # Compile every padding bucket before the socket opens —
+                # the first remote household must not pay an XLA compile
+                # in-slot.
+                engine.warmup(include_step=False)
+            pending_queue = MicroBatchQueue(engine, max_wait_s=max_wait_s)
+            registry.register(
+                engine, pending_queue, telemetry=pending_tel,
+                default=(i == 0),
+            )
+            pending_tel = pending_queue = None  # ownership -> registry
+    except BaseException:
+        # A later bundle failing to load must not strand the earlier
+        # bundles' queue worker threads or their buffered warehouse rows
+        # (the caller gets an exception, not a handle to clean up).
+        if pending_queue is not None:
+            pending_queue.close()
+        if pending_tel is not None:
+            pending_tel.close()
+        registry.close_all()
+        raise
+    return ServeGateway(
+        registry, admission=admission, host=host, port=port, own_bundles=True
+    )
+
+
+class GatewayServer:
+    """Synchronous facade: run a ``ServeGateway`` on a daemon thread with
+    its own event loop (tests, the serve-bench ``--network`` harness, and
+    anything else that needs a live socket without owning a loop)."""
+
+    def __init__(self, gateway: ServeGateway):
+        self.gateway = gateway
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, timeout_s: float = 60.0) -> Tuple[str, int]:
+        started = threading.Event()
+        failure: list = []
+
+        def run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            try:
+                loop.run_until_complete(self.gateway.start())
+            except Exception as err:  # noqa: BLE001 — surface to start()
+                # self._loop stays unset: stop() must short-circuit, not
+                # block scheduling a coroutine on a loop that will never
+                # run (that would mask this error behind a timeout).
+                failure.append(err)
+                loop.close()
+                started.set()
+                return
+            self._loop = loop
+            started.set()
+            try:
+                loop.run_forever()
+            finally:
+                loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        if not started.wait(timeout_s):
+            raise TimeoutError("gateway did not start in time")
+        if failure:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+            if self.gateway.own_bundles:
+                # The caller gets an exception, not a handle to clean up:
+                # the bundles build_gateway created (queue worker threads,
+                # buffered warehouse sinks) must not leak here.
+                self.gateway.registry.close_all()
+            raise failure[0]
+        return self.gateway.host, self.gateway.port
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        if self._loop is None:
+            return
+        future = asyncio.run_coroutine_threadsafe(
+            self.gateway.stop(drain=drain, timeout_s=timeout_s), self._loop
+        )
+        try:
+            future.result(timeout=timeout_s + 5.0)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._thread is not None:
+                self._thread.join(timeout=10.0)
+            self._loop = None
+            self._thread = None
+
+    def __enter__(self) -> "GatewayServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -28,6 +28,8 @@ headline row carrying every stat).
 
 from __future__ import annotations
 
+import asyncio
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
@@ -171,6 +173,249 @@ def _emit_request_traces(tel, arrivals: np.ndarray, result: LoadgenResult) -> No
                 service_ms=round(service_ms, 3),
                 latency_ms=round(float(result.latencies_s[r]) * 1e3, 3),
             )
+
+
+# --- wire-level load generation (serve-bench --network) ----------------------
+#
+# The virtual-clock planner above answers "what do the QUEUE + DEVICE cost?";
+# the network mode answers "what does a household actually SEE?" — the same
+# open-loop Poisson schedule fired over real sockets at the serve gateway
+# (serve/gateway.py), so wire latencies include HTTP framing, the asyncio
+# handler, queue coalescing and the engine batch. Shed requests (admission
+# control answering 429) are a first-class stat, not an error.
+
+
+@dataclass
+class NetworkLoadgenResult:
+    """Per-request wire measurements from one network loadgen run."""
+
+    latencies_s: np.ndarray    # [N] send -> full response, ALL requests
+    statuses: np.ndarray       # [N] HTTP status (-1 = transport error)
+    config_hashes: List       # per request: serving bundle hash (None if shed)
+    makespan_s: float          # first send -> last completion
+
+    @property
+    def n_requests(self) -> int:
+        return int(self.statuses.shape[0])
+
+    @property
+    def n_ok(self) -> int:
+        return int((self.statuses == 200).sum())
+
+    @property
+    def n_shed(self) -> int:
+        return int((self.statuses == 429).sum())
+
+    @property
+    def n_errors(self) -> int:
+        return int(
+            ((self.statuses != 200) & (self.statuses != 429)).sum()
+        )
+
+    @property
+    def shed_rate(self) -> float:
+        return self.n_shed / self.n_requests if self.n_requests else 0.0
+
+    @property
+    def throughput_rps(self) -> float:
+        return self.n_ok / self.makespan_s if self.makespan_s > 0 else 0.0
+
+    def latency_ms(self, q: float) -> float:
+        """Percentile over SERVED requests (shed answers return in
+        microseconds and would flatter the tail)."""
+        ok = self.latencies_s[self.statuses == 200]
+        return float(np.percentile(ok, q) * 1e3) if ok.size else 0.0
+
+
+async def _http_post_json(
+    host: str, port: int, path: str, payload: dict, timeout_s: float
+):
+    """One POST over a fresh connection; returns (status, parsed body).
+    Stdlib-only HTTP/1.1 — mirrors the gateway's server side."""
+    body = json.dumps(payload).encode()
+    request = (
+        f"POST {path} HTTP/1.1\r\n"
+        f"Host: {host}:{port}\r\n"
+        "Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        "Connection: close\r\n\r\n"
+    ).encode() + body
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout_s
+    )
+    try:
+        writer.write(request)
+        await writer.drain()
+        status_line = await asyncio.wait_for(reader.readline(), timeout_s)
+        parts = status_line.decode("latin-1").split()
+        status = int(parts[1]) if len(parts) >= 2 else -1
+        length = 0
+        while True:
+            h = await asyncio.wait_for(reader.readline(), timeout_s)
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1").partition(":")
+            if name.strip().lower() == "content-length":
+                length = int(value.strip())
+        raw = (
+            await asyncio.wait_for(reader.readexactly(length), timeout_s)
+            if length else b""
+        )
+        try:
+            doc = json.loads(raw.decode()) if raw else {}
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            doc = {}
+        return status, doc
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+
+def run_network_loadgen(
+    host: str,
+    port: int,
+    obs: np.ndarray,
+    arrivals: np.ndarray,
+    households: List[str],
+    path: str = "/v1/act",
+    timeout_s: float = 30.0,
+) -> NetworkLoadgenResult:
+    """Fire ``obs[i]`` at the gateway at ``arrivals[i]`` seconds (open loop:
+    send times never wait on completions) and measure wire latencies.
+
+    One connection per request — each simulated household is an independent
+    remote client; connection reuse would serialize them onto shared
+    sockets and hide queueing the open-loop methodology exists to expose.
+    """
+    obs = np.asarray(obs, dtype=np.float32)  # host-sync: host-side inputs
+    arrivals = np.asarray(arrivals, dtype=float)
+    n = int(arrivals.shape[0])
+    latencies = np.zeros(n)
+    statuses = np.full(n, -1, dtype=np.int64)
+    hashes: List = [None] * n
+
+    async def one(i: int, t0: float) -> None:
+        delay = (arrivals[i] - arrivals[0]) - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        payload = {
+            "household": households[i % len(households)],
+            "obs": obs[i].tolist(),
+        }
+        t_send = time.perf_counter()
+        try:
+            status, doc = await _http_post_json(
+                host, port, path, payload, timeout_s
+            )
+        except (
+            ConnectionError, OSError, EOFError, ValueError,
+            asyncio.TimeoutError, asyncio.IncompleteReadError,
+        ):
+            # Transport failures score as status -1 (n_errors), they must
+            # not abort the whole open-loop schedule mid-run.
+            status, doc = -1, {}
+        latencies[i] = time.perf_counter() - t_send
+        statuses[i] = status
+        hashes[i] = doc.get("config_hash")
+
+    async def run() -> float:
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i, t0) for i in range(n)))
+        return time.perf_counter() - t0
+
+    makespan = asyncio.run(run())
+    return NetworkLoadgenResult(
+        latencies_s=latencies,
+        statuses=statuses,
+        config_hashes=hashes,
+        makespan_s=makespan,
+    )
+
+
+def serve_bench_network(
+    host: str,
+    port: int,
+    n_agents: int,
+    rate_hz: float = 256.0,
+    n_requests: int = 1024,
+    n_households: int = 16,
+    seed: int = 0,
+    slo_ms: float = 100.0,
+    timeout_s: float = 30.0,
+    emit: Optional[Callable[[dict], None]] = None,
+    extra_headline: Optional[dict] = None,
+) -> List[dict]:
+    """Wire-level SLO benchmark: the serve-bench schedule over real sockets.
+
+    Same row contract as ``serve_bench`` (metric rows, headline LAST), with
+    wire percentiles and the admission-control shed rate. ``vs_baseline``:
+    SLO headroom for latency rows, served/offered for throughput, and the
+    served fraction (1 - shed_rate) for the shed row.
+    """
+    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    obs = synthetic_obs(n_requests, n_agents, seed=seed)
+    households = [f"house-{i:04d}" for i in range(n_households)]
+    result = run_network_loadgen(
+        host, port, obs, arrivals, households, timeout_s=timeout_s
+    )
+    p50, p95, p99 = (result.latency_ms(q) for q in (50, 95, 99))
+    rows = [
+        {
+            "metric": f"serve_gateway_latency_ms_p{q}",
+            "value": round(v, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / v, 2) if v > 0 else 0.0,
+        }
+        for q, v in (("50", p50), ("95", p95), ("99", p99))
+    ]
+    rows.append(
+        {
+            "metric": "serve_gateway_throughput_rps",
+            "value": round(result.throughput_rps, 1),
+            "unit": "requests/sec",
+            "vs_baseline": round(result.throughput_rps / rate_hz, 3),
+        }
+    )
+    rows.append(
+        {
+            "metric": "serve_gateway_shed_rate",
+            "value": round(result.shed_rate, 4),
+            "unit": "fraction",
+            "vs_baseline": round(1.0 - result.shed_rate, 4),
+        }
+    )
+    served_hashes = sorted(
+        {h for h in result.config_hashes if h is not None}
+    )
+    rows.append(
+        {
+            "metric": "serve_bench_network",
+            "value": round(p99, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / p99, 2) if p99 > 0 else 0.0,
+            "p50_ms": round(p50, 3),
+            "p95_ms": round(p95, 3),
+            "p99_ms": round(p99, 3),
+            "throughput_rps": round(result.throughput_rps, 1),
+            "shed_rate": round(result.shed_rate, 4),
+            "n_requests": n_requests,
+            "n_ok": result.n_ok,
+            "n_shed": result.n_shed,
+            "n_errors": result.n_errors,
+            "n_households": n_households,
+            "offered_rate_rps": rate_hz,
+            "slo_ms": slo_ms,
+            "served_config_hashes": served_hashes,
+            **(extra_headline or {}),
+        }
+    )
+    if emit is not None:
+        for row in rows:
+            emit(row)
+    return rows
 
 
 def serve_bench(

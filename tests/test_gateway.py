@@ -1,0 +1,785 @@
+"""Serve gateway: network front + hot-swap registry + admission control.
+
+Tier-1 acceptance for ISSUE 5: a real socket server over the microbatch
+queue serves concurrent households bit-identically to a direct
+``PolicyEngine.act``, hot-swaps bundles mid-traffic with zero failed
+requests, sheds load with 429 under forced saturation, drains before
+close, and the wire-level serve-bench lands per-request traces in the
+SQLite warehouse keyed by the SERVING bundle's config_hash. Fast and
+JAX_PLATFORMS=cpu-safe by design.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.serve import (
+    AdmissionConfig,
+    BundleRegistry,
+    GatewayServer,
+    MicroBatchQueue,
+    PolicyEngine,
+    build_gateway,
+    export_policy_bundle,
+    serve_bench_network,
+)
+from p2pmicrogrid_tpu.train import init_policy_state
+
+A = 3  # community size for all gateway tests
+
+
+def _make_bundle(tmp_path, seed, name):
+    """A tabular bundle with non-trivial greedy structure; distinct seeds
+    give distinct config_hashes (the registry key)."""
+    cfg = default_config(
+        sim=SimConfig(n_agents=A),
+        train=TrainConfig(implementation="tabular", seed=seed),
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
+    ps = ps._replace(
+        q_table=jax.random.normal(
+            jax.random.PRNGKey(seed + 1), ps.q_table.shape
+        )
+    )
+    return export_policy_bundle(cfg, ps, str(tmp_path / name))
+
+
+def _obs(n, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = np.empty((n, A, 4), dtype=np.float32)
+    obs[..., 0] = rng.uniform(0, 1, (n, A))
+    obs[..., 1:] = rng.uniform(-1, 1, (n, A, 3))
+    return obs
+
+
+def _request(host, port, method, path, body=None, timeout=30):
+    """(status, parsed JSON, headers) over stdlib http.client — an
+    independent HTTP implementation exercising our server's framing."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(
+            method, path,
+            body=json.dumps(body) if isinstance(body, dict) else body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        raw = resp.read()
+        doc = json.loads(raw) if raw else {}
+        return resp.status, doc, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def two_bundle_server(tmp_path):
+    """A running gateway over two tabular bundles (ephemeral port)."""
+    b1 = _make_bundle(tmp_path, 0, "b1")
+    b2 = _make_bundle(tmp_path, 1, "b2")
+    # Permissive admission: these tests assert serving semantics, and a
+    # loaded CI machine must not trip the default wait budget under them
+    # (shedding has its own dedicated tests with forced budgets).
+    gateway = build_gateway(
+        [b1, b2], max_batch=4, max_wait_s=0.02,
+        admission=AdmissionConfig(
+            max_queue_depth=100_000, wait_budget_ms=100_000.0
+        ),
+    )
+    with GatewayServer(gateway) as server:
+        host, port = gateway.host, gateway.port
+        yield gateway, host, port
+    # server stopped (drained + bundles closed) by the context manager
+
+
+class TestRegistry:
+    def _engine_queue(self, tmp_path, seed, name):
+        bundle = _make_bundle(tmp_path, seed, name)
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+        return engine, MicroBatchQueue(engine, max_wait_s=0.005)
+
+    def test_register_route_swap(self, tmp_path):
+        e1, q1 = self._engine_queue(tmp_path, 0, "b1")
+        e2, q2 = self._engine_queue(tmp_path, 1, "b2")
+        reg = BundleRegistry()
+        h1 = reg.register(e1, q1)
+        h2 = reg.register(e2, q2)
+        assert h1 != h2 and reg.default_hash == h1
+        # Duplicate config_hash is refused — routing would be ambiguous.
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register(e1, q1)
+        assert reg.route("house-1").config_hash == h1
+        prev = reg.swap(h2)
+        assert prev == h1 and reg.default_hash == h2
+        assert reg.route("house-1").config_hash == h2
+        q1.close()
+        q2.close()
+
+    def test_split_is_deterministic_and_pins(self, tmp_path):
+        e1, q1 = self._engine_queue(tmp_path, 0, "b1")
+        e2, q2 = self._engine_queue(tmp_path, 1, "b2")
+        reg = BundleRegistry()
+        h1 = reg.register(e1, q1)
+        h2 = reg.register(e2, q2)
+        reg.set_split(h2, 30.0)
+        homes = [f"house-{i}" for i in range(64)]
+        first = {h: reg.route(h).config_hash for h in homes}
+        assert set(first.values()) == {h1, h2}  # both arms see traffic
+        # Affinity: repeated routing never flips a household's bundle
+        # (sessions carry cross-slot state).
+        for h in homes:
+            assert reg.route(h).config_hash == first[h]
+        # ... even after the split percent changes (pins hold).
+        reg.set_split(h2, 90.0)
+        for h in homes:
+            assert reg.route(h).config_hash == first[h]
+        # Anonymous requests (no household id) always serve the DEFAULT,
+        # whatever the split — hashing a constant empty id would dump ALL
+        # anonymous traffic onto one arm instead of a percentage.
+        assert reg.route(None).config_hash == h1
+        assert reg.route("").config_hash == h1
+        # A swap clears pins: everyone re-routes to the new default.
+        reg.clear_split()
+        reg.swap(h2)
+        assert all(reg.route(h).config_hash == h2 for h in homes)
+        q1.close()
+        q2.close()
+
+    def test_remove_guards_and_pin_cleanup(self, tmp_path):
+        e1, q1 = self._engine_queue(tmp_path, 0, "b1")
+        e2, q2 = self._engine_queue(tmp_path, 1, "b2")
+        reg = BundleRegistry()
+        h1 = reg.register(e1, q1)
+        h2 = reg.register(e2, q2)
+        with pytest.raises(ValueError, match="default"):
+            reg.remove(h1)
+        reg.set_split(h2, 50.0)
+        with pytest.raises(ValueError, match="split"):
+            reg.remove(h2)
+        reg.clear_split()
+        reg.swap(h2)
+        removed = reg.remove(h1)
+        assert removed.config_hash == h1
+        assert reg.route("anyone").config_hash == h2
+        with pytest.raises(KeyError):
+            reg.swap(h1)
+        q1.close()
+        q2.close()
+
+    def test_stats_snapshot(self, tmp_path):
+        e1, q1 = self._engine_queue(tmp_path, 0, "b1")
+        e2, q2 = self._engine_queue(tmp_path, 1, "b2")
+        reg = BundleRegistry()
+        h1 = reg.register(e1, q1)
+        h2 = reg.register(e2, q2)
+        # No split -> every route serves the default and records NO pin
+        # (a pin per household id would grow without bound for zero
+        # routing information at the millions-of-users scale).
+        reg.route("house-1")
+        s = reg.stats()
+        assert s["default"] == h1
+        assert s["bundles"][h1]["implementation"] == "tabular"
+        assert s["bundles"][h1]["pinned_households"] == 0
+        # Under a split, assignments pin (session affinity).
+        reg.set_split(h2, 50.0)
+        reg.route("house-1")
+        assert reg.pinned_count == 1
+        q1.close()
+        q2.close()
+
+
+class TestGatewayEndToEnd:
+    """The ISSUE 5 acceptance path: concurrent network requests from
+    multiple households across multiple padding buckets, bit-identical to
+    direct engine calls."""
+
+    def test_health_ready_stats(self, two_bundle_server):
+        gateway, host, port = two_bundle_server
+        status, doc, _ = _request(host, port, "GET", "/healthz")
+        assert status == 200 and doc["ok"] is True
+        status, doc, _ = _request(host, port, "GET", "/readyz")
+        assert status == 200 and doc["ready"] is True
+        status, doc, _ = _request(host, port, "GET", "/stats")
+        assert status == 200
+        assert doc["kind"] == "gateway_stats"
+        assert doc["default"] in doc["bundles"]
+        assert len(doc["bundles"]) == 2
+
+    def test_concurrent_households_two_buckets_bit_exact(
+        self, two_bundle_server
+    ):
+        gateway, host, port = two_bundle_server
+        default = gateway.registry.get(gateway.registry.default_hash)
+        engine = default.engine
+        obs = _obs(4, seed=7)
+
+        # Phase 1: one lone household -> a bucket-1 batch.
+        status, doc, _ = _request(
+            host, port, "POST", "/v1/act",
+            {"household": "house-solo", "obs": obs[0].tolist()},
+        )
+        assert status == 200
+        # Phase 2: three households fired concurrently coalesce inside the
+        # 20 ms window -> one batch of 3 padded to bucket 4.
+        results = [None] * 3
+
+        def fire(i):
+            results[i] = _request(
+                host, port, "POST", "/v1/act",
+                {"household": f"house-{i}", "obs": obs[1 + i].tolist()},
+            )
+
+        threads = [
+            threading.Thread(target=fire, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r[0] == 200 for r in results)
+
+        # >= 2 padding buckets were exercised (1 and 4): 4 rows in >= 2
+        # batches with at least one padded row.
+        assert engine.stats["rows"] == 4
+        assert engine.stats["batches"] >= 2
+        assert engine.stats["padded_rows"] >= 1
+
+        # Bit-exactness: network responses == direct engine.act on the
+        # same observations (discrete policy guarantee holds across the
+        # padding buckets the batches landed in).
+        want = engine.act(obs)
+        got = np.asarray(
+            [doc["actions"]] + [r[1]["actions"] for r in results],
+            dtype=np.float32,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_batched_request(self, two_bundle_server):
+        gateway, host, port = two_bundle_server
+        engine = gateway.registry.get(gateway.registry.default_hash).engine
+        obs = _obs(3, seed=11)
+        status, doc, _ = _request(
+            host, port, "POST", "/v1/act",
+            {"household": "house-b", "obs": obs.tolist()},
+        )
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.asarray(doc["actions"], np.float32), engine.act(obs)
+        )
+
+    def test_hot_swap_mid_traffic_zero_failures(self, two_bundle_server):
+        gateway, host, port = two_bundle_server
+        h1, h2 = gateway.registry.hashes
+        assert gateway.registry.default_hash == h1
+        obs = _obs(1)[0].tolist()
+        statuses, hashes = [], []
+        lock = threading.Lock()
+
+        def fire(i):
+            s, doc, _ = _request(
+                host, port, "POST", "/v1/act",
+                {"household": f"house-{i}", "obs": obs},
+            )
+            with lock:
+                statuses.append(s)
+                hashes.append(doc.get("config_hash"))
+
+        # Wave 1 against bundle 1, swap to bundle 2 mid-stream, wave 2.
+        wave1 = [
+            threading.Thread(target=fire, args=(i,)) for i in range(8)
+        ]
+        for t in wave1:
+            t.start()
+        status, doc, _ = _request(
+            host, port, "POST", "/admin/swap", {"config_hash": h2}
+        )
+        assert status == 200 and doc["default"] == h2
+        wave2 = [
+            threading.Thread(target=fire, args=(100 + i,)) for i in range(8)
+        ]
+        for t in wave2:
+            t.start()
+        for t in wave1 + wave2:
+            t.join()
+        # Zero failed requests across the swap, and both bundles served.
+        assert statuses == [200] * 16
+        assert h2 in hashes  # post-swap traffic reached the new default
+        assert all(h in (h1, h2) for h in hashes)
+        assert gateway.stats["swaps"] == 1
+
+    def test_ab_split_routes_both_bundles(self, two_bundle_server):
+        gateway, host, port = two_bundle_server
+        h1, h2 = gateway.registry.hashes
+        status, doc, _ = _request(
+            host, port, "POST", "/admin/swap",
+            {"split": {"config_hash": h2, "percent": 50.0}},
+        )
+        assert status == 200 and doc["split"]["config_hash"] == h2
+        obs = _obs(1)[0].tolist()
+        served = set()
+        for i in range(32):
+            s, d, _ = _request(
+                host, port, "POST", "/v1/act",
+                {"household": f"split-house-{i}", "obs": obs},
+            )
+            assert s == 200
+            served.add(d["config_hash"])
+        assert served == {h1, h2}
+        # Stable assignment: the same household never flips arms.
+        s, d, _ = _request(
+            host, port, "POST", "/v1/act",
+            {"household": "split-house-0", "obs": obs},
+        )
+        s2, d2, _ = _request(
+            host, port, "POST", "/v1/act",
+            {"household": "split-house-0", "obs": obs},
+        )
+        assert d["config_hash"] == d2["config_hash"]
+
+    def test_admission_control_sheds_with_429(self, tmp_path):
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        # Forced saturation: depth budget 1 and a wide coalescing window,
+        # so concurrent requests pile behind the first and shed.
+        gateway = build_gateway(
+            [bundle], max_batch=4, max_wait_s=0.25,
+            admission=AdmissionConfig(
+                max_queue_depth=1, retry_after_s=2.5, min_wait_samples=10_000
+            ),
+        )
+        with GatewayServer(gateway):
+            host, port = gateway.host, gateway.port
+            obs = _obs(1)[0].tolist()
+            results = [None] * 6
+
+            def fire(i):
+                results[i] = _request(
+                    host, port, "POST", "/v1/act",
+                    {"household": f"h{i}", "obs": obs},
+                )
+
+            threads = [
+                threading.Thread(target=fire, args=(i,)) for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            statuses = [r[0] for r in results]
+            shed = [r for r in results if r[0] == 429]
+            assert 200 in statuses  # the admitted head of the line served
+            assert shed  # and the pile-up was shed, not queued forever
+            # Shed responses carry Retry-After and an explanatory error.
+            _, doc, headers = shed[0]
+            assert headers.get("Retry-After") == "2.5"
+            assert "queue depth" in doc["error"]
+            assert gateway.stats["shed"] == len(shed)
+
+    def test_wait_budget_sheds(self, tmp_path):
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        gateway = build_gateway(
+            [bundle], max_batch=4,
+            admission=AdmissionConfig(
+                wait_budget_ms=5.0, min_wait_samples=8
+            ),
+        )
+        with GatewayServer(gateway):
+            host, port = gateway.host, gateway.port
+            # Stuff the queue's recent-wait window over budget — the
+            # deterministic stand-in for a measured saturated tail.
+            default = gateway.registry.get(gateway.registry.default_hash)
+            now = time.monotonic()
+            for _ in range(16):
+                default.queue.recent_wait_ms.append((now, 100.0))
+            status, doc, headers = _request(
+                host, port, "POST", "/v1/act",
+                {"household": "h", "obs": _obs(1)[0].tolist()},
+            )
+            assert status == 429
+            assert "p95 queue wait" in doc["error"]
+            assert "Retry-After" in headers
+            # Recovery: shed requests never dispatch, so only AGE can
+            # clear the window — samples older than wait_window_s must
+            # stop shedding traffic (a burst must not shed forever).
+            default.queue.recent_wait_ms.clear()
+            stale = now - 2 * gateway.admission.wait_window_s
+            for _ in range(16):
+                default.queue.recent_wait_ms.append((stale, 100.0))
+            status, doc, _ = _request(
+                host, port, "POST", "/v1/act",
+                {"household": "h", "obs": _obs(1)[0].tolist()},
+            )
+            assert status == 200
+
+
+class TestQueueCancellation:
+    def test_cancelled_future_does_not_starve_batchmates(self, tmp_path):
+        """A caller abandoning its request (gateway timeout cancels through
+        wrap_future) must not break result delivery to the other requests
+        coalesced into the same batch."""
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+        engine.warmup(include_step=False)
+        obs = _obs(3, seed=2)
+        with MicroBatchQueue(engine, max_wait_s=0.2) as q:
+            futs = [q.submit(obs[i]) for i in range(3)]
+            assert futs[1].cancel()  # abandoned while still queued
+            want = engine.act(obs)
+            np.testing.assert_array_equal(futs[0].result(timeout=30), want[0])
+            np.testing.assert_array_equal(futs[2].result(timeout=30), want[2])
+            assert futs[1].cancelled()
+
+
+class TestGatewayFailurePaths:
+    def test_malformed_json_400(self, two_bundle_server):
+        _, host, port = two_bundle_server
+        status, doc, _ = _request(
+            host, port, "POST", "/v1/act", body="{not json"
+        )
+        assert status == 400 and "JSON" in doc["error"]
+
+    def test_wrong_shape_400(self, two_bundle_server):
+        _, host, port = two_bundle_server
+        status, doc, _ = _request(
+            host, port, "POST", "/v1/act",
+            {"household": "h", "obs": [[0.0] * 4] * (A + 2)},
+        )
+        assert status == 400 and "obs must be" in doc["error"]
+        status, doc, _ = _request(
+            host, port, "POST", "/v1/act", {"household": "h"}
+        )
+        assert status == 400 and "missing 'obs'" in doc["error"]
+
+    def test_oversized_batch_413(self, tmp_path):
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        gateway = build_gateway(
+            [bundle], max_batch=4,
+            admission=AdmissionConfig(max_request_rows=4),
+        )
+        with GatewayServer(gateway):
+            host, port = gateway.host, gateway.port
+            status, doc, _ = _request(
+                host, port, "POST", "/v1/act",
+                {"household": "h", "obs": _obs(5).tolist()},
+            )
+            assert status == 413 and "request limit" in doc["error"]
+
+    def test_oversized_body_413(self, tmp_path):
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        gateway = build_gateway(
+            [bundle], max_batch=4,
+            admission=AdmissionConfig(max_body_bytes=256),
+        )
+        with GatewayServer(gateway):
+            host, port = gateway.host, gateway.port
+            status, doc, _ = _request(
+                host, port, "POST", "/v1/act",
+                {"household": "h", "obs": _obs(4).tolist()},
+            )
+            assert status == 413 and "byte limit" in doc["error"]
+
+    def test_unknown_config_hash_on_swap_404(self, two_bundle_server):
+        _, host, port = two_bundle_server
+        status, doc, _ = _request(
+            host, port, "POST", "/admin/swap",
+            {"config_hash": "deadbeef0000"},
+        )
+        assert status == 404 and "deadbeef0000" in doc["error"]
+        # Split to an unknown arm is a 404 too.
+        status, doc, _ = _request(
+            host, port, "POST", "/admin/swap",
+            {"split": {"config_hash": "deadbeef0000", "percent": 10}},
+        )
+        assert status == 404
+
+    def test_swap_plus_bad_split_is_atomic(self, two_bundle_server):
+        """A combined swap+split request that fails validation must apply
+        NEITHER half — a 404 reply with the default already retargeted
+        (and every pin cleared) would lie to the operator."""
+        gateway, host, port = two_bundle_server
+        h1, h2 = gateway.registry.hashes
+        obs = _obs(1)[0].tolist()
+        # Pin a household via a live split (pins only record under one).
+        gateway.registry.set_split(h2, 50.0)
+        _request(host, port, "POST", "/v1/act",
+                 {"household": "pinned-house", "obs": obs})
+        assert gateway.registry.pinned_count == 1
+        status, doc, _ = _request(
+            host, port, "POST", "/admin/swap",
+            {"config_hash": h2,
+             "split": {"config_hash": "deadbeef0000", "percent": 10}},
+        )
+        assert status == 404
+        # Default unchanged, split unchanged, pins intact, no swap counted.
+        assert gateway.registry.default_hash == h1
+        assert gateway.registry.split == (h2, 50.0)
+        assert gateway.registry.pinned_count == 1
+        assert gateway.stats["swaps"] == 0
+        gateway.registry.clear_split()
+        # Bad percent on a valid arm: same atomicity.
+        status, doc, _ = _request(
+            host, port, "POST", "/admin/swap",
+            {"config_hash": h2,
+             "split": {"config_hash": h2, "percent": 250}},
+        )
+        assert status == 400
+        assert gateway.registry.default_hash == h1
+
+    def test_unknown_route_and_method(self, two_bundle_server):
+        _, host, port = two_bundle_server
+        assert _request(host, port, "GET", "/nope")[0] == 404
+        assert _request(host, port, "GET", "/v1/act")[0] == 405
+        assert _request(host, port, "POST", "/healthz", {})[0] == 405
+
+    def test_engine_fault_answers_500_not_503(self, two_bundle_server):
+        """Engine failures (XlaRuntimeError subclasses RuntimeError) must
+        answer 500 — only the queue's shutdown race is a retriable 503."""
+        gateway, host, port = two_bundle_server
+        default = gateway.registry.get(gateway.registry.default_hash)
+        original = default.engine.act
+        try:
+            def broken_act(obs):
+                raise RuntimeError("simulated engine fault")
+
+            default.engine.act = broken_act
+            status, doc, _ = _request(
+                host, port, "POST", "/v1/act",
+                {"household": "h", "obs": _obs(1)[0].tolist()},
+            )
+            assert status == 500
+            assert "simulated engine fault" in doc["error"]
+        finally:
+            default.engine.act = original
+
+    def test_header_flood_bounded_400(self, two_bundle_server):
+        """An endless header stream must be cut off, not accumulated."""
+        _, host, port = two_bundle_server
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            conn.putrequest("GET", "/healthz")
+            for i in range(200):
+                conn.putheader(f"x-flood-{i}", "y")
+            conn.endheaders()
+            resp = conn.getresponse()
+            doc = json.loads(resp.read())
+            assert resp.status == 400
+            assert "too many headers" in doc["error"]
+        finally:
+            conn.close()
+
+    def test_request_mid_drain_503(self, two_bundle_server):
+        gateway, host, port = two_bundle_server
+        status, doc, _ = _request(host, port, "POST", "/admin/drain", {})
+        assert status == 200 and doc["draining"] is True
+        # Readiness flips; act requests are refused with Retry-After.
+        status, doc, _ = _request(host, port, "GET", "/readyz")
+        assert status == 503 and doc["reason"] == "draining"
+        status, doc, headers = _request(
+            host, port, "POST", "/v1/act",
+            {"household": "h", "obs": _obs(1)[0].tolist()},
+        )
+        assert status == 503 and "draining" in doc["error"]
+        assert "Retry-After" in headers
+        # Liveness is unaffected (the pod is healthy, just not ready).
+        assert _request(host, port, "GET", "/healthz")[0] == 200
+
+
+class TestNetworkServeBench:
+    def test_rows_and_warehouse_traces_keyed_by_bundle_hash(self, tmp_path):
+        """Acceptance: serve-bench --network measures wire percentiles and
+        its per-request traces land in the warehouse joined on the SERVING
+        bundle's config_hash."""
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        db = str(tmp_path / "r.db")
+        # Admission effectively off: this test asserts every request is
+        # served and traced — on a loaded CI machine the default 50 ms
+        # wait budget can legitimately shed (covered by its own tests).
+        gateway = build_gateway(
+            [bundle], max_batch=4, max_wait_s=0.002, results_db=db,
+            admission=AdmissionConfig(
+                max_queue_depth=100_000, wait_budget_ms=100_000.0
+            ),
+        )
+        with GatewayServer(gateway):
+            host, port = gateway.host, gateway.port
+            bundle_hash = gateway.registry.default_hash
+            rows = serve_bench_network(
+                host, port, n_agents=A, rate_hz=400.0, n_requests=48,
+                n_households=4, seed=5,
+            )
+        head = rows[-1]
+        assert head["metric"] == "serve_bench_network"
+        for key in ("p50_ms", "p95_ms", "p99_ms", "throughput_rps",
+                    "shed_rate"):
+            assert isinstance(head[key], (int, float))
+        assert head["n_ok"] == 48 and head["shed_rate"] == 0.0
+        assert head["served_config_hashes"] == [bundle_hash]
+        metrics = [r["metric"] for r in rows]
+        assert metrics[:3] == [
+            "serve_gateway_latency_ms_p50",
+            "serve_gateway_latency_ms_p95",
+            "serve_gateway_latency_ms_p99",
+        ]
+        # Warehouse: one serve_request trace per wire request, on a run
+        # whose manifest identity IS the serving bundle's config_hash.
+        with ResultsStore(db) as store:
+            (n_traces,) = store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_points p "
+                "JOIN telemetry_runs r ON r.run_id = p.run_id "
+                "WHERE p.kind = 'serve_request' AND r.config_hash = ?",
+                (bundle_hash,),
+            ).fetchone()
+            assert n_traces == 48
+
+    def test_serve_bench_network_cli_one_json_per_line(self, capfd):
+        from p2pmicrogrid_tpu.cli import main
+
+        rc = main([
+            "serve-bench", "--network", "--agents", "2",
+            "--implementation", "tabular", "--requests", "24",
+            "--rate", "400", "--max-batch", "4", "--max-wait-ms", "1",
+            "--households", "3",
+        ])
+        assert rc == 0
+        out, err = capfd.readouterr()
+        lines = [l for l in out.splitlines() if l.strip()]
+        rows = [json.loads(l) for l in lines]  # every stdout line is JSON
+        assert rows[-1]["metric"] == "serve_bench_network"
+        assert "gateway on" in err
+
+
+class TestGatewayCli:
+    def test_serve_gateway_bounded_run_writes_stats(self, tmp_path, capfd):
+        import importlib.util
+        import os
+
+        from p2pmicrogrid_tpu.cli import main
+
+        stats_path = str(tmp_path / "GATEWAY_STATS_test.json")
+        rc = main([
+            "serve-gateway", "--agents", "2", "--implementation", "tabular",
+            "--port", "0", "--max-batch", "4", "--serve-seconds", "0.3",
+            "--stats-out", stats_path,
+        ])
+        assert rc == 0
+        out, err = capfd.readouterr()
+        listening = json.loads(
+            [l for l in out.splitlines() if l.strip()][0]
+        )
+        assert listening["kind"] == "gateway_listening"
+        assert listening["port"] > 0
+        assert listening["default"] in listening["bundles"]
+        assert "fresh-init" in err
+        # The final snapshot validates against the committed-capture schema.
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "check_artifacts_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        problems: list = []
+        mod.check_gateway_stats(stats_path, problems)
+        assert problems == []
+
+    def test_stats_snapshot_schema_round_trip(self, two_bundle_server, tmp_path):
+        import importlib.util
+        import os
+
+        gateway, host, port = two_bundle_server
+        _request(
+            host, port, "POST", "/v1/act",
+            {"household": "h", "obs": _obs(1)[0].tolist()},
+        )
+        status, doc, _ = _request(host, port, "GET", "/stats")
+        assert status == 200
+        path = tmp_path / "GATEWAY_STATS_r0.json"
+        path.write_text(json.dumps(doc))
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "check_artifacts_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        problems: list = []
+        mod.check_gateway_stats(str(path), problems)
+        assert problems == []
+        # A broken snapshot is caught.
+        bad = dict(doc, default="not-a-bundle")
+        path.write_text(json.dumps(bad))
+        problems = []
+        mod.check_gateway_stats(str(path), problems)
+        assert any("default" in p for p in problems)
+
+    def test_build_gateway_partial_failure_leaks_nothing(self, tmp_path):
+        """A later bundle failing to load must close the earlier bundles'
+        queue workers and telemetry (the caller only gets an exception)."""
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        before = threading.active_count()
+        with pytest.raises(FileNotFoundError):
+            build_gateway(
+                [bundle, str(tmp_path / "does-not-exist")], max_batch=4
+            )
+        # The first bundle's MicroBatchQueue worker thread was joined.
+        assert threading.active_count() == before
+
+    def test_start_failure_surfaces_and_stop_is_fast(self, tmp_path):
+        """A bind failure must raise the real error from start(), and the
+        follow-up stop() must return immediately instead of timing out on
+        a loop that never ran."""
+        import socket
+
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        taken_port = blocker.getsockname()[1]
+        try:
+            gateway = build_gateway(
+                [bundle], max_batch=4, port=taken_port, warmup=False
+            )
+            server = GatewayServer(gateway)
+            with pytest.raises(OSError):
+                server.start()
+            t0 = time.monotonic()
+            server.stop()  # must short-circuit, not block ~35 s
+            assert time.monotonic() - t0 < 1.0
+            # The owned bundles were cleaned up on the failure path: no
+            # leaked queue worker threads, no unflushed telemetry.
+            for h in gateway.registry.hashes:
+                assert gateway.registry.get(h).queue._closed
+        finally:
+            blocker.close()
+
+    def test_gateway_jsonl_schema(self, tmp_path):
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "check_artifacts_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        good = {
+            "metric": "serve_bench_network", "value": 1.0, "unit": "ms",
+            "vs_baseline": 1.0, "p50_ms": 0.5, "p95_ms": 0.9,
+            "p99_ms": 1.0, "throughput_rps": 100.0, "shed_rate": 0.0,
+        }
+        path = tmp_path / "SERVE_GATEWAY_r01.jsonl"
+        path.write_text(json.dumps(good) + "\n")
+        problems: list = []
+        mod.check_gateway_jsonl(str(path), problems)
+        assert problems == []
+        bad = {k: v for k, v in good.items() if k != "shed_rate"}
+        path.write_text(json.dumps(bad) + "\n")
+        problems = []
+        mod.check_gateway_jsonl(str(path), problems)
+        assert any("shed_rate" in p for p in problems)

@@ -508,6 +508,93 @@ class TestServePlacement:
         with pytest.raises(ValueError, match="device"):
             PolicyEngine(bundle_dir=bundle, max_batch=4, device="tpu9000")
 
+    def _serve_table(self, tmp_path, rows):
+        """A committed-capture-shaped CROSSOVER_SERVE file in tmp_path."""
+        import json
+
+        doc = {"kind": "serve_crossover", "rows": rows}
+        (tmp_path / "CROSSOVER_SERVE_r99.json").write_text(json.dumps(doc))
+        return str(tmp_path)
+
+    def test_serve_crossover_table_decides_placement(self, tmp_path):
+        """ISSUE 5 satellite: with a measured (n_agents, max_batch) serve
+        table, auto-placement is batch-width aware — the B=1 training
+        caveat is gone."""
+        from p2pmicrogrid_tpu.train.placement import pick_serve_device
+
+        art = self._serve_table(tmp_path, [
+            {"implementation": "tabular", "n_agents": 2, "max_batch": 1,
+             "tpu_over_cpu": 0.05},
+            {"implementation": "tabular", "n_agents": 2, "max_batch": 64,
+             "tpu_over_cpu": 4.0},
+        ])
+        # Narrow serving: the measured point says CPU wins -> host pin.
+        dev, reason = pick_serve_device(
+            "tabular", 2, max_batch=1, default_backend="tpu",
+            artifacts_dir=art,
+        )
+        assert dev is not None and dev.platform == "cpu"
+        assert "serve crossover" in reason and "max_batch=1" in reason
+        # A capture point so CPU-favorable it rounds to 0.0 must not
+        # divide by zero — it reports the bound.
+        zero_dir = tmp_path / "zero"
+        zero_dir.mkdir()
+        art0 = self._serve_table(zero_dir, [
+            {"implementation": "dqn", "n_agents": 2, "max_batch": 1,
+             "tpu_over_cpu": 0.0},
+        ])
+        dev, reason = pick_serve_device(
+            "dqn", 2, max_batch=1, default_backend="tpu",
+            artifacts_dir=art0,
+        )
+        assert dev is not None and ">1000x" in reason
+        # Wide bucket: the measured point says the accelerator wins.
+        dev, reason = pick_serve_device(
+            "tabular", 2, max_batch=64, default_backend="tpu",
+            artifacts_dir=art,
+        )
+        assert dev is None and "tpu wins" in reason.lower()
+
+    def test_no_serve_table_wide_batch_stays_on_default(self, tmp_path):
+        """Without a serve measurement, wide-batch configs must NOT
+        inherit the B=1 training table's CPU pin (a padded bucket can
+        fill the accelerator); max_batch=1 still may."""
+        from p2pmicrogrid_tpu.train.placement import pick_serve_device
+
+        empty = str(tmp_path)  # no CROSSOVER_SERVE_* here
+        dev, reason = pick_serve_device(
+            "tabular", 2, max_batch=64, default_backend="tpu",
+            artifacts_dir=empty,
+        )
+        assert dev is None and "no serve-specific crossover" in reason
+        dev, reason = pick_serve_device(
+            "tabular", 2, max_batch=1, default_backend="tpu",
+            artifacts_dir=empty,
+        )
+        assert dev is not None and dev.platform == "cpu"
+        assert "B=1" in reason
+
+    def test_serve_table_nearest_point_lookup(self, tmp_path):
+        from p2pmicrogrid_tpu.train.placement import serve_cpu_advantage
+
+        art = self._serve_table(tmp_path, [
+            {"implementation": "ddpg", "n_agents": 10, "max_batch": 8,
+             "tpu_over_cpu": 0.5},
+            {"implementation": "ddpg", "n_agents": 100, "max_batch": 64,
+             "tpu_over_cpu": 3.0},
+        ])
+        ratio, source = serve_cpu_advantage("ddpg", 12, 8, art)
+        assert ratio == 0.5 and "A=10" in source
+        ratio, source = serve_cpu_advantage("ddpg", 80, 32, art)
+        assert ratio == 3.0 and "A=100" in source
+        assert serve_cpu_advantage("tabular", 2, 1, art) is None
+
+    def test_gateway_modules_on_host_sync_hot_path(self, host_sync_checker):
+        """The async gateway/registry handlers are hot-path modules: one
+        blocking readback stalls every connected household."""
+        rels = {os.path.basename(p) for p in host_sync_checker.HOT_PATH_FILES}
+        assert {"gateway.py", "registry.py", "engine.py"} <= rels
+
 
 def test_bench_registry_includes_chunked_pipeline():
     from p2pmicrogrid_tpu.benchmarks import BENCHES, CPU_RETRYABLE

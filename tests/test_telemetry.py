@@ -927,3 +927,143 @@ class TestReport:
         out = capsys.readouterr().out
         assert tel.run_id in out and "health" in out
         assert main(["telemetry-report", str(tmp_path / "nope")]) == 1
+
+
+class TestServeCompaction:
+    """Warehouse retention (ISSUE 5 satellite): per-request serve_request
+    rows older than the window roll into per-(run, bucket) aggregates, so
+    a long-running gateway's telemetry stays bounded."""
+
+    def _seed_serve_requests(self, db, run_id="gw-run", config_hash="cfg-a"):
+        """A warehouse with 12 serve_request points across two buckets,
+        all stamped 2 hours in the past, plus one fresh point."""
+        import time as _time
+
+        from p2pmicrogrid_tpu.telemetry import SqliteSink
+
+        sink = SqliteSink(db, batch=1)
+        sink.register_run(run_id, {"config_hash": config_hash, "created": "t"})
+        old = _time.time() - 2 * 3600
+        waits = []
+        for i in range(12):
+            bucket = 4 if i % 2 else 1
+            wait = float(i)
+            waits.append((bucket, wait))
+            sink.emit({
+                "ts": old + i, "kind": "serve_request", "source": "queue",
+                "bucket": bucket, "batch_size": 1,
+                "padded_rows": bucket - 1, "wait_ms": wait,
+                "service_ms": 2.0, "latency_ms": wait + 2.0,
+            })
+        sink.emit({
+            "ts": _time.time(), "kind": "serve_request", "source": "queue",
+            "bucket": 2, "batch_size": 2, "padded_rows": 0,
+            "wait_ms": 0.5, "service_ms": 1.0, "latency_ms": 1.5,
+        })
+        sink.close()
+        return waits
+
+    def test_round_trip(self, tmp_path):
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        db = str(tmp_path / "r.db")
+        self._seed_serve_requests(db)
+        with ResultsStore(db) as store:
+            summary = store.compact_serve_telemetry(older_than_hours=1.0)
+            assert summary == {
+                "rows_compacted": 12, "aggregates_written": 2,
+            }
+            # The recent row survives raw; the old tail is aggregates now.
+            (raw,) = store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_points "
+                "WHERE kind='serve_request'"
+            ).fetchone()
+            assert raw == 1
+            aggs = store.con.execute(
+                "SELECT name, value, attrs_json FROM telemetry_points "
+                "WHERE kind='serve_request_agg' ORDER BY name"
+            ).fetchall()
+            assert [a[0] for a in aggs] == ["bucket_1", "bucket_4"]
+            # Request counts are preserved exactly across the roll-up.
+            assert sum(int(a[1]) for a in aggs) == 12
+            attrs = json.loads(aggs[1][2])
+            assert attrs["bucket"] == 4
+            assert attrs["requests"] == 6
+            assert attrs["padded_rows"] == 6 * 3
+            odd_waits = np.asarray([1.0, 3.0, 5.0, 7.0, 9.0, 11.0])
+            assert attrs["wait_ms"]["p95"] == pytest.approx(
+                float(np.percentile(odd_waits, 95)), abs=1e-3
+            )
+            assert attrs["ts_min"] < attrs["ts_max"]
+            # Idempotent: a second pass finds nothing left to compact.
+            assert store.compact_serve_telemetry(older_than_hours=1.0) == {
+                "rows_compacted": 0, "aggregates_written": 0,
+            }
+            # The warehouse stays orphan-free (seq continuity preserved).
+            (orphans,) = store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_points t WHERE NOT EXISTS "
+                "(SELECT 1 FROM telemetry_runs r WHERE r.run_id = t.run_id)"
+            ).fetchone()
+            assert orphans == 0
+
+    def test_compact_while_sink_is_live(self, tmp_path):
+        """The stated use case is compacting a LONG-RUNNING gateway's
+        warehouse: a live SqliteSink's in-memory seq counter must not
+        collide with the aggregate rows' seqs (a collision makes the
+        sink's next batch fail its PRIMARY KEY and silently drop
+        telemetry from then on)."""
+        import time as _time
+
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import SqliteSink
+
+        db = str(tmp_path / "r.db")
+        sink = SqliteSink(db, batch=1)
+        sink.register_run("live-run", {"config_hash": "cfg", "created": "t"})
+        old = _time.time() - 2 * 3600
+        for i in range(4):
+            sink.emit({"ts": old + i, "kind": "serve_request", "bucket": 1,
+                       "wait_ms": 1.0, "service_ms": 1.0, "latency_ms": 2.0})
+        # Compact mid-run, sink still open and counting in memory.
+        with ResultsStore(db) as store:
+            assert store.compact_serve_telemetry(older_than_hours=1.0) == {
+                "rows_compacted": 4, "aggregates_written": 1,
+            }
+        for i in range(4):  # the live sink keeps streaming afterwards
+            sink.emit({"ts": _time.time(), "kind": "serve_request",
+                       "bucket": 2, "wait_ms": 1.0, "service_ms": 1.0,
+                       "latency_ms": 2.0})
+        sink.close()
+        with ResultsStore(db) as store:
+            (raw,) = store.con.execute(
+                "SELECT COUNT(*) FROM telemetry_points "
+                "WHERE kind='serve_request'"
+            ).fetchone()
+            assert raw == 4  # nothing silently dropped post-compaction
+            # Second pass still finds and rolls the new tail eventually.
+            summary = store.compact_serve_telemetry(
+                older_than_hours=0.0, now=_time.time() + 1
+            )
+            assert summary["rows_compacted"] == 4
+
+    def test_cli_compact(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+
+        db = str(tmp_path / "r.db")
+        self._seed_serve_requests(db)
+        rc = main([
+            "telemetry-query", "--results-db", db, "--compact",
+            "--older-than-hours", "1",
+        ])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out.strip())
+        assert doc["compacted"]["rows_compacted"] == 12
+        assert doc["compacted"]["aggregates_written"] == 2
+        # Re-running is a no-op, and a missing DB fails loud.
+        assert main([
+            "telemetry-query", "--results-db", db, "--compact",
+        ]) == 0
+        assert main([
+            "telemetry-query", "--results-db", str(tmp_path / "nope.db"),
+            "--compact",
+        ]) == 1

@@ -34,6 +34,16 @@ the contracts executable:
 * Serve-bench captures (``artifacts/SERVE_*.jsonl``): metric rows, same
   schema as the bench captures.
 
+* Gateway bench captures (``artifacts/SERVE_GATEWAY_*.jsonl``): metric
+  rows, and any ``serve_bench_network`` headline row must carry the wire
+  percentiles (``p50_ms``/``p95_ms``/``p99_ms``), ``throughput_rps`` and
+  ``shed_rate`` as numbers.
+
+* Gateway stats snapshots (``artifacts/GATEWAY_STATS_*.json``, the
+  ``GET /stats`` document of serve/gateway.py): ``kind: "gateway_stats"``
+  with a non-empty ``bundles`` object, the ``default`` hash present in it,
+  and ``gateway``/``admission`` counter objects.
+
 * Results databases (``*.db``/``*.sqlite`` at the root and under
   ``artifacts/``): when a DB carries telemetry warehouse tables
   (``data/results.py``), its ``PRAGMA user_version`` must match the
@@ -128,6 +138,91 @@ def check_metric_jsonl(path: str, problems: list) -> None:
             problems.append(f"{where}:{i + 1}: not valid JSON: {line[:60]!r}")
             continue
         check_metric_row(row, f"{where}:{i + 1}", problems)
+
+
+# Numeric stats every serve_bench_network headline row must carry — the
+# wire-level SLO contract of serve/loadgen.py:serve_bench_network.
+GATEWAY_HEADLINE_KEYS = (
+    "p50_ms", "p95_ms", "p99_ms", "throughput_rps", "shed_rate",
+)
+
+
+def check_gateway_jsonl(path: str, problems: list) -> None:
+    """SERVE_GATEWAY_*.jsonl: metric rows + the network-headline contract."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return  # already reported by check_metric_jsonl
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # already reported
+        if not isinstance(row, dict):
+            continue
+        if row.get("metric") != "serve_bench_network":
+            continue
+        for key in GATEWAY_HEADLINE_KEYS:
+            v = row.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(
+                    f"{where}:{i + 1}: serve_bench_network headline "
+                    f"missing numeric {key!r}"
+                )
+
+
+def check_gateway_stats(path: str, problems: list) -> None:
+    """GATEWAY_STATS_*.json: one /stats snapshot (serve/gateway.py)."""
+    where = os.path.relpath(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as err:
+        problems.append(f"{where}: unreadable ({err})")
+        return
+    if not isinstance(doc, dict):
+        problems.append(f"{where}: top level is not an object")
+        return
+    if doc.get("kind") != "gateway_stats":
+        problems.append(
+            f"{where}: kind is {doc.get('kind')!r}, expected 'gateway_stats'"
+        )
+    for key in ("created", "default"):
+        if not isinstance(doc.get(key), str):
+            problems.append(f"{where}: missing string {key!r}")
+    for key in ("gateway", "admission", "bundles"):
+        if not isinstance(doc.get(key), dict):
+            problems.append(f"{where}: missing object {key!r}")
+    bundles = doc.get("bundles")
+    if isinstance(bundles, dict):
+        if not bundles:
+            problems.append(f"{where}: 'bundles' is empty")
+        for h, b in bundles.items():
+            if not isinstance(b, dict):
+                problems.append(f"{where}: bundle {h!r} is not an object")
+                continue
+            for key in ("requests", "batches", "queue_depth"):
+                if not isinstance(b.get(key), (int, float)) or isinstance(
+                    b.get(key), bool
+                ):
+                    problems.append(
+                        f"{where}: bundle {h!r} missing numeric {key!r}"
+                    )
+        default = doc.get("default")
+        if isinstance(default, str) and default not in bundles:
+            problems.append(
+                f"{where}: default {default!r} not among bundles "
+                f"{sorted(bundles)}"
+            )
+    if isinstance(doc.get("admission"), dict) and not isinstance(
+        doc["admission"].get("shed_total"), (int, float)
+    ):
+        problems.append(f"{where}: admission missing numeric 'shed_total'")
 
 
 BUNDLE_IMPLEMENTATIONS = ("tabular", "dqn", "ddpg")
@@ -339,11 +434,24 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
     problems: list = []
     for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json"))):
         check_bench_capture(path, problems, strict_tail=strict_tail)
+    gateway_jsonl = set(
+        glob.glob(os.path.join(repo_root, "artifacts", "SERVE_GATEWAY_*.jsonl"))
+    )
     for pattern in ("BENCH_*.jsonl", "SERVE_*.jsonl"):
         for path in sorted(
             glob.glob(os.path.join(repo_root, "artifacts", pattern))
         ):
+            if path in gateway_jsonl:
+                # SERVE_GATEWAY_* matches SERVE_* too; the gateway check
+                # below includes the metric-row validation.
+                continue
             check_metric_jsonl(path, problems)
+    for path in sorted(gateway_jsonl):
+        check_gateway_jsonl(path, problems)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "GATEWAY_STATS_*.json"))
+    ):
+        check_gateway_stats(path, problems)
     for run_dir in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "runs", "*"))
     ):

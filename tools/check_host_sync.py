@@ -48,6 +48,11 @@ HOT_PATH_FILES = (
     os.path.join("p2pmicrogrid_tpu", "train", "loop.py"),
     os.path.join("p2pmicrogrid_tpu", "envs", "community.py"),
     os.path.join("p2pmicrogrid_tpu", "serve", "engine.py"),
+    # The gateway's async handlers serve every connected household from one
+    # event loop — a single un-annotated blocking readback stalls ALL of
+    # them, not one request (the worst place in the repo for this class).
+    os.path.join("p2pmicrogrid_tpu", "serve", "gateway.py"),
+    os.path.join("p2pmicrogrid_tpu", "serve", "registry.py"),
     os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
 )
 

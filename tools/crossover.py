@@ -7,12 +7,23 @@ runs the SAME jitted single-scenario training program
 (benchmarks.single_community_steps_per_sec) on both backends across community
 sizes and emits the crossover table for ``artifacts/``.
 
-Usage: ``PYTHONPATH=/root/repo python tools/crossover.py``
+``--serve`` measures the SERVING crossover instead: the padded-bucket
+``PolicyEngine.act`` program over (n_agents, max_batch) on both backends.
+The training table is a B=1 sequential measurement and says nothing about
+whether a 64-wide padded serve bucket fills the chip; the committed
+``artifacts/CROSSOVER_SERVE_r0X.json`` capture is what
+``train.placement.pick_serve_device`` consults for batch-width-aware
+auto-placement.
+
+Usage: ``PYTHONPATH=/root/repo python tools/crossover.py [--serve]``
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import tempfile
+import time
 
 import jax
 
@@ -20,6 +31,91 @@ from p2pmicrogrid_tpu.benchmarks import single_community_steps_per_sec
 
 SIZES_TABULAR = (2, 10, 50, 100, 250)
 SIZES_DDPG = (10, 50, 100)
+
+# Serve sweep: community sizes x coalescing caps (powers of two — the
+# engine's bucket grid). max_batch IS the widest padded bucket the engine
+# compiles, so measuring the full bucket measures the worst-case program.
+SERVE_SIZES = (2, 10, 100)
+SERVE_BATCHES = (1, 8, 64)
+SERVE_REPEATS = 30
+
+
+def _serve_engine(implementation: str, n_agents: int, max_batch: int, device):
+    """A fresh-init engine for the sweep, pinned to ``device``."""
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.serve import PolicyEngine, export_policy_bundle
+    from p2pmicrogrid_tpu.train import init_policy_state
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=n_agents),
+        train=TrainConfig(implementation=implementation),
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    bundle = export_policy_bundle(cfg, ps, tempfile.mkdtemp(prefix="xover-"))
+    engine = PolicyEngine(
+        bundle_dir=bundle, max_batch=max_batch,
+        device="cpu" if device.platform == "cpu" else "default",
+    )
+    engine.warmup([max_batch], include_step=False)
+    return engine
+
+
+def _serve_batches_per_sec(engine, max_batch: int) -> float:
+    import numpy as np
+
+    obs = np.zeros((max_batch, engine.n_agents, 4), dtype=np.float32)
+    engine.act(obs)  # one extra warm call outside the timed window
+    t0 = time.perf_counter()
+    for _ in range(SERVE_REPEATS):
+        engine.act(obs)
+    return SERVE_REPEATS / (time.perf_counter() - t0)
+
+
+def serve_main() -> dict:
+    """The (n_agents, max_batch) padded-batch serve crossover sweep."""
+    tpu = jax.devices()[0]
+    cpu = jax.devices("cpu")[0]
+    assert tpu.platform != "cpu", "run this on a TPU host"
+
+    rows = []
+    for impl in ("tabular", "ddpg"):
+        for a in SERVE_SIZES:
+            for b in SERVE_BATCHES:
+                r_cpu = _serve_batches_per_sec(
+                    _serve_engine(impl, a, b, cpu), b
+                )
+                r_tpu = _serve_batches_per_sec(
+                    _serve_engine(impl, a, b, tpu), b
+                )
+                rows.append(
+                    {
+                        "implementation": impl,
+                        "n_agents": a,
+                        "max_batch": b,
+                        "cpu_batches_per_sec": round(r_cpu, 1),
+                        "tpu_batches_per_sec": round(r_tpu, 1),
+                        "tpu_over_cpu": round(r_tpu / r_cpu, 3),
+                        "winner": "tpu" if r_tpu > r_cpu else "cpu",
+                    }
+                )
+                print(
+                    f"{impl} A={a} B={b}: cpu {r_cpu:.0f} vs tpu "
+                    f"{r_tpu:.0f} batches/s ({r_tpu / r_cpu:.2f}x)",
+                    flush=True,
+                )
+
+    doc = {
+        "what": (
+            "padded-bucket PolicyEngine.act placed on each backend; one "
+            "full max_batch bucket per call, fresh-init bundles, "
+            f"{SERVE_REPEATS} timed calls after warmup"
+        ),
+        "kind": "serve_crossover",
+        "device": jax.devices()[0].device_kind,
+        "rows": rows,
+    }
+    print(json.dumps(doc, indent=2))
+    return doc
 
 
 def main() -> dict:
@@ -70,4 +166,14 @@ def main() -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="measure the padded-batch SERVE crossover over "
+             "(n_agents, max_batch) instead of the training crossover "
+             "(emit as artifacts/CROSSOVER_SERVE_r0X.json)",
+    )
+    if parser.parse_args().serve:
+        serve_main()
+    else:
+        main()
