@@ -1299,6 +1299,122 @@ def cmd_serve_bench(args) -> int:
                 file=sys.stderr,
                 flush=True,
             )
+        if getattr(args, "fleet", False):
+            # Fleet mode: N in-process gateway replicas behind the
+            # consistent-hash router, the open-loop schedule fired THROUGH
+            # the router (retry/failover semantics included), optionally
+            # with a deterministic kill/restart fault plan mid-run. The
+            # committed FLEET_*.jsonl captures come from here.
+            from p2pmicrogrid_tpu.serve import (
+                AdmissionConfig,
+                FaultPlan,
+                FleetRouter,
+                LocalFleet,
+                RetryPolicy,
+                kill_restart_plan,
+                serve_bench_fleet,
+            )
+
+            plan = None
+            if getattr(args, "chaos_plan", None):
+                with open(args.chaos_plan) as f:
+                    plan = FaultPlan.from_json(f.read())
+            elif getattr(args, "chaos", False):
+                duration = args.requests / args.rate
+                kill_at = (
+                    args.kill_at if args.kill_at is not None
+                    else 0.3 * duration
+                )
+                restart_at = (
+                    args.restart_at if args.restart_at is not None
+                    else 0.6 * duration
+                )
+                victim = f"replica-{min(1, args.replicas - 1)}"
+                plan = kill_restart_plan(
+                    victim, kill_at, restart_at, seed=args.chaos_seed
+                )
+            fleet = LocalFleet(
+                [bundle],
+                n_replicas=args.replicas,
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1e3,
+                admission=AdmissionConfig(
+                    max_queue_depth=args.max_queue_depth,
+                    wait_budget_ms=args.wait_budget_ms,
+                ),
+                results_db=args.results_db,
+                device=getattr(args, "serve_device", "auto"),
+                fault_plan=plan,
+                run_name="serve-bench-fleet",
+            )
+            fleet.start()
+            reference = fleet.reference_engine()
+            # The router gets its own warehouse-keyed telemetry: ejection/
+            # failover/retry counters and the aggregated fleet_stats event
+            # land next to the per-replica bundle traces, joined on the
+            # served bundle's config_hash.
+            router_tel = Telemetry(
+                run_id=f"fleet-router-{run_stamp()}",
+                sinks=(
+                    [SqliteSink(args.results_db)] if args.results_db else []
+                ),
+                manifest=run_manifest(
+                    extra={
+                        "config_hash": reference.manifest.get("config_hash"),
+                        "setting": reference.manifest.get("setting"),
+                        "serve_role": "router",
+                        "fleet_size": args.replicas,
+                    }
+                ),
+            )
+            router = FleetRouter(
+                fleet.replicas,
+                retry=RetryPolicy(
+                    max_attempts=args.retry_attempts,
+                    deadline_s=args.retry_deadline_s,
+                ),
+                fail_threshold=2,
+                ok_threshold=1,
+                telemetry=router_tel,
+            )
+            print(
+                f"serve-bench: fleet of {args.replicas} replicas on "
+                + ", ".join(f"{r.replica_id}:{r.port}" for r in fleet.replicas)
+                + (
+                    f"; chaos plan: {len(plan.events)} event(s), "
+                    f"seed {plan.seed}" if plan is not None else ""
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+            try:
+                serve_bench_fleet(
+                    router,
+                    n_agents=reference.n_agents,
+                    fleet=fleet,
+                    fault_plan=plan,
+                    reference_engine=reference,
+                    rate_hz=args.rate,
+                    n_requests=args.requests,
+                    n_households=args.households,
+                    seed=args.bench_seed,
+                    slo_ms=args.slo_ms,
+                    probe_interval_s=0.05,
+                    emit=lambda row: (sink.emit(row), router_tel.emit(row)),
+                    extra_headline={
+                        "config_hash": reference.manifest.get("config_hash"),
+                        "implementation": reference.manifest.get(
+                            "implementation"
+                        ),
+                        "n_agents": reference.n_agents,
+                        "max_batch": args.max_batch,
+                        "max_wait_ms": round(args.max_wait_ms, 3),
+                    },
+                )
+            finally:
+                fleet.stop_all()
+                router_tel.close()
+            return 0
         if getattr(args, "network", False):
             # Wire-level mode: the same open-loop schedule, fired over real
             # sockets at an in-process gateway (its per-bundle telemetry —
@@ -1307,6 +1423,7 @@ def cmd_serve_bench(args) -> int:
             from p2pmicrogrid_tpu.serve import (
                 AdmissionConfig,
                 GatewayServer,
+                RetryPolicy,
                 build_gateway,
                 serve_bench_network,
             )
@@ -1347,6 +1464,13 @@ def cmd_serve_bench(args) -> int:
                     n_households=args.households,
                     seed=args.bench_seed,
                     slo_ms=args.slo_ms,
+                    retry=(
+                        RetryPolicy(
+                            max_attempts=args.retry_attempts,
+                            deadline_s=args.retry_deadline_s,
+                        )
+                        if getattr(args, "retry", False) else None
+                    ),
                     emit=emit,
                     extra_headline={
                         "config_hash": default.config_hash,
@@ -1660,6 +1784,16 @@ def cmd_telemetry_query(args) -> int:
         return [dict(zip(cols, r)) for r in cur.fetchall()]
 
     if getattr(args, "watch", False):
+        if getattr(args, "fleet", False):
+            # Silently tailing the EVAL join when the user asked for the
+            # fleet view would stream unrelated rows; refuse loudly.
+            print(
+                "--fleet and --watch cannot combine (the watch tails the "
+                "eval join); drop one",
+                file=sys.stderr,
+            )
+            con.close()
+            return 2
         try:
             return _watch_telemetry_join(con, args)
         finally:
@@ -1667,6 +1801,10 @@ def cmd_telemetry_query(args) -> int:
     try:
         if args.sql:
             rows = select(args.sql)
+        elif getattr(args, "fleet", False):
+            from p2pmicrogrid_tpu.data.results import FLEET_VIEW_SQL
+
+            rows = select(FLEET_VIEW_SQL)
         else:
             rows = select(TELEMETRY_JOIN_SQL)
             if args.gauges:
@@ -2146,6 +2284,45 @@ def main(argv=None) -> int:
                    dest="wait_budget_ms",
                    help="--network: admission-control p95 coalescing-wait "
                         "budget in ms (default 50)")
+    p.add_argument("--retry", action="store_true",
+                   help="--network: retry shed (429) and transient-failure "
+                        "responses client-side, honoring Retry-After with "
+                        "capped jittered backoff; off by default to "
+                        "preserve the committed captures' shed semantics")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet mode: run N in-process gateway replicas "
+                        "behind the consistent-hash router and fire the "
+                        "open-loop schedule THROUGH the router (retry, "
+                        "failover, re-pinning); headline row carries "
+                        "availability/failover/retry SLOs (FLEET_*.jsonl)")
+    p.add_argument("--replicas", type=int, default=3,
+                   help="--fleet: gateway replica count (default 3)")
+    p.add_argument("--chaos", action="store_true",
+                   help="--fleet: apply the default deterministic fault "
+                        "plan — kill one replica at 30%% of the run, "
+                        "restart it at 60%% — while the bench runs")
+    p.add_argument("--chaos-seed", type=int, default=0, dest="chaos_seed",
+                   help="--chaos: fault-plan seed (same seed = same "
+                        "injected faults; default 0)")
+    p.add_argument("--chaos-plan", dest="chaos_plan",
+                   help="--fleet: JSON fault-plan file (serve/faults.py "
+                        "FaultPlan.to_json) overriding the default "
+                        "kill/restart plan")
+    p.add_argument("--kill-at", type=float, default=None, dest="kill_at",
+                   help="--chaos: kill instant in seconds from loadgen "
+                        "start (default: 30%% of the expected run)")
+    p.add_argument("--restart-at", type=float, default=None,
+                   dest="restart_at",
+                   help="--chaos: restart instant in seconds (default: "
+                        "60%% of the expected run)")
+    p.add_argument("--retry-attempts", type=int, default=5,
+                   dest="retry_attempts",
+                   help="client retry policy: max attempts per request "
+                        "(--fleet router / --network --retry; default 5)")
+    p.add_argument("--retry-deadline-s", type=float, default=15.0,
+                   dest="retry_deadline_s",
+                   help="client retry policy: per-request deadline in "
+                        "seconds (default 15)")
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser(
@@ -2213,6 +2390,11 @@ def main(argv=None) -> int:
     p.add_argument("--gauges", action="store_true",
                    help="inline each joined run's gauge points "
                         "(profile.*, train.*, replay.*) into its row")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet view instead of the eval join: serving "
+                        "runs (replica bundles + fleet routers) grouped "
+                        "by config_hash with serve-trace totals and the "
+                        "router's failover/retry/ejection/shed counters")
     p.add_argument("--watch", action="store_true",
                    help="tail mode: poll the warehouse join and stream "
                         "new/updated rows as JSON lines until interrupted "

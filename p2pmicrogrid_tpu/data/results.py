@@ -295,6 +295,42 @@ def ensure_telemetry_schema(con: sqlite3.Connection) -> int:
     return TELEMETRY_SCHEMA_VERSION
 
 
+# One fleet view over per-replica serving runs (serve/router.py): every
+# serve-role telemetry run (replica bundles register one run per bundle,
+# the fleet router registers a 'router' run) grouped by config_hash, with
+# per-run serve_request trace counts and the router's resilience counters
+# (ejections/failovers/retries/sheds) summed alongside. The warehouse
+# analogue of aggregating per-replica GET /stats into one snapshot — but
+# over EVERYTHING ever recorded, not the live fleet.
+FLEET_VIEW_SQL = """
+SELECT t.config_hash,
+       COUNT(DISTINCT t.run_id) AS n_runs,
+       COUNT(DISTINCT CASE
+           WHEN json_extract(t.manifest_json, '$.serve_role') = 'router'
+           THEN t.run_id END) AS n_router_runs,
+       COUNT(CASE WHEN p.kind = 'serve_request' THEN 1 END)
+           AS n_serve_traces,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'router.failovers' THEN p.value END), 0)
+           AS router_failovers,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'router.retries' THEN p.value END), 0)
+           AS router_retries,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'router.ejections' THEN p.value END), 0)
+           AS router_ejections,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'router.shed' THEN p.value END), 0)
+           AS router_shed
+FROM telemetry_runs t
+LEFT JOIN telemetry_points p ON p.run_id = t.run_id
+WHERE json_extract(t.manifest_json, '$.serve_role') IS NOT NULL
+  AND t.config_hash IS NOT NULL
+GROUP BY t.config_hash
+ORDER BY t.config_hash
+"""
+
+
 # The default telemetry-query join (cli.py `telemetry-query`): one row per
 # (telemetry run, eval run) pair sharing a config_hash, with the run's gauge
 # points aggregated alongside the eval cost.
@@ -620,6 +656,14 @@ class ResultsStore:
         """Telemetry runs joined to eval runs on config_hash, as a list of
         dicts (``TELEMETRY_JOIN_SQL``) — the warehouse's headline query."""
         cur = self.con.execute(TELEMETRY_JOIN_SQL)
+        cols = [d[0] for d in cur.description]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
+
+    def query_fleet_view(self) -> list:
+        """Serving runs aggregated into one fleet view per config_hash
+        (``FLEET_VIEW_SQL``): replica/router run counts, serve-trace
+        totals and the router's resilience counters, as dicts."""
+        cur = self.con.execute(FLEET_VIEW_SQL)
         cols = [d[0] for d in cur.description]
         return [dict(zip(cols, row)) for row in cur.fetchall()]
 

@@ -19,6 +19,13 @@ trained policy given its observation:
 * ``gateway``  the network front (``serve-gateway`` CLI): asyncio HTTP/1.1
                endpoints bridging remote households into the microbatch
                queue, with admission control and drain-before-close.
+* ``router``   the fleet tier (``serve-bench --fleet``): consistent-hash
+               routing of households over N gateway replicas with health
+               probing, retry/failover/re-pinning, retry budgets,
+               two-phase fleet-wide hot-swap and aggregated fleet stats.
+* ``faults``   deterministic, seed-driven fault injection (kill/restart,
+               stall, 500s, connection drops, payload corruption) so
+               chaos runs replay exactly (``serve-bench --fleet --chaos``).
 """
 
 from p2pmicrogrid_tpu.serve.engine import (
@@ -26,11 +33,19 @@ from p2pmicrogrid_tpu.serve.engine import (
     PolicyEngine,
     Sessions,
 )
+from p2pmicrogrid_tpu.serve.faults import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    FaultSchedule,
+    kill_restart_plan,
+)
 from p2pmicrogrid_tpu.serve.gateway import (
     AdmissionConfig,
     GatewayServer,
     ServeGateway,
     build_gateway,
+    build_registry,
 )
 from p2pmicrogrid_tpu.serve.export import (
     BUNDLE_FORMAT_VERSION,
@@ -39,6 +54,8 @@ from p2pmicrogrid_tpu.serve.export import (
     load_policy_bundle,
 )
 from p2pmicrogrid_tpu.serve.loadgen import (
+    RetryBudget,
+    RetryPolicy,
     plan_open_loop,
     poisson_arrivals,
     run_network_loadgen,
@@ -46,24 +63,52 @@ from p2pmicrogrid_tpu.serve.loadgen import (
     serve_bench_network,
 )
 from p2pmicrogrid_tpu.serve.registry import BundleRegistry, ServingBundle
+from p2pmicrogrid_tpu.serve.router import (
+    ConsistentHashRing,
+    FleetRouter,
+    FleetSwapError,
+    LocalFleet,
+    NoHealthyReplicas,
+    Replica,
+    RouterResult,
+    run_fleet_loadgen,
+    serve_bench_fleet,
+)
 
 __all__ = [
     "AdmissionConfig",
     "BUNDLE_FORMAT_VERSION",
     "BundleRegistry",
+    "ConsistentHashRing",
+    "FaultEvent",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSchedule",
+    "FleetRouter",
+    "FleetSwapError",
     "GatewayServer",
+    "LocalFleet",
     "MicroBatchQueue",
+    "NoHealthyReplicas",
     "PolicyEngine",
+    "Replica",
+    "RetryBudget",
+    "RetryPolicy",
+    "RouterResult",
     "ServeGateway",
     "ServingBundle",
     "Sessions",
     "build_gateway",
+    "build_registry",
     "export_bundle_from_checkpoint",
     "export_policy_bundle",
+    "kill_restart_plan",
     "load_policy_bundle",
     "plan_open_loop",
     "poisson_arrivals",
+    "run_fleet_loadgen",
     "run_network_loadgen",
     "serve_bench",
+    "serve_bench_fleet",
     "serve_bench_network",
 ]

@@ -15,7 +15,11 @@ Endpoints:
                        households coalesce into one padded engine batch
                        exactly as in-process callers do.
 * ``GET  /healthz``    process liveness (200 once the server accepts).
-* ``GET  /readyz``     traffic readiness (503 while draining/bundle-less).
+* ``GET  /readyz``     traffic readiness (503 while draining/bundle-less);
+                       the 200 body carries the active default
+                       ``config_hash`` (and ``replica_id`` when set) — the
+                       fleet two-phase swap verifies each replica flipped
+                       against it (serve/router.py).
 * ``GET  /stats``      gateway + per-bundle snapshot (the schema
                        ``tools/check_artifacts_schema.py`` validates for
                        committed ``GATEWAY_STATS_*.json`` captures).
@@ -45,6 +49,11 @@ Design points:
   JSON float64 repr, which round-trips binary32 exactly — the end-to-end
   test asserts network responses byte-equal to a direct
   ``PolicyEngine.act`` on the same observations.
+* **Fault injection is a first-class hook.** A ``faults.FaultInjector``
+  (deterministic, seed-driven) can stall, 500, drop or detectably corrupt
+  responses per request — the chaos harness the fleet router's
+  retry/failover paths are tested against. ``abort()`` is the replica
+  kill switch: sever every open connection with a reset, no drain.
 """
 
 from __future__ import annotations
@@ -114,6 +123,8 @@ class ServeGateway:
         port: int = 0,
         request_timeout_s: float = 30.0,
         own_bundles: bool = False,
+        fault_injector=None,
+        replica_id: Optional[str] = None,
     ):
         self.registry = registry
         self.admission = admission or AdmissionConfig()
@@ -121,6 +132,11 @@ class ServeGateway:
         self.port = port
         self.request_timeout_s = request_timeout_s
         self.own_bundles = own_bundles
+        # Chaos hook (serve/faults.py): decides per request whether to
+        # stall/500/drop/corrupt. None in production; the fleet bench and
+        # the failure-path tests wire one in.
+        self.fault_injector = fault_injector
+        self.replica_id = replica_id
         self.created = time.strftime("%Y-%m-%dT%H:%M:%S%z")
         self._t0 = time.monotonic()
         self._server: Optional[asyncio.AbstractServer] = None
@@ -128,9 +144,17 @@ class ServeGateway:
         self._inflight = 0
         self._idle = asyncio.Event()
         self._idle.set()
+        # stop() must be safe under repeated AND concurrent calls (a
+        # signal handler racing a --serve-seconds timer, a fleet teardown
+        # racing a test's context manager): the lock serializes, the flag
+        # short-circuits repeats.
+        self._stop_lock = asyncio.Lock()
+        self._stopped = False
+        self._conns: set = set()
         self.stats = {
             "requests": 0, "act_requests": 0, "act_rows": 0, "act_ok": 0,
             "shed": 0, "http_errors": 0, "swaps": 0, "drained": 0,
+            "faults_injected": 0,
         }
 
     # -- lifecycle -----------------------------------------------------------
@@ -141,6 +165,11 @@ class ServeGateway:
             self._handle_connection, self.host, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        # NOTE: the fault injector is deliberately NOT activated here. Its
+        # windows anchor either at the harness's explicit activate() (the
+        # fleet bench pins every replica to the loadgen start instant —
+        # anchoring at server start would skew windows by each replica's
+        # warmup) or lazily at the first request it sees.
         return self.host, self.port
 
     @property
@@ -162,19 +191,52 @@ class ServeGateway:
                 pass
 
     async def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
-        """Drain (optionally), stop accepting, close owned bundles."""
-        if drain:
-            await self.drain(timeout_s)
+        """Drain (optionally), stop accepting, close owned bundles.
+
+        Idempotent under repeated and concurrent calls: the first caller
+        does the work, later callers wait on the lock and return — a
+        rolling-restart controller retrying stop must not re-close
+        bundles or hang on a dead server."""
+        async with self._stop_lock:
+            if self._stopped:
+                return
+            if drain:
+                await self.drain(timeout_s)
+            if self._server is not None:
+                self._server.close()
+                await self._server.wait_closed()
+                self._server = None
+            if self.own_bundles:
+                self.registry.close_all()
+            self._stopped = True
+
+    async def abort(self) -> None:
+        """The replica KILL switch (fault harness): stop accepting and
+        sever every open connection with a reset — no drain, in-flight
+        clients see a dropped connection, engines/queues stay untouched
+        (a restart reuses them warm). This is deliberately NOT stop():
+        a kill must look like a crash to clients, not a rolling drain."""
+        self._draining = True
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
             self._server = None
-        if self.own_bundles:
-            self.registry.close_all()
+        for writer in list(self._conns):
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()
 
     # -- HTTP plumbing -------------------------------------------------------
 
+    @staticmethod
+    def _fault_scope(path: str) -> str:
+        if path == "/v1/act":
+            return "act"
+        if path in ("/healthz", "/readyz"):
+            return "health"
+        return "other"
+
     async def _handle_connection(self, reader, writer) -> None:
+        self._conns.add(writer)
         try:
             while True:
                 try:
@@ -201,7 +263,23 @@ class ServeGateway:
                     break
                 method, path, headers, body = request
                 self.stats["requests"] += 1
+                fault = None
+                if self.fault_injector is not None:
+                    fault = self.fault_injector.decide(
+                        self._fault_scope(path)
+                    )
+                if fault is not None:
+                    self.stats["faults_injected"] += 1
+                    if fault.kind == "drop":
+                        # Vanish mid-exchange: the client sees EOF with no
+                        # response — the transport-failure path the router
+                        # must survive.
+                        break
+                    if fault.kind == "stall":
+                        await asyncio.sleep(fault.stall_s)
                 try:
+                    if fault is not None and fault.kind == "error":
+                        raise _HttpError(500, "injected fault")
                     status, payload, extra = await self._route(
                         method, path, body
                     )
@@ -221,12 +299,16 @@ class ServeGateway:
                     extra = []
                     self.stats["http_errors"] += 1
                 keep_alive = headers.get("connection", "").lower() != "close"
-                await self._send(writer, status, payload, extra, keep_alive)
+                await self._send(
+                    writer, status, payload, extra, keep_alive,
+                    corrupt=fault is not None and fault.kind == "corrupt",
+                )
                 if not keep_alive:
                     break
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # client went away mid-request
         finally:
+            self._conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -279,9 +361,17 @@ class ServeGateway:
         return method, path, headers, body
 
     async def _send(
-        self, writer, status: int, payload: dict, extra_headers, keep_alive
+        self, writer, status: int, payload: dict, extra_headers, keep_alive,
+        corrupt: bool = False,
     ) -> None:
         body = json.dumps(payload).encode()
+        if corrupt:
+            # Injected payload corruption (faults.py): same length so the
+            # HTTP framing stays valid, but 0xff bytes are never valid
+            # UTF-8/JSON — every client DETECTS the corruption instead of
+            # mistaking it for a real answer.
+            k = min(8, len(body))
+            body = b"\xff" * k + body[k:]
         headers = [
             f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
             f"Content-Length: {len(body)}",
@@ -302,13 +392,20 @@ class ServeGateway:
         if path == "/readyz":
             if method != "GET":
                 raise _HttpError(405, "GET only")
-            ready = not self._draining and self.registry.default_hash
-            if not ready:
+            default_hash = self.registry.default_hash
+            doc = {"config_hash": default_hash}
+            if self.replica_id is not None:
+                doc["replica_id"] = self.replica_id
+            if self._draining or not default_hash:
                 return 503, {
                     "ready": False,
                     "reason": "draining" if self._draining else "no bundles",
+                    **doc,
                 }, []
-            return 200, {"ready": True}, []
+            # The ACTIVE default config_hash rides readiness: the fleet
+            # two-phase swap pushes to every replica, then verifies each
+            # one reports the new hash here before declaring the flip.
+            return 200, {"ready": True, **doc}, []
         if path == "/stats":
             if method != "GET":
                 raise _HttpError(405, "GET only")
@@ -526,6 +623,7 @@ class ServeGateway:
         reg = self.registry.stats()
         return {
             "kind": "gateway_stats",
+            "replica_id": self.replica_id,
             "created": self.created,
             "uptime_s": self.uptime_s,
             "draining": self._draining,
@@ -547,20 +645,22 @@ class ServeGateway:
 # -- construction -------------------------------------------------------------
 
 
-def build_gateway(
+def build_registry(
     bundle_dirs,
     max_batch: int = 64,
     max_wait_s: float = 0.002,
     results_db: Optional[str] = None,
     device: str = "auto",
-    admission: Optional[AdmissionConfig] = None,
-    host: str = "127.0.0.1",
-    port: int = 0,
     warmup: bool = True,
     run_name: str = "gateway",
-) -> ServeGateway:
+) -> BundleRegistry:
     """Load each bundle dir into an engine + queue + per-bundle telemetry
-    and return a gateway owning them (first bundle is the default).
+    registered in a fresh ``BundleRegistry`` (first bundle = default).
+
+    The caller owns the registry (``close_all`` on teardown). Split out of
+    ``build_gateway`` so the fleet harness (serve/router.py ``LocalFleet``)
+    can keep one warm registry per replica across gateway kill/restart
+    cycles — a restarted replica must not recompile its engines.
 
     With ``results_db``, every bundle's telemetry streams into the SQLite
     warehouse keyed by THAT bundle's config_hash — the per-request
@@ -622,8 +722,37 @@ def build_gateway(
             pending_tel.close()
         registry.close_all()
         raise
+    return registry
+
+
+def build_gateway(
+    bundle_dirs,
+    max_batch: int = 64,
+    max_wait_s: float = 0.002,
+    results_db: Optional[str] = None,
+    device: str = "auto",
+    admission: Optional[AdmissionConfig] = None,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    warmup: bool = True,
+    run_name: str = "gateway",
+    fault_injector=None,
+    replica_id: Optional[str] = None,
+) -> ServeGateway:
+    """``build_registry`` + a gateway owning the result (the one-process
+    serving entry point; the fleet harness composes the pieces itself)."""
+    registry = build_registry(
+        bundle_dirs,
+        max_batch=max_batch,
+        max_wait_s=max_wait_s,
+        results_db=results_db,
+        device=device,
+        warmup=warmup,
+        run_name=run_name,
+    )
     return ServeGateway(
-        registry, admission=admission, host=host, port=port, own_bundles=True
+        registry, admission=admission, host=host, port=port, own_bundles=True,
+        fault_injector=fault_injector, replica_id=replica_id,
     )
 
 
@@ -636,6 +765,10 @@ class GatewayServer:
         self.gateway = gateway
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
+        # stop()/kill() may race from different threads (fleet chaos
+        # schedule vs. test teardown); first caller in wins, the rest
+        # no-op against the cleared loop.
+        self._stop_lock = threading.Lock()
 
     def start(self, timeout_s: float = 60.0) -> Tuple[str, int]:
         started = threading.Event()
@@ -677,19 +810,68 @@ class GatewayServer:
         return self.gateway.host, self.gateway.port
 
     def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
-        if self._loop is None:
-            return
-        future = asyncio.run_coroutine_threadsafe(
-            self.gateway.stop(drain=drain, timeout_s=timeout_s), self._loop
-        )
-        try:
-            future.result(timeout=timeout_s + 5.0)
-        finally:
-            self._loop.call_soon_threadsafe(self._loop.stop)
-            if self._thread is not None:
-                self._thread.join(timeout=10.0)
-            self._loop = None
-            self._thread = None
+        async def teardown() -> None:
+            await self.gateway.stop(drain=drain, timeout_s=timeout_s)
+            # In-flight act requests drained above; what remains are idle
+            # keep-alive connections or fault-stalled handlers. Cancel
+            # them and let their finally blocks run before the loop dies,
+            # or asyncio logs "Task was destroyed but it is pending!".
+            tasks = [
+                t for t in asyncio.all_tasks()
+                if t is not asyncio.current_task()
+            ]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        with self._stop_lock:
+            loop = self._loop
+            if loop is None:
+                return  # already stopped/killed (idempotent)
+            future = asyncio.run_coroutine_threadsafe(teardown(), loop)
+            try:
+                future.result(timeout=timeout_s + 5.0)
+            finally:
+                loop.call_soon_threadsafe(loop.stop)
+                if self._thread is not None:
+                    self._thread.join(timeout=10.0)
+                self._loop = None
+                self._thread = None
+
+    def kill(self, timeout_s: float = 5.0) -> None:
+        """Abrupt replica kill (fault harness): abort every connection and
+        tear the loop down — clients see resets, nothing drains, engines
+        and queues are left untouched for a warm restart. Idempotent, and
+        safe to interleave with stop()."""
+
+        async def teardown() -> None:
+            await self.gateway.abort()
+            # Cancel the orphaned handler tasks and let their finally
+            # blocks run before the loop dies — otherwise asyncio logs a
+            # "Task was destroyed but it is pending!" per connection.
+            tasks = [
+                t for t in asyncio.all_tasks()
+                if t is not asyncio.current_task()
+            ]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        with self._stop_lock:
+            loop = self._loop
+            if loop is None:
+                return
+            future = asyncio.run_coroutine_threadsafe(teardown(), loop)
+            try:
+                future.result(timeout=timeout_s)
+            except Exception:  # noqa: BLE001 — a kill must always finish
+                pass
+            finally:
+                loop.call_soon_threadsafe(loop.stop)
+                if self._thread is not None:
+                    self._thread.join(timeout=10.0)
+                self._loop = None
+                self._thread = None
 
     def __enter__(self) -> "GatewayServer":
         self.start()

@@ -30,11 +30,106 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 import numpy as np
+
+
+# --- client retry primitives --------------------------------------------------
+#
+# Shared by the network loadgen's optional retry mode (below) and the fleet
+# router (serve/router.py), which layers failover re-routing on top. They
+# live here — not in router.py — so the import direction stays acyclic
+# (router imports loadgen for the open-loop schedule machinery).
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Client-side retry discipline for transient serve failures.
+
+    * ``max_attempts`` bounds tries per request (1 = never retry).
+    * ``deadline_s`` is the per-request wall budget: no attempt or backoff
+      sleep may start past it — a household's 15-minute-slot decision is
+      worthless late, so requests fail fast rather than queue forever.
+    * Backoff between attempts is capped exponential with multiplicative
+      jitter: ``base * 2^attempt`` clipped to ``backoff_cap_s``, scaled by
+      a uniform draw from [1 - jitter, 1]. Jitter de-synchronizes retry
+      waves — a fleet-wide brown-out must not turn into a synchronized
+      retry hammer on the recovering replica.
+    * A server-supplied ``Retry-After`` (429/503 sheds carry one) takes
+      precedence over the computed backoff when larger — the server knows
+      its own recovery horizon better than the client's guess.
+    """
+
+    max_attempts: int = 4
+    deadline_s: float = 10.0
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    jitter: float = 0.5
+    honor_retry_after: bool = True
+
+    def backoff_s(
+        self,
+        attempt: int,
+        rng: random.Random,
+        retry_after_s: Optional[float] = None,
+    ) -> float:
+        """Sleep before attempt ``attempt + 1`` (attempt counts from 0)."""
+        base = min(self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt))
+        delay = base * (1.0 - self.jitter * rng.random())
+        if retry_after_s is not None and self.honor_retry_after:
+            delay = max(delay, retry_after_s)
+        return delay
+
+
+class RetryBudget:
+    """Token-bucket retry budget (the anti-retry-storm governor).
+
+    Every first attempt deposits ``ratio`` tokens (capped); every retry
+    withdraws one. Under a brown-out the bucket drains and retries STOP
+    fleet-wide at ~``ratio`` of offered load, instead of each client
+    multiplying the overload by ``max_attempts`` — the retry-storm
+    failure mode. ``min_tokens`` is the starting balance so low-traffic
+    periods can still retry. Thread-safe (the router's probe thread and
+    event loop share it).
+    """
+
+    def __init__(
+        self, ratio: float = 0.2, min_tokens: float = 8.0,
+        cap: float = 64.0,
+    ):
+        if ratio < 0:
+            raise ValueError(f"ratio must be >= 0, got {ratio}")
+        self.ratio = ratio
+        self.cap = max(cap, min_tokens)
+        self._tokens = min(min_tokens, self.cap)
+        self._lock = threading.Lock()
+        self.spent = 0
+        self.denied = 0
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def on_attempt(self) -> None:
+        """Deposit for one FIRST attempt (not retries)."""
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        """Withdraw one token for a retry; False = budget exhausted."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
 
 
 def poisson_arrivals(rate_hz: float, n: int, seed: int = 0) -> np.ndarray:
@@ -189,10 +284,21 @@ def _emit_request_traces(tel, arrivals: np.ndarray, result: LoadgenResult) -> No
 class NetworkLoadgenResult:
     """Per-request wire measurements from one network loadgen run."""
 
-    latencies_s: np.ndarray    # [N] send -> full response, ALL requests
-    statuses: np.ndarray       # [N] HTTP status (-1 = transport error)
+    latencies_s: np.ndarray    # [N] send -> FINAL response (incl. retries)
+    statuses: np.ndarray       # [N] final HTTP status (-1 = transport error)
     config_hashes: List       # per request: serving bundle hash (None if shed)
     makespan_s: float          # first send -> last completion
+    # Per-request retry counts and gave-up flags (all-zero when the
+    # loadgen runs in its default no-retry mode).
+    retries: Optional[np.ndarray] = None
+    gave_up: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        n = int(self.statuses.shape[0])
+        if self.retries is None:
+            self.retries = np.zeros(n, dtype=np.int64)
+        if self.gave_up is None:
+            self.gave_up = np.zeros(n, dtype=bool)
 
     @property
     def n_requests(self) -> int:
@@ -217,6 +323,21 @@ class NetworkLoadgenResult:
         return self.n_shed / self.n_requests if self.n_requests else 0.0
 
     @property
+    def total_retries(self) -> int:
+        return int(self.retries.sum())
+
+    @property
+    def retry_rate(self) -> float:
+        """Retries per offered request (0.0 in no-retry mode)."""
+        return self.total_retries / self.n_requests if self.n_requests else 0.0
+
+    @property
+    def n_gave_up(self) -> int:
+        """Requests that retried and still failed (exhausted attempts,
+        budget or deadline)."""
+        return int(self.gave_up.sum())
+
+    @property
     def throughput_rps(self) -> float:
         return self.n_ok / self.makespan_s if self.makespan_s > 0 else 0.0
 
@@ -227,19 +348,28 @@ class NetworkLoadgenResult:
         return float(np.percentile(ok, q) * 1e3) if ok.size else 0.0
 
 
-async def _http_post_json(
-    host: str, port: int, path: str, payload: dict, timeout_s: float
+async def _http_request_json(
+    host: str,
+    port: int,
+    method: str,
+    path: str,
+    payload: Optional[dict],
+    timeout_s: float,
 ):
-    """One POST over a fresh connection; returns (status, parsed body).
-    Stdlib-only HTTP/1.1 — mirrors the gateway's server side."""
-    body = json.dumps(payload).encode()
-    request = (
-        f"POST {path} HTTP/1.1\r\n"
-        f"Host: {host}:{port}\r\n"
-        "Content-Type: application/json\r\n"
-        f"Content-Length: {len(body)}\r\n"
-        "Connection: close\r\n\r\n"
-    ).encode() + body
+    """One JSON request over a fresh connection; returns (status, parsed
+    body, response headers). A non-empty body that fails to parse comes
+    back as ``None`` (NOT ``{}``) so callers can tell payload corruption
+    from an intentionally empty response and retry it. Stdlib-only
+    HTTP/1.1 — mirrors the gateway's server side; the ONE copy of the
+    client framing logic (the fleet router's GETs share it)."""
+    body = json.dumps(payload).encode() if payload is not None else b""
+    head = f"{method} {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+    if payload is not None:
+        head += (
+            "Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+        )
+    request = (head + "Connection: close\r\n\r\n").encode() + body
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(host, port), timeout_s
     )
@@ -250,11 +380,13 @@ async def _http_post_json(
         parts = status_line.decode("latin-1").split()
         status = int(parts[1]) if len(parts) >= 2 else -1
         length = 0
+        headers = {}
         while True:
             h = await asyncio.wait_for(reader.readline(), timeout_s)
             if h in (b"\r\n", b"\n", b""):
                 break
             name, _, value = h.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
             if name.strip().lower() == "content-length":
                 length = int(value.strip())
         raw = (
@@ -264,14 +396,34 @@ async def _http_post_json(
         try:
             doc = json.loads(raw.decode()) if raw else {}
         except (UnicodeDecodeError, json.JSONDecodeError):
-            doc = {}
-        return status, doc
+            doc = None  # detectably corrupt payload
+        return status, doc, headers
     finally:
         writer.close()
         try:
             await writer.wait_closed()
         except (ConnectionError, OSError):
             pass
+
+
+async def _http_post_json(
+    host: str, port: int, path: str, payload: dict, timeout_s: float
+):
+    """(status, doc, headers) of one POST — see ``_http_request_json``."""
+    return await _http_request_json(
+        host, port, "POST", path, payload, timeout_s
+    )
+
+
+def _retry_after_s(headers: Optional[dict]) -> Optional[float]:
+    """The Retry-After header as seconds, when present and numeric."""
+    if not headers:
+        return None
+    try:
+        value = headers.get("retry-after")
+        return float(value) if value is not None else None
+    except (TypeError, ValueError):
+        return None
 
 
 def run_network_loadgen(
@@ -282,6 +434,8 @@ def run_network_loadgen(
     households: List[str],
     path: str = "/v1/act",
     timeout_s: float = 30.0,
+    retry: Optional[RetryPolicy] = None,
+    retry_seed: int = 0,
 ) -> NetworkLoadgenResult:
     """Fire ``obs[i]`` at the gateway at ``arrivals[i]`` seconds (open loop:
     send times never wait on completions) and measure wire latencies.
@@ -289,13 +443,40 @@ def run_network_loadgen(
     One connection per request — each simulated household is an independent
     remote client; connection reuse would serialize them onto shared
     sockets and hide queueing the open-loop methodology exists to expose.
+
+    ``retry=None`` (the default) preserves the capture semantics every
+    committed ``SERVE_GATEWAY_*`` row was measured under: a 429 is a
+    terminal shed, a transport error a terminal failure. With a
+    ``RetryPolicy``, shed (429) and transient-failure (5xx / transport /
+    corrupt-payload) responses are retried with capped jittered backoff,
+    honoring the server's ``Retry-After``, inside the policy's deadline;
+    the result then reports ``retry_rate`` and ``n_gave_up`` next to
+    ``shed_rate``, and latency includes the backoff time a real client
+    would spend. Retry sleeps are seeded (``retry_seed``) so two runs
+    draw identical jitter.
     """
     obs = np.asarray(obs, dtype=np.float32)  # host-sync: host-side inputs
     arrivals = np.asarray(arrivals, dtype=float)
     n = int(arrivals.shape[0])
     latencies = np.zeros(n)
     statuses = np.full(n, -1, dtype=np.int64)
+    retries = np.zeros(n, dtype=np.int64)
+    gave_up = np.zeros(n, dtype=bool)
     hashes: List = [None] * n
+
+    async def attempt(payload: dict, attempt_timeout_s: float):
+        """(status, doc, headers); transport failures -> status -1."""
+        try:
+            return await _http_post_json(
+                host, port, path, payload, attempt_timeout_s
+            )
+        except (
+            ConnectionError, OSError, EOFError, ValueError,
+            asyncio.TimeoutError, asyncio.IncompleteReadError,
+        ):
+            # Transport failures score as status -1 (n_errors), they must
+            # not abort the whole open-loop schedule mid-run.
+            return -1, {}, {}
 
     async def one(i: int, t0: float) -> None:
         delay = (arrivals[i] - arrivals[0]) - (time.perf_counter() - t0)
@@ -305,21 +486,46 @@ def run_network_loadgen(
             "household": households[i % len(households)],
             "obs": obs[i].tolist(),
         }
+        rng = random.Random((retry_seed << 20) ^ i)
         t_send = time.perf_counter()
-        try:
-            status, doc = await _http_post_json(
-                host, port, path, payload, timeout_s
+        deadline = t_send + (retry.deadline_s if retry else timeout_s)
+        tries = 0
+        while True:
+            # In retry mode the per-request deadline caps every attempt's
+            # socket timeout too — one hung attempt must not overrun the
+            # policy's wall budget by the full transport timeout.
+            attempt_timeout = timeout_s if retry is None else max(
+                0.05, min(timeout_s, deadline - time.perf_counter())
             )
-        except (
-            ConnectionError, OSError, EOFError, ValueError,
-            asyncio.TimeoutError, asyncio.IncompleteReadError,
-        ):
-            # Transport failures score as status -1 (n_errors), they must
-            # not abort the whole open-loop schedule mid-run.
-            status, doc = -1, {}
+            status, doc, headers = await attempt(payload, attempt_timeout)
+            tries += 1
+            # A 200 whose payload failed to parse is a corrupt answer —
+            # retryable, never reported as success.
+            corrupt = status == 200 and doc is None
+            ok = status == 200 and not corrupt
+            terminal_client_err = status in (400, 404, 405, 413)
+            if corrupt:
+                status = -1
+            if (
+                retry is None or ok or terminal_client_err
+                or tries >= retry.max_attempts
+            ):
+                gave_up[i] = retry is not None and tries > 1 and not ok
+                break
+            # Past here the failure is retryable (shed/5xx/transport/
+            # corrupt) and attempts remain — back off unless the sleep
+            # itself would overrun the request deadline.
+            backoff = retry.backoff_s(
+                tries - 1, rng, _retry_after_s(headers)
+            )
+            if time.perf_counter() + backoff >= deadline:
+                gave_up[i] = True
+                break
+            retries[i] += 1
+            await asyncio.sleep(backoff)
         latencies[i] = time.perf_counter() - t_send
         statuses[i] = status
-        hashes[i] = doc.get("config_hash")
+        hashes[i] = (doc or {}).get("config_hash")
 
     async def run() -> float:
         t0 = time.perf_counter()
@@ -332,6 +538,8 @@ def run_network_loadgen(
         statuses=statuses,
         config_hashes=hashes,
         makespan_s=makespan,
+        retries=retries,
+        gave_up=gave_up,
     )
 
 
@@ -347,19 +555,23 @@ def serve_bench_network(
     timeout_s: float = 30.0,
     emit: Optional[Callable[[dict], None]] = None,
     extra_headline: Optional[dict] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> List[dict]:
     """Wire-level SLO benchmark: the serve-bench schedule over real sockets.
 
     Same row contract as ``serve_bench`` (metric rows, headline LAST), with
     wire percentiles and the admission-control shed rate. ``vs_baseline``:
     SLO headroom for latency rows, served/offered for throughput, and the
-    served fraction (1 - shed_rate) for the shed row.
+    served fraction (1 - shed_rate) for the shed row. With ``retry`` the
+    client retries sheds/transients (see ``run_network_loadgen``) and the
+    headline grows ``retry_rate``/``n_gave_up``.
     """
     arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
     obs = synthetic_obs(n_requests, n_agents, seed=seed)
     households = [f"house-{i:04d}" for i in range(n_households)]
     result = run_network_loadgen(
-        host, port, obs, arrivals, households, timeout_s=timeout_s
+        host, port, obs, arrivals, households, timeout_s=timeout_s,
+        retry=retry, retry_seed=seed,
     )
     p50, p95, p99 = (result.latency_ms(q) for q in (50, 95, 99))
     rows = [
@@ -405,6 +617,9 @@ def serve_bench_network(
             "n_ok": result.n_ok,
             "n_shed": result.n_shed,
             "n_errors": result.n_errors,
+            "retry_rate": round(result.retry_rate, 4),
+            "n_gave_up": result.n_gave_up,
+            "retry_enabled": retry is not None,
             "n_households": n_households,
             "offered_rate_rps": rate_hz,
             "slo_ms": slo_ms,

@@ -1,0 +1,1291 @@
+"""Resilient fleet tier: consistent-hash routing over N gateway replicas.
+
+One gateway process (serve/gateway.py) fronts one host's engines — a
+single point of failure for the whole community. This module is the tier
+above it, sized for the paper's deployment story (millions of households
+deciding every 15-minute slot):
+
+* **Consistent-hash routing.** Households map onto a ring of replica
+  virtual nodes by the same deterministic sha256 household hash
+  ``serve/registry.py`` uses for A/B splits. Losing a replica moves ONLY
+  the households that hashed to it (they slide clockwise to the next
+  healthy replica); every other household keeps its replica — and with it
+  the warm per-household session/affinity state that replica holds.
+
+* **Health: active probes + passive signals.** A prober sweeps each
+  replica's ``/readyz`` on an interval; ``fail_threshold`` consecutive
+  failures eject a replica from routing, ``ok_threshold`` consecutive
+  successes re-admit it. Request-path transport errors and 5xx responses
+  feed the same consecutive counters, so a crashed replica stops
+  receiving traffic after a handful of failed requests — typically well
+  before the next probe sweep notices.
+
+* **Retry discipline** (``loadgen.RetryPolicy``): per-request deadline,
+  capped jittered exponential backoff, server ``Retry-After`` honored,
+  and a token-bucket ``RetryBudget`` so a fleet-wide brown-out degrades
+  to ~budget-ratio extra load instead of a retry storm. A replica dying
+  mid-request fails over: the failed replica is excluded for the rest of
+  that request, the household re-routes to the next healthy replica on
+  the ring, and a success there RE-PINS the household (it stays on its
+  failover target — flapping back the moment the original recovers would
+  tear warm session state twice).
+
+* **Graceful degradation.** No healthy replica, or a retry the budget
+  refuses: the router sheds locally — an immediate 503 with
+  ``Retry-After`` — rather than queueing unboundedly in front of a fleet
+  that cannot absorb the load.
+
+* **Two-phase fleet swap.** ``swap_fleet(config_hash)`` pushes
+  ``POST /admin/swap`` to every healthy replica, then verifies each
+  replica's ``/readyz`` reports the new ``config_hash`` before declaring
+  the flip (failed pushes/verifies roll the pushed replicas back). Each
+  per-replica swap is atomic and in-flight requests finish on the bundle
+  that admitted them, so a fleet-wide swap drops zero requests.
+
+* **One fleet view.** ``fleet_stats()`` aggregates per-replica
+  ``GET /stats`` into a single snapshot; router counters (ejections,
+  failovers, retries, backoff time, sheds) stream through the attached
+  ``Telemetry`` into the SQLite warehouse next to the per-bundle serve
+  traces (``data/results.py::FLEET_VIEW_SQL`` joins them back together).
+
+``LocalFleet`` runs N in-process replicas (each its own engines + queues +
+asyncio loop thread) with kill/restart hooks for the deterministic fault
+harness (serve/faults.py); ``serve_bench_fleet`` drives the open-loop
+Poisson loadgen through the router over a live fleet while a fault plan
+kills and restarts replicas mid-run — the ``serve-bench --fleet --chaos``
+CLI and the committed ``FLEET_*.jsonl`` captures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import hashlib
+import http.client
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2pmicrogrid_tpu.serve.faults import FaultInjector, FaultPlan, FaultSchedule
+from p2pmicrogrid_tpu.serve.loadgen import (
+    RetryBudget,
+    RetryPolicy,
+    _http_post_json,
+    _http_request_json,
+    _retry_after_s,
+    poisson_arrivals,
+    synthetic_obs,
+)
+
+_TRANSPORT_ERRORS = (
+    ConnectionError, OSError, EOFError, ValueError,
+    asyncio.TimeoutError, asyncio.IncompleteReadError,
+)
+
+
+@dataclass(frozen=True)
+class Replica:
+    """One addressable gateway replica."""
+
+    replica_id: str
+    host: str
+    port: int
+
+
+class NoHealthyReplicas(RuntimeError):
+    """Every replica is ejected — the router must shed, not queue."""
+
+
+class FleetSwapError(RuntimeError):
+    """A two-phase fleet swap failed (pushed replicas were rolled back)."""
+
+
+# -- consistent-hash ring ------------------------------------------------------
+
+
+def _ring_point(key: str) -> int:
+    """Deterministic 64-bit ring position (stable across processes —
+    hashlib, not the salted builtin ``hash``)."""
+    return int.from_bytes(hashlib.sha256(key.encode()).digest()[:8], "big")
+
+
+class ConsistentHashRing:
+    """Classic consistent hashing with virtual nodes.
+
+    Each replica owns ``vnodes`` points; a key routes to the first point
+    clockwise. ``vnodes`` trades balance for lookup-table size: at 64
+    vnodes a 3-replica ring splits keys within a few percent of evenly.
+    ``lookup(key, accept)`` walks clockwise past points whose replica the
+    predicate rejects — the consistent-hashing failover rule that moves
+    ONLY the rejected replica's keys, to their next-clockwise survivor.
+    """
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self._points: List[int] = []       # sorted vnode positions
+        self._owners: List[str] = []       # replica id per point
+        self._replicas: set = set()
+
+    def add(self, replica_id: str) -> None:
+        if replica_id in self._replicas:
+            raise ValueError(f"replica {replica_id!r} already on the ring")
+        self._replicas.add(replica_id)
+        for v in range(self.vnodes):
+            point = _ring_point(f"{replica_id}#{v}")
+            i = bisect.bisect_left(self._points, point)
+            self._points.insert(i, point)
+            self._owners.insert(i, replica_id)
+
+    def remove(self, replica_id: str) -> None:
+        if replica_id not in self._replicas:
+            raise KeyError(f"replica {replica_id!r} not on the ring")
+        self._replicas.discard(replica_id)
+        keep = [
+            (p, o)
+            for p, o in zip(self._points, self._owners)
+            if o != replica_id
+        ]
+        self._points = [p for p, _ in keep]
+        self._owners = [o for _, o in keep]
+
+    def __len__(self) -> int:
+        return len(self._replicas)
+
+    def lookup(
+        self, key: str, accept: Optional[Callable[[str], bool]] = None
+    ) -> Optional[str]:
+        """First replica clockwise from ``key`` whose id passes
+        ``accept`` (default: any). None on an empty/filtered-out ring."""
+        if not self._points:
+            return None
+        start = bisect.bisect_right(self._points, _ring_point(key))
+        n = len(self._points)
+        seen: set = set()
+        for step in range(n):
+            owner = self._owners[(start + step) % n]
+            if owner in seen:
+                continue
+            if accept is None or accept(owner):
+                return owner
+            seen.add(owner)
+        return None
+
+
+# -- router --------------------------------------------------------------------
+
+
+@dataclass
+class _ReplicaState:
+    replica: Replica
+    healthy: bool = True
+    consecutive_fail: int = 0
+    consecutive_ok: int = 0
+    ejections: int = 0
+    last_error: str = ""
+
+
+@dataclass
+class RouterResult:
+    """One routed request's outcome."""
+
+    status: int                      # final HTTP status (-1 transport, 503 shed)
+    actions: Optional[list] = None
+    config_hash: Optional[str] = None
+    replica_id: Optional[str] = None
+    retries: int = 0
+    failovers: int = 0
+    shed: bool = False               # the ROUTER refused (budget/no replicas)
+    retry_after_s: Optional[float] = None
+    error: Optional[str] = None
+    gave_up: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return self.status == 200
+
+
+class FleetRouter:
+    """Client-side fleet front: consistent-hash routing + health + retry.
+
+    Thread-safe: routing state is lock-held, ``act`` runs on an asyncio
+    loop while the prober thread updates health concurrently.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Replica],
+        retry: Optional[RetryPolicy] = None,
+        budget: Optional[RetryBudget] = None,
+        vnodes: int = 64,
+        fail_threshold: int = 3,
+        ok_threshold: int = 2,
+        probe_timeout_s: float = 2.0,
+        request_timeout_s: float = 30.0,
+        shed_retry_after_s: float = 1.0,
+        telemetry=None,
+        jitter_seed: int = 0,
+    ):
+        if not replicas:
+            raise ValueError("pass at least one replica")
+        self.retry = retry or RetryPolicy()
+        self.budget = budget or RetryBudget()
+        self.fail_threshold = fail_threshold
+        self.ok_threshold = ok_threshold
+        self.probe_timeout_s = probe_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.shed_retry_after_s = shed_retry_after_s
+        self.telemetry = telemetry
+        self._lock = threading.RLock()
+        self._ring = ConsistentHashRing(vnodes=vnodes)
+        self._state: Dict[str, _ReplicaState] = {}
+        self._order: List[str] = []
+        for r in replicas:
+            self._state[r.replica_id] = _ReplicaState(replica=r)
+            self._order.append(r.replica_id)
+            self._ring.add(r.replica_id)
+        self._pins: Dict[str, str] = {}   # household -> failover target
+        self._anon_rr = 0
+        self._rng = random.Random(jitter_seed)
+        self._prober: Optional[threading.Thread] = None
+        self._prober_stop = threading.Event()
+        self.fleet_config_hash: Optional[str] = None
+        self.counters: Dict[str, float] = {
+            "requests": 0, "retries": 0, "failovers": 0, "repins": 0,
+            "ejections": 0, "readmissions": 0, "shed": 0,
+            "budget_denied": 0, "corrupt_detected": 0, "swaps": 0,
+            "swap_aligns": 0, "probes": 0, "backoff_ms": 0.0,
+        }
+
+    # -- counters / telemetry ------------------------------------------------
+
+    def _bump(self, name: str, inc: float = 1) -> None:
+        # Telemetry.counter is an unlocked read-modify-write; the router's
+        # lock serializes the prober thread against the act() event loop so
+        # the warehouse counters can't lose increments.
+        with self._lock:
+            self.counters[name] += inc
+            if self.telemetry is not None:
+                self.telemetry.counter(f"router.{name}", inc)
+
+    # -- membership / health -------------------------------------------------
+
+    @property
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+    def replica(self, replica_id: str) -> Replica:
+        with self._lock:
+            return self._state[replica_id].replica
+
+    def healthy_ids(self) -> List[str]:
+        with self._lock:
+            return [r for r in self._order if self._state[r].healthy]
+
+    def is_healthy(self, replica_id: str) -> bool:
+        with self._lock:
+            return self._state[replica_id].healthy
+
+    def mark_result(
+        self, replica_id: str, ok: bool, error: str = ""
+    ) -> None:
+        """Feed one health observation (probe or request outcome) into a
+        replica's consecutive counters; flips eject/re-admit at the
+        thresholds."""
+        with self._lock:
+            st = self._state.get(replica_id)
+            if st is None:
+                return
+            if ok:
+                st.consecutive_ok += 1
+                st.consecutive_fail = 0
+                if (
+                    not st.healthy
+                    and st.consecutive_ok >= self.ok_threshold
+                ):
+                    st.healthy = True
+                    readmitted = True
+                else:
+                    readmitted = False
+                ejected = False
+            else:
+                st.consecutive_fail += 1
+                st.consecutive_ok = 0
+                st.last_error = error
+                if (
+                    st.healthy
+                    and st.consecutive_fail >= self.fail_threshold
+                ):
+                    st.healthy = False
+                    st.ejections += 1
+                    ejected = True
+                else:
+                    ejected = False
+                readmitted = False
+        if ejected:
+            self._bump("ejections")
+        if readmitted:
+            self._bump("readmissions")
+
+    def probe_once(self) -> Dict[str, bool]:
+        """One synchronous ``/readyz`` sweep over every replica; returns
+        {replica_id: probe ok}. Drives eject/re-admit via mark_result —
+        callable directly (tests, deterministic sweeps) or from the
+        background prober."""
+        results: Dict[str, bool] = {}
+        for rid in self.replica_ids:
+            rep = self.replica(rid)
+            ok, error = self._probe(rep)
+            results[rid] = ok
+            self._bump("probes")
+            self.mark_result(rid, ok, error=error)
+        return results
+
+    def _probe(self, rep: Replica) -> Tuple[bool, str]:
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self.probe_timeout_s
+        )
+        try:
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status != 200:
+                return False, f"/readyz answered {resp.status}"
+            try:
+                doc = json.loads(raw) if raw else {}
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                doc = {}
+            with self._lock:
+                fleet_hash = self.fleet_config_hash
+            served = doc.get("config_hash") if isinstance(doc, dict) else None
+            if fleet_hash and served and served != fleet_hash:
+                # A replica that missed a fleet swap (killed/restarted
+                # around it) must NOT be re-admitted on its stale default —
+                # it would serve the old config to its households forever,
+                # a silent half-swapped fleet. Push the swap so it
+                # converges, and stay unready until a later probe verifies.
+                self._push_swap(rep, fleet_hash)
+                self._bump("swap_aligns")
+                return False, (
+                    f"/readyz config_hash {served} != fleet "
+                    f"{fleet_hash} (swap re-pushed)"
+                )
+            return True, ""
+        except (OSError, http.client.HTTPException) as err:
+            return False, f"{type(err).__name__}: {err}"
+        finally:
+            conn.close()
+
+    def _push_swap(self, rep: Replica, config_hash: str) -> None:
+        """Best-effort synchronous ``/admin/swap`` push (probe thread)."""
+        body = json.dumps({"config_hash": config_hash})
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self.probe_timeout_s
+        )
+        try:
+            conn.request(
+                "POST", "/admin/swap", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            conn.getresponse().read()
+        except (OSError, http.client.HTTPException):
+            pass  # the replica stays unready; a later probe retries
+        finally:
+            conn.close()
+
+    def start_probing(self, interval_s: float = 0.5) -> None:
+        """Background prober: ``probe_once`` every ``interval_s``."""
+        if self._prober is not None:
+            raise RuntimeError("prober already running")
+        self._prober_stop.clear()
+
+        def run() -> None:
+            while not self._prober_stop.wait(interval_s):
+                self.probe_once()
+
+        self._prober = threading.Thread(target=run, daemon=True)
+        self._prober.start()
+
+    def stop_probing(self) -> None:
+        self._prober_stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=10.0)
+            self._prober = None
+
+    # -- routing -------------------------------------------------------------
+
+    def route(
+        self, household: Optional[str], exclude: frozenset = frozenset()
+    ) -> str:
+        """The replica id serving this household right now.
+
+        Ring lookup among healthy replicas, honoring a failover pin when
+        its target is still usable. ``exclude`` is per-request state: the
+        replicas that already failed THIS request — skipped unless that
+        would leave nowhere to go. Anonymous requests round-robin over
+        healthy replicas (hashing the constant empty key would pile all
+        anonymous traffic onto one replica)."""
+        with self._lock:
+            healthy = [r for r in self._order if self._state[r].healthy]
+            if not healthy:
+                raise NoHealthyReplicas(
+                    f"all {len(self._order)} replicas unhealthy"
+                )
+            candidates = [r for r in healthy if r not in exclude] or healthy
+            if not household:
+                rid = candidates[self._anon_rr % len(candidates)]
+                self._anon_rr += 1
+                return rid
+            pinned = self._pins.get(household)
+            if pinned is not None and pinned in candidates:
+                return pinned
+            allowed = set(candidates)
+            rid = self._ring.lookup(household, accept=allowed.__contains__)
+            if rid is None:  # unreachable: candidates is non-empty
+                raise NoHealthyReplicas("ring lookup found no candidate")
+            return rid
+
+    def _record_route(self, household: Optional[str], rid: str) -> None:
+        """After a SUCCESS on ``rid``: pin the household iff it is not on
+        its home (pure-ring) replica. Pins are recorded only for failover
+        placements, so the pin map grows with failovers, not with
+        households; a household whose pin target dies re-pins on its next
+        request, and one that lands home again drops its pin."""
+        if not household:
+            return
+        repinned = False
+        with self._lock:
+            home = self._ring.lookup(household)
+            if rid == home:
+                self._pins.pop(household, None)
+            elif self._pins.get(household) != rid:
+                self._pins[household] = rid
+                repinned = True
+        if repinned:
+            self._bump("repins")
+
+    @property
+    def pinned_count(self) -> int:
+        with self._lock:
+            return len(self._pins)
+
+    def pinned_households(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._pins)
+
+    # -- request path --------------------------------------------------------
+
+    async def act(
+        self,
+        household: Optional[str],
+        obs_row,
+        deadline_s: Optional[float] = None,
+    ) -> RouterResult:
+        """Route one act request with retry/failover; never raises for
+        server-side failure — the outcome (including router-side sheds)
+        comes back as a ``RouterResult``."""
+        policy = self.retry
+        t0 = time.monotonic()
+        deadline = t0 + (
+            deadline_s if deadline_s is not None else policy.deadline_s
+        )
+        # host-sync: caller-supplied host observation row, not device data.
+        payload = {"obs": np.asarray(obs_row, dtype=np.float32).tolist()}
+        if household:
+            payload["household"] = household
+        self._bump("requests")
+        self.budget.on_attempt()
+        exclude: set = set()
+        prev_rid: Optional[str] = None
+        tries = 0
+        failovers = 0
+        status, doc, headers = -1, None, {}
+        rid = None
+        while True:
+            try:
+                rid = self.route(household, exclude=frozenset(exclude))
+            except NoHealthyReplicas as err:
+                self._bump("shed")
+                return RouterResult(
+                    status=503, shed=True,
+                    retry_after_s=self.shed_retry_after_s,
+                    error=str(err), retries=tries, failovers=failovers,
+                )
+            if (
+                prev_rid is not None and rid != prev_rid
+                and prev_rid in exclude
+            ):
+                # A failover is leaving a FAULTED replica — a 429 retry
+                # that round-robins (anonymous traffic) or re-routes is
+                # load balancing, not failover, and must not pollute the
+                # failover_count SLO in committed captures.
+                failovers += 1
+                self._bump("failovers")
+            rep = self.replica(rid)
+            timeout = max(0.05, min(
+                self.request_timeout_s, deadline - time.monotonic()
+            ))
+            try:
+                status, doc, headers = await _http_post_json(
+                    rep.host, rep.port, "/v1/act", payload, timeout
+                )
+            except _TRANSPORT_ERRORS as err:
+                status, doc, headers = -1, None, {}
+                transport_error = f"{type(err).__name__}: {err}"
+            else:
+                transport_error = ""
+            tries += 1
+            corrupt = status == 200 and doc is None
+            if corrupt:
+                self._bump("corrupt_detected")
+                status = -1
+            if status == 200:
+                self.mark_result(rid, True)
+                self._record_route(household, rid)
+                return RouterResult(
+                    status=200,
+                    actions=doc.get("actions"),
+                    config_hash=doc.get("config_hash"),
+                    replica_id=rid,
+                    retries=tries - 1,
+                    failovers=failovers,
+                )
+            if status in (400, 404, 405, 413):
+                # The REQUEST is bad, not the replica — retrying the same
+                # payload elsewhere cannot help.
+                return RouterResult(
+                    status=status, replica_id=rid,
+                    error=(doc or {}).get("error"),
+                    retries=tries - 1, failovers=failovers,
+                )
+            if status == -1 or status >= 500 or corrupt:
+                # Replica fault: feed health, fail over away from it for
+                # the remainder of this request.
+                self.mark_result(
+                    rid, False,
+                    error=transport_error or f"status {status}",
+                )
+                exclude.add(rid)
+            # 429 = saturated-but-alive: no health penalty, no exclusion —
+            # backing off and re-trying (possibly the same replica) is the
+            # correct response to admission-control shed.
+            prev_rid = rid
+            now = time.monotonic()
+            if tries >= policy.max_attempts or now >= deadline:
+                break
+            if not self.budget.try_spend():
+                # Budget-governed degradation: a brown-out must not turn
+                # into a retry storm. Shed at the router with Retry-After.
+                self._bump("budget_denied")
+                self._bump("shed")
+                return RouterResult(
+                    status=503, shed=True,
+                    retry_after_s=self.shed_retry_after_s,
+                    error="retry budget exhausted",
+                    replica_id=rid, retries=tries - 1,
+                    failovers=failovers, gave_up=True,
+                )
+            with self._lock:
+                backoff = policy.backoff_s(
+                    tries - 1, self._rng, _retry_after_s(headers)
+                )
+            if now + backoff >= deadline:
+                break
+            self._bump("retries")
+            self._bump("backoff_ms", backoff * 1e3)
+            await asyncio.sleep(backoff)
+        return RouterResult(
+            status=status, replica_id=rid,
+            error=(doc or {}).get("error") if isinstance(doc, dict) else None,
+            retries=tries - 1, failovers=failovers,
+            retry_after_s=_retry_after_s(headers),
+            gave_up=tries > 1,
+        )
+
+    # -- fleet orchestration -------------------------------------------------
+
+    async def _get_json(
+        self, rep: Replica, path: str, timeout_s: float
+    ) -> Tuple[int, Optional[dict]]:
+        """Async GET over a fresh connection (swap verify) — delegates
+        the wire framing to loadgen's one shared HTTP client."""
+        status, doc, _ = await _http_request_json(
+            rep.host, rep.port, "GET", path, None, timeout_s
+        )
+        return status, doc
+
+    async def swap_fleet(
+        self,
+        config_hash: str,
+        timeout_s: float = 10.0,
+        poll_interval_s: float = 0.05,
+    ) -> dict:
+        """Two-phase fleet-wide hot-swap: push ``/admin/swap`` to every
+        healthy replica, verify each ``/readyz`` reports the new
+        ``config_hash``, then flip (clear failover pins, record the fleet
+        hash). Any push/verify failure rolls the pushed replicas back to
+        their previous defaults and raises ``FleetSwapError`` — the fleet
+        is never left half-swapped. Zero requests drop: each per-replica
+        swap is atomic and in-flight requests finish on the bundle that
+        admitted them."""
+        targets = [
+            (rid, self.replica(rid)) for rid in self.healthy_ids()
+        ]
+        if not targets:
+            raise FleetSwapError("no healthy replicas to swap")
+        previous: Dict[str, Optional[str]] = {}
+        for rid, rep in targets:
+            try:
+                _, doc = await self._get_json(rep, "/readyz", timeout_s)
+            except _TRANSPORT_ERRORS as err:
+                raise FleetSwapError(
+                    f"{rid}: unreachable before swap ({err})"
+                ) from None
+            previous[rid] = (doc or {}).get("config_hash")
+        pushed: List[str] = []
+        try:
+            for rid, rep in targets:
+                try:
+                    status, doc, _ = await _http_post_json(
+                        rep.host, rep.port, "/admin/swap",
+                        {"config_hash": config_hash}, timeout_s,
+                    )
+                except _TRANSPORT_ERRORS as err:
+                    raise FleetSwapError(
+                        f"{rid}: swap push failed ({err})"
+                    ) from None
+                if status != 200:
+                    raise FleetSwapError(
+                        f"{rid}: swap push answered {status}: "
+                        f"{(doc or {}).get('error')}"
+                    )
+                pushed.append(rid)
+            for rid, rep in targets:
+                end = time.monotonic() + timeout_s
+                while True:
+                    try:
+                        status, doc = await self._get_json(
+                            rep, "/readyz", timeout_s
+                        )
+                    except _TRANSPORT_ERRORS:
+                        status, doc = -1, None
+                    if (
+                        status == 200
+                        and (doc or {}).get("config_hash") == config_hash
+                    ):
+                        break
+                    if time.monotonic() >= end:
+                        raise FleetSwapError(
+                            f"{rid}: /readyz never confirmed "
+                            f"{config_hash} (last: {doc})"
+                        )
+                    await asyncio.sleep(poll_interval_s)
+        except FleetSwapError:
+            # Roll back best-effort: a half-swapped fleet double-serves
+            # configs indefinitely; a rolled-back fleet is merely stale.
+            for rid in pushed:
+                prev = previous.get(rid)
+                if prev and prev != config_hash:
+                    rep = self.replica(rid)
+                    try:
+                        await _http_post_json(
+                            rep.host, rep.port, "/admin/swap",
+                            {"config_hash": prev}, timeout_s,
+                        )
+                    except _TRANSPORT_ERRORS:
+                        pass
+            raise
+        with self._lock:
+            # Mirror the per-gateway swap semantics fleet-wide: every
+            # household re-routes fresh against the new default.
+            self._pins.clear()
+            self.fleet_config_hash = config_hash
+        self._bump("swaps")
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "fleet_swap", config_hash=config_hash,
+                replicas=[rid for rid, _ in targets],
+            )
+        return {
+            "config_hash": config_hash,
+            "replicas": [rid for rid, _ in targets],
+            "previous": previous,
+        }
+
+    # -- observability -------------------------------------------------------
+
+    def fleet_stats(self, timeout_s: float = 5.0) -> dict:
+        """One aggregated fleet view over per-replica ``GET /stats``.
+
+        Dead replicas appear with an ``error`` instead of a snapshot; the
+        totals sum whatever answered. Emitted as a ``fleet_stats`` event
+        through the router telemetry (-> warehouse) when attached."""
+        per_replica: Dict[str, dict] = {}
+        totals = {
+            "requests": 0, "act_requests": 0, "act_ok": 0, "act_rows": 0,
+            "shed": 0, "http_errors": 0, "swaps": 0, "faults_injected": 0,
+        }
+        engine_totals = {"requests": 0, "batches": 0, "padded_rows": 0}
+        for rid in self.replica_ids:
+            rep = self.replica(rid)
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=timeout_s
+            )
+            try:
+                conn.request("GET", "/stats")
+                resp = conn.getresponse()
+                doc = json.loads(resp.read())
+                per_replica[rid] = doc
+                gw = doc.get("gateway", {})
+                for key in totals:
+                    v = gw.get(key)
+                    if isinstance(v, (int, float)):
+                        totals[key] += v
+                for b in doc.get("bundles", {}).values():
+                    for key in engine_totals:
+                        v = b.get(key)
+                        if isinstance(v, (int, float)):
+                            engine_totals[key] += v
+            except (OSError, ValueError, http.client.HTTPException) as err:
+                per_replica[rid] = {
+                    "error": f"{type(err).__name__}: {err}"
+                }
+            finally:
+                conn.close()
+        with self._lock:
+            health = {
+                rid: {
+                    "healthy": st.healthy,
+                    "consecutive_fail": st.consecutive_fail,
+                    "ejections": st.ejections,
+                    "last_error": st.last_error,
+                }
+                for rid, st in self._state.items()
+            }
+            counters = dict(self.counters)
+            pinned = len(self._pins)
+        snapshot = {
+            "kind": "fleet_stats",
+            "n_replicas": len(per_replica),
+            "n_healthy": sum(1 for h in health.values() if h["healthy"]),
+            "fleet_config_hash": self.fleet_config_hash,
+            "router": counters,
+            "retry_budget": {
+                "tokens": self.budget.tokens,
+                "spent": self.budget.spent,
+                "denied": self.budget.denied,
+            },
+            "pinned_households": pinned,
+            "gateway_totals": totals,
+            "engine_totals": engine_totals,
+            "health": health,
+            "replicas": per_replica,
+        }
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "fleet_stats",
+                n_replicas=snapshot["n_replicas"],
+                n_healthy=snapshot["n_healthy"],
+                pinned_households=pinned,
+                gateway_totals=totals,
+                router=counters,
+            )
+        return snapshot
+
+
+# -- in-process fleet harness --------------------------------------------------
+
+
+class LocalFleet:
+    """N in-process gateway replicas over the same bundle set.
+
+    Each replica owns its engines/queues (``build_registry``) and serves
+    from its own ``GatewayServer`` loop thread on an ephemeral port.
+    ``kill`` severs a replica abruptly (connection resets, no drain) but
+    keeps its registry warm; ``restart`` rebinds the SAME port with a
+    fresh gateway over the warm registry — the fault harness's
+    kill/restart cycle without paying XLA recompiles mid-bench. The
+    per-replica ``FaultInjector`` (when a plan is given) survives
+    restarts, so request-fault determinism spans the kill window.
+    """
+
+    def __init__(
+        self,
+        bundle_dirs: Sequence[str],
+        n_replicas: int = 3,
+        max_batch: int = 64,
+        max_wait_s: float = 0.002,
+        admission=None,
+        results_db: Optional[str] = None,
+        device: str = "auto",
+        warmup: bool = True,
+        fault_plan: Optional[FaultPlan] = None,
+        host: str = "127.0.0.1",
+        run_name: str = "fleet",
+    ):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.bundle_dirs = list(bundle_dirs)
+        self.n_replicas = n_replicas
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.admission = admission
+        self.results_db = results_db
+        self.device = device
+        self.warmup = warmup
+        self.fault_plan = fault_plan
+        self.host = host
+        self.run_name = run_name
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+        self.kills: List[str] = []
+        self.restarts: List[str] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> List[Replica]:
+        from p2pmicrogrid_tpu.serve.gateway import (
+            GatewayServer,
+            ServeGateway,
+            build_registry,
+        )
+
+        try:
+            for i in range(self.n_replicas):
+                rid = f"replica-{i}"
+                injector = (
+                    FaultInjector(self.fault_plan, rid)
+                    if self.fault_plan is not None else None
+                )
+                registry = build_registry(
+                    self.bundle_dirs,
+                    max_batch=self.max_batch,
+                    max_wait_s=self.max_wait_s,
+                    results_db=self.results_db,
+                    device=self.device,
+                    warmup=self.warmup,
+                    run_name=f"{self.run_name}-{rid}",
+                )
+                gateway = ServeGateway(
+                    registry, admission=self.admission, host=self.host,
+                    port=0, own_bundles=False, fault_injector=injector,
+                    replica_id=rid,
+                )
+                server = GatewayServer(gateway)
+                try:
+                    host, port = server.start()
+                except BaseException:
+                    registry.close_all()
+                    raise
+                with self._lock:
+                    self._entries[rid] = {
+                        "registry": registry,
+                        "gateway": gateway,
+                        "server": server,
+                        "injector": injector,
+                        "host": host,
+                        "port": port,
+                        "alive": True,
+                    }
+        except BaseException:
+            self.stop_all()
+            raise
+        return self.replicas
+
+    @property
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return [
+                Replica(replica_id=rid, host=e["host"], port=e["port"])
+                for rid, e in self._entries.items()
+            ]
+
+    def entry(self, replica_id: str) -> dict:
+        with self._lock:
+            return self._entries[replica_id]
+
+    def reference_engine(self):
+        """The default bundle's engine on the first replica — the direct
+        comparator for the fleet bench's bit-exactness check."""
+        with self._lock:
+            first = self._entries[next(iter(self._entries))]
+        registry = first["registry"]
+        return registry.get(registry.default_hash).engine
+
+    def activate_faults(self, t0: Optional[float] = None) -> None:
+        """Anchor every replica injector's fault windows at one instant
+        (the loadgen start), so a plan's windows line up fleet-wide."""
+        t0 = time.monotonic() if t0 is None else t0
+        with self._lock:
+            injectors = [
+                e["injector"] for e in self._entries.values()
+                if e["injector"] is not None
+            ]
+        for injector in injectors:
+            injector.activate(t0)
+
+    # -- chaos hooks ---------------------------------------------------------
+
+    def kill(self, replica_id: str) -> None:
+        """Abrupt replica death: open connections reset, no drain; the
+        registry (engines, queues, telemetry) stays warm for restart."""
+        with self._lock:
+            e = self._entries[replica_id]
+            server, alive = e["server"], e["alive"]
+            e["alive"] = False
+            self.kills.append(replica_id)
+        if alive and server is not None:
+            server.kill()
+
+    def restart(self, replica_id: str) -> None:
+        """Bring a killed replica back on its ORIGINAL port (the router's
+        address book must stay valid) over the warm registry."""
+        from p2pmicrogrid_tpu.serve.gateway import (
+            GatewayServer,
+            ServeGateway,
+        )
+
+        with self._lock:
+            e = self._entries[replica_id]
+            if e["alive"]:
+                raise RuntimeError(f"{replica_id} is already running")
+            gateway = ServeGateway(
+                e["registry"], admission=self.admission, host=e["host"],
+                port=e["port"], own_bundles=False,
+                fault_injector=e["injector"], replica_id=replica_id,
+            )
+            server = GatewayServer(gateway)
+        server.start()
+        with self._lock:
+            e["gateway"] = gateway
+            e["server"] = server
+            e["alive"] = True
+            self.restarts.append(replica_id)
+
+    def stop_all(self) -> None:
+        """Drain-stop every live replica, then close every registry
+        (queues + telemetry). Idempotent."""
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            if e["alive"] and e["server"] is not None:
+                try:
+                    e["server"].stop()
+                except Exception:  # noqa: BLE001 — close every replica
+                    pass
+                e["alive"] = False
+        for e in entries:
+            e["registry"].close_all()
+
+    def __enter__(self) -> "LocalFleet":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop_all()
+
+
+# -- fleet loadgen + bench -----------------------------------------------------
+
+
+@dataclass
+class FleetLoadgenResult:
+    """Per-request outcomes of one open-loop run through the router."""
+
+    latencies_s: np.ndarray      # [N] send -> final outcome (incl. retries)
+    statuses: np.ndarray         # [N] final status (-1 transport, 503 shed)
+    retries: np.ndarray          # [N]
+    failovers: np.ndarray        # [N]
+    router_shed: np.ndarray      # [N] bool: the ROUTER refused this request
+    config_hashes: List
+    replica_ids: List
+    actions: List                # per request: served actions (None if not ok)
+    makespan_s: float
+
+    @property
+    def n_requests(self) -> int:
+        return int(self.statuses.shape[0])
+
+    @property
+    def n_ok(self) -> int:
+        return int((self.statuses == 200).sum())
+
+    @property
+    def n_shed(self) -> int:
+        """Requests refused honestly under back-pressure: replica 429s
+        and ROUTER sheds (RouterResult.shed — no healthy replicas, or
+        retry budget spent). A replica-originated 503 (draining, queue
+        shutdown) is NOT a shed: that request was admitted and then
+        refused, which is exactly the broken promise availability must
+        count against the fleet."""
+        return int(
+            (self.statuses == 429).sum() + self.router_shed.sum()
+        )
+
+    @property
+    def n_failed(self) -> int:
+        return self.n_requests - self.n_ok - self.n_shed
+
+    @property
+    def shed_rate(self) -> float:
+        return self.n_shed / self.n_requests if self.n_requests else 0.0
+
+    @property
+    def availability(self) -> float:
+        """Answered fraction of ADMITTED requests — the chaos SLO: a shed
+        request was refused honestly (and told when to retry); an
+        admitted-but-unanswered one is a broken promise."""
+        admitted = self.n_requests - self.n_shed
+        return self.n_ok / admitted if admitted else 1.0
+
+    @property
+    def total_retries(self) -> int:
+        return int(self.retries.sum())
+
+    @property
+    def retry_rate(self) -> float:
+        return self.total_retries / self.n_requests if self.n_requests else 0.0
+
+    @property
+    def failover_total(self) -> int:
+        return int(self.failovers.sum())
+
+    @property
+    def throughput_rps(self) -> float:
+        return self.n_ok / self.makespan_s if self.makespan_s > 0 else 0.0
+
+    def latency_ms(self, q: float) -> float:
+        ok = self.latencies_s[self.statuses == 200]
+        return float(np.percentile(ok, q) * 1e3) if ok.size else 0.0
+
+
+def run_fleet_loadgen(
+    router: FleetRouter,
+    obs: np.ndarray,
+    arrivals: np.ndarray,
+    households: List[str],
+    deadline_s: Optional[float] = None,
+) -> FleetLoadgenResult:
+    """The open-loop Poisson schedule fired through the ROUTER (retry,
+    failover and shed semantics included) instead of at one gateway."""
+    obs = np.asarray(obs, dtype=np.float32)  # host-sync: host-side inputs
+    arrivals = np.asarray(arrivals, dtype=float)  # host-sync: host schedule
+    n = int(arrivals.shape[0])
+    latencies = np.zeros(n)
+    statuses = np.full(n, -1, dtype=np.int64)
+    retries = np.zeros(n, dtype=np.int64)
+    failovers = np.zeros(n, dtype=np.int64)
+    router_shed = np.zeros(n, dtype=bool)
+    hashes: List = [None] * n
+    replica_ids: List = [None] * n
+    actions: List = [None] * n
+
+    async def one(i: int, t0: float) -> None:
+        delay = (arrivals[i] - arrivals[0]) - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        t_send = time.perf_counter()
+        result = await router.act(
+            households[i % len(households)], obs[i], deadline_s=deadline_s
+        )
+        latencies[i] = time.perf_counter() - t_send
+        statuses[i] = result.status
+        retries[i] = result.retries
+        failovers[i] = result.failovers
+        router_shed[i] = result.shed
+        hashes[i] = result.config_hash
+        replica_ids[i] = result.replica_id
+        actions[i] = result.actions
+
+    async def run() -> float:
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i, t0) for i in range(n)))
+        return time.perf_counter() - t0
+
+    makespan = asyncio.run(run())
+    return FleetLoadgenResult(
+        latencies_s=latencies,
+        statuses=statuses,
+        retries=retries,
+        failovers=failovers,
+        router_shed=router_shed,
+        config_hashes=hashes,
+        replica_ids=replica_ids,
+        actions=actions,
+        makespan_s=makespan,
+    )
+
+
+def serve_bench_fleet(
+    router: FleetRouter,
+    n_agents: int,
+    fleet: Optional[LocalFleet] = None,
+    fault_plan: Optional[FaultPlan] = None,
+    reference_engine=None,
+    rate_hz: float = 256.0,
+    n_requests: int = 1024,
+    n_households: int = 16,
+    seed: int = 0,
+    slo_ms: float = 100.0,
+    deadline_s: Optional[float] = None,
+    probe_interval_s: float = 0.1,
+    emit: Optional[Callable[[dict], None]] = None,
+    extra_headline: Optional[dict] = None,
+) -> List[dict]:
+    """Fleet-level SLO benchmark: the serve-bench open-loop schedule
+    through the router over a live fleet, optionally with a fault plan
+    killing/restarting replicas mid-run (``serve-bench --fleet --chaos``).
+
+    Emits metric rows (headline LAST, ``serve_bench_fleet``) with the
+    chaos SLOs: wire percentiles over served requests, availability over
+    admitted requests, failover/retry counts, and — when a
+    ``reference_engine`` is given — a bit-exactness verdict comparing
+    every served action against a direct ``PolicyEngine.act`` on the same
+    observations.
+    """
+    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    obs = synthetic_obs(n_requests, n_agents, seed=seed)
+    households = [f"house-{i:04d}" for i in range(n_households)]
+    schedule = None
+    if fault_plan is not None and fleet is not None:
+        schedule = FaultSchedule(fault_plan, fleet.kill, fleet.restart)
+        fleet.activate_faults()
+    router.start_probing(probe_interval_s)
+    try:
+        if schedule is not None:
+            schedule.start()
+        result = run_fleet_loadgen(
+            router, obs, arrivals, households, deadline_s=deadline_s
+        )
+        if schedule is not None:
+            # Let a restart scheduled NEAR the run's end still apply (the
+            # fleet should come back whole), but never block teardown on
+            # events planned far past the run — those are cancelled, and
+            # the headline's chaos.applied vs the plan shows the gap.
+            last = max(
+                (e.at_s for e in fault_plan.lifecycle_events()),
+                default=0.0,
+            )
+            grace_s = 10.0
+            schedule.join(timeout_s=min(
+                max(0.0, last - result.makespan_s) + 5.0, grace_s
+            ))
+            schedule.stop()
+    finally:
+        router.stop_probing()
+    # One post-chaos sweep so health/pins reflect the recovered fleet.
+    router.probe_once()
+
+    bit_exact = None
+    mismatches = 0
+    if reference_engine is not None:
+        ok_idx = [
+            i for i in range(result.n_requests)
+            if result.statuses[i] == 200 and result.actions[i] is not None
+        ]
+        if ok_idx:
+            got = np.asarray(  # host-sync: wire responses, host data
+                [result.actions[i] for i in ok_idx], dtype=np.float32
+            )
+            want = reference_engine.act(obs[ok_idx])
+            mismatches = int((got != want).any(axis=-1).sum())
+            bit_exact = mismatches == 0
+
+    stats = router.fleet_stats()
+    p50, p95, p99 = (result.latency_ms(q) for q in (50, 95, 99))
+    rows = [
+        {
+            "metric": f"fleet_latency_ms_p{q}",
+            "value": round(v, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / v, 2) if v > 0 else 0.0,
+        }
+        for q, v in (("50", p50), ("95", p95), ("99", p99))
+    ]
+    rows.append(
+        {
+            "metric": "fleet_availability",
+            "value": round(result.availability, 6),
+            "unit": "fraction",
+            "vs_baseline": round(result.availability, 6),
+        }
+    )
+    rows.append(
+        {
+            "metric": "fleet_throughput_rps",
+            "value": round(result.throughput_rps, 1),
+            "unit": "requests/sec",
+            "vs_baseline": round(result.throughput_rps / rate_hz, 3),
+        }
+    )
+    rows.append(
+        {
+            "metric": "fleet_retry_rate",
+            "value": round(result.retry_rate, 4),
+            "unit": "retries/request",
+            "vs_baseline": round(
+                max(0.0, 1.0 - min(1.0, result.retry_rate)), 4
+            ),
+        }
+    )
+    counters = stats["router"]
+    chaos = {
+        "seed": fault_plan.seed if fault_plan is not None else None,
+        "events": len(fault_plan.events) if fault_plan is not None else 0,
+        "applied": schedule.applied if schedule is not None else [],
+        "errors": schedule.errors if schedule is not None else [],
+        "kills": list(fleet.kills) if fleet is not None else [],
+        "restarts": list(fleet.restarts) if fleet is not None else [],
+    }
+    rows.append(
+        {
+            "metric": "serve_bench_fleet",
+            "value": round(p99, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / p99, 2) if p99 > 0 else 0.0,
+            "p50_ms": round(p50, 3),
+            "p95_ms": round(p95, 3),
+            "p99_ms": round(p99, 3),
+            "throughput_rps": round(result.throughput_rps, 1),
+            "availability": round(result.availability, 6),
+            "failover_count": int(counters["failovers"]),
+            "retry_rate": round(result.retry_rate, 4),
+            "shed_rate": round(result.shed_rate, 4),
+            "n_requests": result.n_requests,
+            "n_ok": result.n_ok,
+            "n_shed": result.n_shed,
+            "n_failed": result.n_failed,
+            "n_replicas": stats["n_replicas"],
+            "n_healthy": stats["n_healthy"],
+            "ejections": int(counters["ejections"]),
+            "readmissions": int(counters["readmissions"]),
+            "repins": int(counters["repins"]),
+            "pinned_households": stats["pinned_households"],
+            "budget_denied": int(counters["budget_denied"]),
+            "backoff_ms_total": round(counters["backoff_ms"], 3),
+            "bit_exact": bit_exact,
+            "bit_exact_mismatches": mismatches,
+            "served_replicas": sorted(
+                {r for r in result.replica_ids if r is not None}
+            ),
+            "served_config_hashes": sorted(
+                {h for h in result.config_hashes if h is not None}
+            ),
+            "chaos": chaos,
+            "n_households": n_households,
+            "offered_rate_rps": rate_hz,
+            "slo_ms": slo_ms,
+            **(extra_headline or {}),
+        }
+    )
+    if emit is not None:
+        for row in rows:
+            emit(row)
+    return rows

@@ -1,0 +1,977 @@
+"""Resilient serve fleet: router, failover, fault harness (ISSUE 6).
+
+Tier-1 acceptance for the fleet tier: consistent-hash routing moves only
+a lost replica's households, a replica kill mid-traffic loses zero
+admitted requests (households re-pin to healthy replicas, responses stay
+bit-identical to direct engine calls), health probes eject and re-admit,
+retry-budget exhaustion degrades to a 503 + Retry-After shed, a
+fleet-wide two-phase swap drops nothing, and the seed-driven fault
+harness replays exactly. Fast and JAX_PLATFORMS=cpu-safe by design.
+"""
+
+import asyncio
+import collections
+import http.client
+import importlib.util
+import json
+import os
+import random
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.serve import (
+    AdmissionConfig,
+    ConsistentHashRing,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    FleetRouter,
+    FleetSwapError,
+    GatewayServer,
+    LocalFleet,
+    RetryBudget,
+    RetryPolicy,
+    build_gateway,
+    export_policy_bundle,
+    kill_restart_plan,
+    run_fleet_loadgen,
+    run_network_loadgen,
+    serve_bench_fleet,
+)
+from p2pmicrogrid_tpu.train import init_policy_state
+
+A = 3  # community size for all fleet tests
+
+# Admission effectively off for serving-semantics tests: shedding has its
+# own dedicated tests with forced budgets, and a loaded CI machine must
+# not trip the default wait budget mid-assertion.
+_OPEN_ADMISSION = AdmissionConfig(
+    max_queue_depth=100_000, wait_budget_ms=100_000.0
+)
+
+
+def _make_bundle(tmp_path, seed, name):
+    cfg = default_config(
+        sim=SimConfig(n_agents=A),
+        train=TrainConfig(implementation="tabular", seed=seed),
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
+    ps = ps._replace(
+        q_table=jax.random.normal(
+            jax.random.PRNGKey(seed + 1), ps.q_table.shape
+        )
+    )
+    return export_policy_bundle(cfg, ps, str(tmp_path / name))
+
+
+def _obs(n, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = np.empty((n, A, 4), dtype=np.float32)
+    obs[..., 0] = rng.uniform(0, 1, (n, A))
+    obs[..., 1:] = rng.uniform(-1, 1, (n, A, 3))
+    return obs
+
+
+def _act(router, household, obs_row, **kw):
+    return asyncio.run(router.act(household, obs_row, **kw))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_artifacts_schema",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_artifacts_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bundles(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fleet-bundles")
+    return _make_bundle(tmp, 0, "b1"), _make_bundle(tmp, 1, "b2")
+
+
+class TestHashRing:
+    def test_deterministic_and_balanced(self):
+        ring = ConsistentHashRing(vnodes=64)
+        for r in ("replica-0", "replica-1", "replica-2"):
+            ring.add(r)
+        keys = [f"house-{i}" for i in range(1500)]
+        routed = {k: ring.lookup(k) for k in keys}
+        # Deterministic: a second ring built the same way agrees exactly.
+        ring2 = ConsistentHashRing(vnodes=64)
+        for r in ("replica-0", "replica-1", "replica-2"):
+            ring2.add(r)
+        assert all(ring2.lookup(k) == routed[k] for k in keys)
+        # Balanced within consistent-hashing tolerance.
+        counts = collections.Counter(routed.values())
+        assert set(counts) == {"replica-0", "replica-1", "replica-2"}
+        assert min(counts.values()) > 1500 / 3 * 0.6
+
+    def test_remove_moves_only_owned_keys(self):
+        """THE consistent-hashing property: losing a replica re-routes
+        only ITS households (to their next-clockwise survivor)."""
+        ring = ConsistentHashRing(vnodes=64)
+        for r in ("replica-0", "replica-1", "replica-2"):
+            ring.add(r)
+        keys = [f"house-{i}" for i in range(1500)]
+        before = {k: ring.lookup(k) for k in keys}
+        ring.remove("replica-1")
+        moved = [k for k in keys if ring.lookup(k) != before[k]]
+        assert moved  # replica-1 owned some keys
+        assert all(before[k] == "replica-1" for k in moved)
+        # Re-adding restores the original assignment exactly.
+        ring.add("replica-1")
+        assert all(ring.lookup(k) == before[k] for k in keys)
+
+    def test_predicate_walks_clockwise(self):
+        ring = ConsistentHashRing(vnodes=8)
+        ring.add("a")
+        ring.add("b")
+        assert ring.lookup("key", accept=lambda r: r == "b") == "b"
+        assert ring.lookup("key", accept=lambda r: False) is None
+        with pytest.raises(ValueError):
+            ring.add("a")
+        with pytest.raises(KeyError):
+            ring.remove("zzz")
+
+
+class TestRetryPrimitives:
+    def test_backoff_capped_jittered_honors_retry_after(self):
+        policy = RetryPolicy(
+            backoff_base_s=0.1, backoff_cap_s=0.5, jitter=0.5
+        )
+        rng = random.Random(0)
+        for attempt in range(8):
+            d = policy.backoff_s(attempt, rng)
+            cap = min(0.5, 0.1 * 2 ** attempt)
+            assert cap * 0.5 <= d <= cap  # jittered within [cap/2, cap]
+        # Retry-After dominates when larger than the computed backoff.
+        assert policy.backoff_s(0, rng, retry_after_s=2.0) == 2.0
+        # ... but is ignored when the policy says not to honor it.
+        no_honor = RetryPolicy(
+            backoff_base_s=0.1, jitter=0.0, honor_retry_after=False
+        )
+        assert no_honor.backoff_s(0, rng, retry_after_s=2.0) == 0.1
+
+    def test_budget_tokens(self):
+        budget = RetryBudget(ratio=0.5, min_tokens=1.0, cap=2.0)
+        assert budget.try_spend()          # the starting balance
+        assert not budget.try_spend()      # drained
+        for _ in range(4):                 # deposits at ratio per attempt
+            budget.on_attempt()
+        assert budget.tokens == 2.0        # capped
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()
+        assert budget.spent == 3 and budget.denied == 2
+
+
+class TestFaultPlan:
+    def test_same_seed_same_faults(self):
+        plan = FaultPlan(
+            seed=7,
+            events=[
+                FaultEvent(kind="error", rate=0.25),
+                FaultEvent(kind="corrupt", rate=0.1),
+            ],
+        )
+        a = FaultInjector(plan, "replica-0")
+        b = FaultInjector(plan, "replica-0")
+        seq_a = [d.kind if d else None for d in (a.decide() for _ in range(300))]
+        seq_b = [d.kind if d else None for d in (b.decide() for _ in range(300))]
+        assert seq_a == seq_b
+        assert "error" in seq_a and "corrupt" in seq_a  # both events fired
+        # A different seed draws a different sequence...
+        c = FaultInjector(
+            FaultPlan(seed=8, events=plan.events), "replica-0"
+        )
+        assert seq_a != [
+            d.kind if d else None for d in (c.decide() for _ in range(300))
+        ]
+        # ... and so does a different replica id under the SAME seed.
+        d_inj = FaultInjector(plan, "replica-1")
+        assert seq_a != [
+            d.kind if d else None
+            for d in (d_inj.decide() for _ in range(300))
+        ]
+
+    def test_replica_and_scope_filters(self):
+        plan = FaultPlan(
+            seed=0,
+            events=[
+                FaultEvent(kind="error", replica="replica-1", rate=1.0),
+                FaultEvent(
+                    kind="stall", scope="health", rate=1.0, stall_s=0.5
+                ),
+            ],
+        )
+        other = FaultInjector(plan, "replica-0")
+        assert other.decide(scope="act") is None  # error targets replica-1
+        assert other.decide(scope="health").kind == "stall"
+        target = FaultInjector(plan, "replica-1")
+        assert target.decide(scope="act").kind == "error"
+
+    def test_json_round_trip_and_validation(self):
+        plan = kill_restart_plan(
+            "replica-2", 0.25, 0.75, seed=3,
+            extra_events=(FaultEvent(kind="drop", rate=0.05),),
+        )
+        back = FaultPlan.from_json(plan.to_json())
+        assert back == plan
+        assert [e.kind for e in back.lifecycle_events()] == [
+            "kill", "restart"
+        ]
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultEvent(kind="meteor")
+        with pytest.raises(ValueError, match="rate"):
+            FaultEvent(kind="error", rate=1.5)
+        with pytest.raises(ValueError, match="name a replica"):
+            FaultEvent(kind="kill")
+        with pytest.raises(ValueError, match="restart_at_s"):
+            kill_restart_plan("r", 1.0, 0.5)
+        with pytest.raises(ValueError, match="fault_plan"):
+            FaultPlan.from_json("{}")
+
+    def test_act_coins_independent_of_health_probes(self):
+        """Health probes fire on their own nondeterministic timer; they
+        must not shift the act-scope fault sequence between otherwise
+        identical runs (per-scope request counters)."""
+        plan = FaultPlan(seed=9, events=[FaultEvent(kind="error", rate=0.4)])
+        clean = FaultInjector(plan, "replica-0")
+        want = [clean.decide("act") is not None for _ in range(120)]
+        noisy = FaultInjector(plan, "replica-0")
+        got = []
+        for i in range(120):
+            if i % 3 == 0:  # interleaved probes, arbitrary cadence
+                noisy.decide("health")
+            got.append(noisy.decide("act") is not None)
+        assert got == want
+
+    def test_request_coins_stable_under_lifecycle_edits(self):
+        """Adding kill/restart events must not shift request-fault coins
+        (the plan index, not the filtered position, feeds the hash)."""
+        base = FaultPlan(seed=5, events=[FaultEvent(kind="error", rate=0.3)])
+        edited = FaultPlan(
+            seed=5,
+            events=[FaultEvent(kind="error", rate=0.3),
+                    FaultEvent(kind="kill", replica="r0", at_s=1.0)],
+        )
+        a = FaultInjector(base, "replica-0")
+        b = FaultInjector(edited, "replica-0")
+        assert [d is not None for d in (a.decide() for _ in range(100))] == [
+            d is not None for d in (b.decide() for _ in range(100))
+        ]
+
+
+class TestGatewayFaultHooks:
+    """Request-level fault injection through a single gateway."""
+
+    def _gateway(self, bundles, plan):
+        injector = FaultInjector(plan, "replica-0")
+        gateway = build_gateway(
+            [bundles[0]], max_batch=4, admission=_OPEN_ADMISSION,
+            fault_injector=injector, replica_id="replica-0",
+        )
+        return gateway, injector
+
+    def _post_act(self, host, port, obs_row, timeout=30):
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request(
+                "POST", "/v1/act",
+                body=json.dumps({"household": "h", "obs": obs_row.tolist()}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                doc = json.loads(raw) if raw else {}
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                doc = None
+            return resp.status, doc, raw
+        finally:
+            conn.close()
+
+    def test_injected_error_and_stats(self, bundles):
+        plan = FaultPlan(seed=0, events=[FaultEvent(kind="error", rate=1.0)])
+        gateway, injector = self._gateway(bundles, plan)
+        with GatewayServer(gateway):
+            status, doc, _ = self._post_act(
+                gateway.host, gateway.port, _obs(1)[0]
+            )
+            assert status == 500 and "injected fault" in doc["error"]
+            assert gateway.stats["faults_injected"] == 1
+            assert injector.injected["error"] == 1
+
+    def test_injected_corruption_is_detectable(self, bundles):
+        plan = FaultPlan(
+            seed=0, events=[FaultEvent(kind="corrupt", rate=1.0)]
+        )
+        gateway, _ = self._gateway(bundles, plan)
+        with GatewayServer(gateway):
+            status, doc, raw = self._post_act(
+                gateway.host, gateway.port, _obs(1)[0]
+            )
+            # Framing intact (full body delivered), payload unparseable.
+            assert status == 200 and doc is None and raw.startswith(b"\xff")
+
+    def test_injected_stall_delays_response(self, bundles):
+        plan = FaultPlan(
+            seed=0,
+            events=[FaultEvent(kind="stall", rate=1.0, stall_s=0.2)],
+        )
+        gateway, _ = self._gateway(bundles, plan)
+        with GatewayServer(gateway):
+            t0 = time.monotonic()
+            status, _, _ = self._post_act(
+                gateway.host, gateway.port, _obs(1)[0]
+            )
+            assert status == 200
+            assert time.monotonic() - t0 >= 0.2
+
+    def test_injected_drop_closes_without_response(self, bundles):
+        plan = FaultPlan(seed=0, events=[FaultEvent(kind="drop", rate=1.0)])
+        gateway, _ = self._gateway(bundles, plan)
+        with GatewayServer(gateway):
+            with pytest.raises((http.client.HTTPException, OSError)):
+                self._post_act(gateway.host, gateway.port, _obs(1)[0])
+
+    def test_health_scope_only_hits_health_endpoints(self, bundles):
+        plan = FaultPlan(
+            seed=0,
+            events=[FaultEvent(kind="error", scope="health", rate=1.0)],
+        )
+        gateway, _ = self._gateway(bundles, plan)
+        with GatewayServer(gateway):
+            conn = http.client.HTTPConnection(
+                gateway.host, gateway.port, timeout=30
+            )
+            try:
+                conn.request("GET", "/readyz")
+                assert conn.getresponse().status == 500
+            finally:
+                conn.close()
+            status, _, _ = self._post_act(
+                gateway.host, gateway.port, _obs(1)[0]
+            )
+            assert status == 200  # act traffic untouched
+
+
+class TestGatewayHardening:
+    def test_readyz_reports_config_hash_and_replica_id(self, bundles):
+        gateway = build_gateway(
+            [bundles[0]], max_batch=4, replica_id="replica-7"
+        )
+        with GatewayServer(gateway):
+            conn = http.client.HTTPConnection(
+                gateway.host, gateway.port, timeout=30
+            )
+            try:
+                conn.request("GET", "/readyz")
+                resp = conn.getresponse()
+                doc = json.loads(resp.read())
+            finally:
+                conn.close()
+            assert resp.status == 200
+            assert doc["config_hash"] == gateway.registry.default_hash
+            assert doc["replica_id"] == "replica-7"
+            # /stats carries the replica identity too.
+            assert gateway.stats_snapshot()["replica_id"] == "replica-7"
+
+    def test_stop_idempotent_repeated_and_concurrent(self, bundles):
+        gateway = build_gateway([bundles[0]], max_batch=4)
+        server = GatewayServer(gateway)
+        server.start()
+        errors = []
+
+        def stopper():
+            try:
+                server.stop()
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+
+        threads = [threading.Thread(target=stopper) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        server.stop()  # repeated call after full teardown is a no-op
+        # The gateway coroutine path is idempotent too.
+        asyncio.run(gateway.stop())
+        asyncio.run(gateway.stop())
+        # Bundles were closed exactly once and stayed closed.
+        for h in gateway.registry.hashes:
+            assert gateway.registry.get(h).queue._closed
+
+
+@pytest.fixture
+def fleet3(bundles):
+    """A running 3-replica fleet over one bundle + a router with fast
+    health thresholds (CI-friendly: ejection after 2 failures, re-admit
+    after 1 success)."""
+    fleet = LocalFleet(
+        [bundles[0]], n_replicas=3, max_batch=4,
+        admission=_OPEN_ADMISSION,
+    )
+    fleet.start()
+    router = FleetRouter(
+        fleet.replicas,
+        retry=RetryPolicy(max_attempts=5, deadline_s=30.0),
+        fail_threshold=2,
+        ok_threshold=1,
+    )
+    try:
+        yield fleet, router
+    finally:
+        fleet.stop_all()
+
+
+class TestFleetFailover:
+    def test_kill_mid_traffic_zero_lost_repinned_bit_exact(self, fleet3):
+        """ISSUE 6 acceptance core: a replica kill loses zero admitted
+        requests, its households re-pin to healthy replicas, and every
+        served response stays bit-identical to a direct engine call."""
+        fleet, router = fleet3
+        engine = fleet.reference_engine()
+        obs = _obs(24, seed=3)
+        homes = [f"house-{i}" for i in range(8)]
+        # Wave 1: map households to their home replicas.
+        first = {}
+        for i, h in enumerate(homes):
+            r = _act(router, h, obs[i])
+            assert r.status == 200
+            first[h] = r.replica_id
+        victim = first[homes[0]]
+        affected = [h for h, rid in first.items() if rid == victim]
+        fleet.kill(victim)
+        # Wave 2 mid-outage: every request still answers 200.
+        results = {}
+        for i, h in enumerate(homes):
+            r = _act(router, h, obs[8 + i])
+            assert r.status == 200, (h, r.status, r.error)
+            results[h] = r
+        # Affected households failed over away from the victim and are
+        # pinned to the replica that actually served them.
+        pins = router.pinned_households()
+        for h in affected:
+            assert results[h].replica_id != victim
+            assert pins.get(h) == results[h].replica_id
+            assert router.is_healthy(results[h].replica_id)
+        # Unaffected households never moved (consistent-hash locality).
+        for h in homes:
+            if h not in affected:
+                assert results[h].replica_id == first[h]
+        assert router.counters["failovers"] >= 1
+        # Bit-exactness across the kill: responses == direct engine.act.
+        got = np.asarray(
+            [results[h].actions for h in homes], dtype=np.float32
+        )
+        want = engine.act(obs[8:8 + len(homes)])
+        np.testing.assert_array_equal(got, want)
+        # Restart: the replica rejoins on its original port and serves.
+        fleet.restart(victim)
+        router.probe_once()
+        assert router.is_healthy(victim)
+        r = _act(router, "brand-new-house", _obs(1, seed=9)[0])
+        assert r.status == 200
+
+    def test_probe_ejects_and_readmits(self, fleet3):
+        fleet, router = fleet3
+        victim = router.replica_ids[1]
+        fleet.kill(victim)
+        assert router.is_healthy(victim)  # not yet observed
+        router.probe_once()
+        assert router.is_healthy(victim)  # 1 of fail_threshold=2
+        router.probe_once()
+        assert not router.is_healthy(victim)  # ejected
+        assert router.counters["ejections"] == 1
+        assert set(router.healthy_ids()) == (
+            set(router.replica_ids) - {victim}
+        )
+        fleet.restart(victim)
+        router.probe_once()  # ok_threshold=1 -> re-admitted
+        assert router.is_healthy(victim)
+        assert router.counters["readmissions"] == 1
+
+    def test_all_replicas_down_sheds_immediately(self, fleet3):
+        fleet, router = fleet3
+        for rid in router.replica_ids:
+            fleet.kill(rid)
+        for _ in range(2):
+            router.probe_once()
+        assert router.healthy_ids() == []
+        t0 = time.monotonic()
+        r = _act(router, "h", _obs(1)[0])
+        assert r.status == 503 and r.shed
+        assert r.retry_after_s == router.shed_retry_after_s
+        # Shed, not queued: the answer is immediate.
+        assert time.monotonic() - t0 < 5.0
+        assert router.counters["shed"] >= 1
+
+    def test_retry_budget_exhaustion_degrades_503(self, bundles):
+        """Every replica 500s; a drained budget must stop the retry storm
+        and shed with Retry-After instead."""
+        plan = FaultPlan(
+            seed=0, events=[FaultEvent(kind="error", rate=1.0)]
+        )
+        fleet = LocalFleet(
+            [bundles[0]], n_replicas=2, max_batch=4,
+            admission=_OPEN_ADMISSION, fault_plan=plan,
+        )
+        fleet.start()
+        router = FleetRouter(
+            fleet.replicas,
+            retry=RetryPolicy(
+                max_attempts=10, deadline_s=30.0,
+                backoff_base_s=0.001, backoff_cap_s=0.002,
+            ),
+            budget=RetryBudget(ratio=0.0, min_tokens=2.0),
+            fail_threshold=100,  # keep replicas routable: isolate budget
+            ok_threshold=1,
+        )
+        try:
+            r = _act(router, "h", _obs(1)[0])
+            assert r.status == 503 and r.shed and r.gave_up
+            assert "retry budget" in r.error
+            assert r.retry_after_s == router.shed_retry_after_s
+            assert router.counters["budget_denied"] == 1
+            # The two budget tokens were the only retries spent.
+            assert router.budget.spent == 2
+        finally:
+            fleet.stop_all()
+
+    def test_retries_recover_from_injected_errors(self, bundles):
+        """Deterministic 50% 500-rate on one replica of two: with retry +
+        failover every request must still answer 200, bit-exact."""
+        plan = FaultPlan(
+            seed=11,
+            events=[
+                FaultEvent(kind="error", replica="replica-0", rate=0.5)
+            ],
+        )
+        fleet = LocalFleet(
+            [bundles[0]], n_replicas=2, max_batch=4,
+            admission=_OPEN_ADMISSION, fault_plan=plan,
+        )
+        fleet.start()
+        router = FleetRouter(
+            fleet.replicas,
+            retry=RetryPolicy(
+                max_attempts=6, deadline_s=30.0,
+                backoff_base_s=0.001, backoff_cap_s=0.01,
+            ),
+            fail_threshold=1000,  # never eject: exercise per-request paths
+        )
+        engine = fleet.reference_engine()
+        obs = _obs(12, seed=4)
+        try:
+            actions = []
+            for i in range(12):
+                r = _act(router, f"house-{i}", obs[i])
+                assert r.status == 200, (i, r.status, r.error)
+                actions.append(r.actions)
+            assert router.counters["retries"] >= 1
+            np.testing.assert_array_equal(
+                np.asarray(actions, dtype=np.float32), engine.act(obs)
+            )
+        finally:
+            fleet.stop_all()
+
+
+class TestRouterAccounting:
+    def test_429_retry_is_not_a_failover(self, bundles):
+        """Anonymous 429 retries round-robin to another replica — that is
+        load balancing over a SATURATED-but-healthy fleet, and must not
+        count into the failover SLO."""
+        plans = AdmissionConfig(
+            wait_budget_ms=5.0, min_wait_samples=8,
+            retry_after_s=0.3, wait_window_s=0.15,
+        )
+        fleet = LocalFleet(
+            [bundles[0]], n_replicas=2, max_batch=4, admission=plans,
+        )
+        fleet.start()
+        now = time.monotonic()
+        for rid in ("replica-0", "replica-1"):
+            q = fleet.entry(rid)["registry"]
+            bundle = q.get(q.default_hash)
+            for _ in range(16):
+                bundle.queue.recent_wait_ms.append((now, 100.0))
+        router = FleetRouter(
+            fleet.replicas,
+            retry=RetryPolicy(max_attempts=5, deadline_s=20.0),
+        )
+        try:
+            r = _act(router, None, _obs(1)[0])  # anonymous: round-robins
+            assert r.status == 200 and r.retries >= 1
+            assert router.counters["retries"] >= 1
+            assert router.counters["failovers"] == 0
+            assert r.failovers == 0
+        finally:
+            fleet.stop_all()
+
+    def test_injector_anchoring_is_harness_owned(self, bundles):
+        """Gateway start must NOT activate the injector: the fleet bench
+        anchors every replica's fault windows at the loadgen start, and a
+        first-wins activate at server start would skew them by warmup."""
+        plan = FaultPlan(
+            seed=0, events=[FaultEvent(kind="error", rate=1.0)]
+        )
+        fleet = LocalFleet(
+            [bundles[0]], n_replicas=2, max_batch=4,
+            admission=_OPEN_ADMISSION, fault_plan=plan,
+        )
+        fleet.start()
+        try:
+            injectors = [
+                fleet.entry(rid)["injector"] for rid in
+                ("replica-0", "replica-1")
+            ]
+            assert all(i._t0 is None for i in injectors)
+            t0 = time.monotonic()
+            fleet.activate_faults(t0)
+            assert all(i._t0 == t0 for i in injectors)
+        finally:
+            fleet.stop_all()
+
+
+class TestFleetSwap:
+    def test_two_phase_swap_zero_drops(self, bundles):
+        """Fleet-wide hot-swap under live traffic: zero failed requests,
+        every replica verified on the new config_hash via /readyz."""
+        fleet = LocalFleet(
+            list(bundles), n_replicas=2, max_batch=4,
+            admission=_OPEN_ADMISSION,
+        )
+        fleet.start()
+        router = FleetRouter(fleet.replicas, retry=RetryPolicy())
+        try:
+            entry = fleet.entry("replica-0")
+            h1 = entry["registry"].default_hash
+            h2 = [h for h in entry["registry"].hashes if h != h1][0]
+            obs = _obs(1)[0]
+            results = []
+
+            def traffic():
+                arrivals = np.arange(40) * 0.005
+                results.append(
+                    run_fleet_loadgen(
+                        router, np.stack([obs] * 40), arrivals,
+                        [f"house-{i}" for i in range(10)],
+                    )
+                )
+
+            t = threading.Thread(target=traffic)
+            t.start()
+            time.sleep(0.05)  # swap lands mid-wave
+            out = asyncio.run(router.swap_fleet(h2))
+            t.join()
+            assert out["config_hash"] == h2
+            assert sorted(out["replicas"]) == sorted(router.replica_ids)
+            result = results[0]
+            # Zero drops through the swap, both configs (and only they)
+            # served.
+            assert result.n_ok == result.n_requests
+            assert set(result.config_hashes) <= {h1, h2}
+            # Every replica reports the new default on /readyz.
+            for rid in router.replica_ids:
+                rep = router.replica(rid)
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=30
+                )
+                try:
+                    conn.request("GET", "/readyz")
+                    doc = json.loads(conn.getresponse().read())
+                finally:
+                    conn.close()
+                assert doc["config_hash"] == h2
+            # Fleet flip recorded; router affinity pins reset.
+            assert router.fleet_config_hash == h2
+            assert router.pinned_count == 0
+            # New traffic serves the new default everywhere.
+            r = _act(router, "post-swap-house", obs)
+            assert r.status == 200 and r.config_hash == h2
+        finally:
+            fleet.stop_all()
+
+    def test_stale_replica_realigned_not_readmitted(self, bundles):
+        """A replica that missed the fleet swap (killed around it) must
+        not be re-admitted serving the OLD default: the probe sees the
+        /readyz config_hash mismatch, re-pushes the swap, and only then
+        re-admits — no silent half-swapped fleet."""
+        fleet = LocalFleet(
+            list(bundles), n_replicas=2, max_batch=4,
+            admission=_OPEN_ADMISSION,
+        )
+        fleet.start()
+        router = FleetRouter(
+            fleet.replicas, fail_threshold=2, ok_threshold=1
+        )
+        try:
+            entry = fleet.entry("replica-0")
+            h1 = entry["registry"].default_hash
+            h2 = [h for h in entry["registry"].hashes if h != h1][0]
+            fleet.kill("replica-1")
+            for _ in range(2):
+                router.probe_once()
+            assert not router.is_healthy("replica-1")
+            asyncio.run(router.swap_fleet(h2))  # swaps replica-0 only
+            fleet.restart("replica-1")  # warm registry: still defaults h1
+            assert fleet.entry("replica-1")["registry"].default_hash == h1
+            # First probe: mismatch detected, swap re-pushed, NOT ready.
+            assert router.probe_once()["replica-1"] is False
+            assert not router.is_healthy("replica-1")
+            assert router.counters["swap_aligns"] == 1
+            assert fleet.entry("replica-1")["registry"].default_hash == h2
+            # Second probe verifies the aligned hash and re-admits.
+            assert router.probe_once()["replica-1"] is True
+            assert router.is_healthy("replica-1")
+        finally:
+            fleet.stop_all()
+
+    def test_swap_unknown_hash_rolls_back(self, bundles):
+        fleet = LocalFleet(
+            list(bundles), n_replicas=2, max_batch=4,
+            admission=_OPEN_ADMISSION,
+        )
+        fleet.start()
+        router = FleetRouter(fleet.replicas)
+        try:
+            entry = fleet.entry("replica-0")
+            h1 = entry["registry"].default_hash
+            with pytest.raises(FleetSwapError, match="push answered 404"):
+                asyncio.run(router.swap_fleet("deadbeef0000"))
+            # Nothing moved: every replica still serves the old default.
+            for rid in router.replica_ids:
+                reg = fleet.entry(rid)["registry"]
+                assert reg.default_hash == h1
+            assert router.fleet_config_hash is None
+        finally:
+            fleet.stop_all()
+
+
+class TestLoadgenRetry:
+    def _shedding_gateway(self, bundles, wait_window_s):
+        """A gateway whose p95-wait budget sheds until the stuffed wait
+        samples age out of the window — deterministic saturation."""
+        gateway = build_gateway(
+            [bundles[0]], max_batch=4,
+            admission=AdmissionConfig(
+                wait_budget_ms=5.0, min_wait_samples=8,
+                retry_after_s=0.3, wait_window_s=wait_window_s,
+            ),
+        )
+        default = gateway.registry.get(gateway.registry.default_hash)
+        now = time.monotonic()
+        for _ in range(16):
+            default.queue.recent_wait_ms.append((now, 100.0))
+        return gateway
+
+    def test_no_retry_preserves_shed_semantics(self, bundles):
+        gateway = self._shedding_gateway(bundles, wait_window_s=0.15)
+        with GatewayServer(gateway):
+            result = run_network_loadgen(
+                gateway.host, gateway.port, _obs(4), np.zeros(4),
+                ["h0", "h1", "h2", "h3"],
+            )
+        assert result.n_shed == 4            # 429 stays terminal
+        assert result.total_retries == 0
+        assert result.retry_rate == 0.0 and result.n_gave_up == 0
+
+    def test_retry_honors_retry_after_and_recovers(self, bundles):
+        """With retry on, the 429 + Retry-After wave outlives the stuffed
+        wait window, so every request succeeds on a later attempt."""
+        gateway = self._shedding_gateway(bundles, wait_window_s=0.15)
+        with GatewayServer(gateway):
+            result = run_network_loadgen(
+                gateway.host, gateway.port, _obs(4), np.zeros(4),
+                ["h0", "h1", "h2", "h3"],
+                retry=RetryPolicy(max_attempts=5, deadline_s=20.0),
+            )
+        assert result.n_ok == 4
+        assert result.total_retries >= 4     # each request retried >= once
+        assert result.retry_rate >= 1.0
+        assert result.n_gave_up == 0
+        # Latency includes the honored Retry-After backoff.
+        assert float(result.latencies_s.min()) >= 0.3
+
+    def test_retry_attempts_capped_by_deadline_under_stall(self, bundles):
+        """A stalled replica must not let one attempt overrun the retry
+        policy's per-request deadline by the full transport timeout."""
+        plan = FaultPlan(
+            seed=0,
+            events=[FaultEvent(kind="stall", rate=1.0, stall_s=5.0)],
+        )
+        gateway = build_gateway(
+            [bundles[0]], max_batch=4, admission=_OPEN_ADMISSION,
+            fault_injector=FaultInjector(plan, "replica-0"),
+        )
+        with GatewayServer(gateway):
+            t0 = time.monotonic()
+            result = run_network_loadgen(
+                gateway.host, gateway.port, _obs(1), np.zeros(1), ["h0"],
+                timeout_s=30.0,
+                retry=RetryPolicy(max_attempts=3, deadline_s=0.5),
+            )
+        # The deadline (0.5 s), not timeout_s (30 s), bounded the attempt.
+        assert time.monotonic() - t0 < 3.0
+        assert result.statuses[0] == -1
+        assert float(result.latencies_s[0]) < 2.0
+
+    def test_retry_gives_up_against_persistent_shed(self, bundles):
+        gateway = self._shedding_gateway(bundles, wait_window_s=1e6)
+        with GatewayServer(gateway):
+            result = run_network_loadgen(
+                gateway.host, gateway.port, _obs(2), np.zeros(2),
+                ["h0", "h1"],
+                retry=RetryPolicy(max_attempts=2, deadline_s=5.0),
+            )
+        assert result.n_shed == 2            # final outcome is still 429
+        assert result.n_gave_up == 2
+        assert result.total_retries == 2
+
+
+class TestFleetBenchAndSchema:
+    def test_chaos_bench_acceptance(self, bundles, tmp_path):
+        """The ISSUE 6 acceptance run: kill/restart fault plan mid-bench;
+        availability >= 99% of admitted requests, every household pinned
+        to a healthy replica afterwards, responses bit-identical to the
+        direct engine, and the capture passes the schema checker."""
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import (
+            SqliteSink,
+            Telemetry,
+            run_manifest,
+        )
+
+        n_requests, rate = 160, 320.0
+        duration = n_requests / rate
+        plan = kill_restart_plan(
+            "replica-1", kill_at_s=0.3 * duration,
+            restart_at_s=0.6 * duration, seed=0,
+        )
+        db = str(tmp_path / "fleet.db")
+        fleet = LocalFleet(
+            [bundles[0]], n_replicas=3, max_batch=4,
+            admission=_OPEN_ADMISSION, fault_plan=plan, results_db=db,
+        )
+        fleet.start()
+        engine = fleet.reference_engine()
+        tel = Telemetry(
+            run_id="fleet-router-test",
+            sinks=[SqliteSink(db)],
+            manifest=run_manifest(
+                extra={
+                    "config_hash": engine.manifest.get("config_hash"),
+                    "serve_role": "router",
+                    "fleet_size": 3,
+                }
+            ),
+        )
+        router = FleetRouter(
+            fleet.replicas,
+            retry=RetryPolicy(max_attempts=6, deadline_s=30.0),
+            fail_threshold=2, ok_threshold=1, telemetry=tel,
+        )
+        try:
+            rows = serve_bench_fleet(
+                router, n_agents=A, fleet=fleet, fault_plan=plan,
+                reference_engine=engine, rate_hz=rate,
+                n_requests=n_requests, n_households=12, seed=0,
+                probe_interval_s=0.05,
+            )
+        finally:
+            fleet.stop_all()
+            tel.close()
+        head = rows[-1]
+        assert head["metric"] == "serve_bench_fleet"
+        # The fault plan actually ran.
+        assert head["chaos"]["kills"] == ["replica-1"]
+        assert head["chaos"]["restarts"] == ["replica-1"]
+        assert head["failover_count"] >= 1
+        # Acceptance SLOs.
+        assert head["availability"] >= 0.99
+        assert head["bit_exact"] is True
+        assert head["n_healthy"] == 3  # the fleet came back whole
+        # Every pinned household points at a healthy replica.
+        for h, rid in router.pinned_households().items():
+            assert router.is_healthy(rid), (h, rid)
+        # The capture passes the committed-artifact schema check.
+        path = tmp_path / "FLEET_r00.jsonl"
+        path.write_text(
+            "".join(json.dumps(r) + "\n" for r in rows)
+        )
+        mod = _load_checker()
+        problems: list = []
+        mod.check_fleet_jsonl(str(path), problems)
+        assert problems == []
+        # Warehouse fleet view: replica bundle runs + the router run are
+        # aggregated under the served config_hash with router counters.
+        with ResultsStore(db) as store:
+            view = store.query_fleet_view()
+        assert len(view) == 1
+        row = view[0]
+        assert row["config_hash"] == engine.manifest.get("config_hash")
+        assert row["n_runs"] == 4           # 3 replica bundles + router
+        assert row["n_router_runs"] == 1
+        assert row["n_serve_traces"] > 0
+        assert row["router_failovers"] >= 1
+
+    def test_fleet_jsonl_schema(self, tmp_path):
+        mod = _load_checker()
+        good = {
+            "metric": "serve_bench_fleet", "value": 1.0, "unit": "ms",
+            "vs_baseline": 1.0, "p50_ms": 0.5, "p95_ms": 0.9,
+            "p99_ms": 1.0, "throughput_rps": 100.0, "availability": 0.999,
+            "failover_count": 3, "retry_rate": 0.01, "shed_rate": 0.0,
+        }
+        path = tmp_path / "FLEET_r01.jsonl"
+        path.write_text(json.dumps(good) + "\n")
+        problems: list = []
+        mod.check_fleet_jsonl(str(path), problems)
+        assert problems == []
+        # A missing SLO key is caught.
+        bad = {k: v for k, v in good.items() if k != "availability"}
+        path.write_text(json.dumps(bad) + "\n")
+        problems = []
+        mod.check_fleet_jsonl(str(path), problems)
+        assert any("availability" in p for p in problems)
+        # An out-of-range availability is caught.
+        path.write_text(json.dumps(dict(good, availability=1.7)) + "\n")
+        problems = []
+        mod.check_fleet_jsonl(str(path), problems)
+        assert any("outside" in p for p in problems)
+        # check_all picks FLEET_*.jsonl up from artifacts/.
+        artifacts = tmp_path / "artifacts"
+        artifacts.mkdir()
+        (artifacts / "FLEET_r02.jsonl").write_text(json.dumps(bad) + "\n")
+        problems = mod.check_all(str(tmp_path))
+        assert any("FLEET_r02" in p for p in problems)
+
+    def test_serve_bench_fleet_cli_one_json_per_line(self, capfd):
+        from p2pmicrogrid_tpu.cli import main
+
+        rc = main([
+            "serve-bench", "--fleet", "--chaos", "--agents", "2",
+            "--implementation", "tabular", "--requests", "36",
+            "--rate", "120", "--max-batch", "4", "--max-wait-ms", "1",
+            "--households", "6", "--replicas", "2",
+            "--max-queue-depth", "100000", "--wait-budget-ms", "100000",
+        ])
+        assert rc == 0
+        out, err = capfd.readouterr()
+        rows = [json.loads(l) for l in out.splitlines() if l.strip()]
+        head = rows[-1]
+        assert head["metric"] == "serve_bench_fleet"
+        assert head["chaos"]["kills"] and head["chaos"]["restarts"]
+        assert head["availability"] >= 0.99
+        assert head["bit_exact"] is True
+        assert "fleet of 2 replicas" in err

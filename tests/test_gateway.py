@@ -203,6 +203,9 @@ class TestGatewayEndToEnd:
         assert status == 200 and doc["ok"] is True
         status, doc, _ = _request(host, port, "GET", "/readyz")
         assert status == 200 and doc["ready"] is True
+        # Readiness carries the ACTIVE default config_hash — the fleet
+        # two-phase swap (serve/router.py) verifies the flip against it.
+        assert doc["config_hash"] == gateway.registry.default_hash
         status, doc, _ = _request(host, port, "GET", "/stats")
         assert status == 200
         assert doc["kind"] == "gateway_stats"

@@ -44,6 +44,12 @@ the contracts executable:
   with a non-empty ``bundles`` object, the ``default`` hash present in it,
   and ``gateway``/``admission`` counter objects.
 
+* Fleet chaos captures (``artifacts/FLEET_*.jsonl``, serve-bench --fleet):
+  metric rows, and any ``serve_bench_fleet`` headline row must carry the
+  resilience SLO contract — numeric ``p50_ms``/``p95_ms``/``p99_ms``/
+  ``throughput_rps``/``availability``/``failover_count``/``retry_rate``/
+  ``shed_rate`` — with ``availability`` in [0, 1].
+
 * Results databases (``*.db``/``*.sqlite`` at the root and under
   ``artifacts/``): when a DB carries telemetry warehouse tables
   (``data/results.py``), its ``PRAGMA user_version`` must match the
@@ -174,6 +180,55 @@ def check_gateway_jsonl(path: str, problems: list) -> None:
                     f"{where}:{i + 1}: serve_bench_network headline "
                     f"missing numeric {key!r}"
                 )
+
+
+# Numeric SLO keys every serve_bench_fleet headline row must carry — the
+# chaos-run contract of serve/router.py:serve_bench_fleet. Availability,
+# failover count and retry rate are the point of a fleet capture: a row
+# without them measured nothing the fleet tier promises.
+FLEET_HEADLINE_KEYS = (
+    "p50_ms", "p95_ms", "p99_ms", "throughput_rps",
+    "availability", "failover_count", "retry_rate", "shed_rate",
+)
+
+
+def check_fleet_jsonl(path: str, problems: list) -> None:
+    """FLEET_*.jsonl: metric rows + the fleet-headline SLO contract."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return  # already reported by check_metric_jsonl
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # already reported
+        if not isinstance(row, dict):
+            continue
+        if row.get("metric") != "serve_bench_fleet":
+            continue
+        for key in FLEET_HEADLINE_KEYS:
+            v = row.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(
+                    f"{where}:{i + 1}: serve_bench_fleet headline "
+                    f"missing numeric {key!r}"
+                )
+        availability = row.get("availability")
+        if (
+            isinstance(availability, (int, float))
+            and not isinstance(availability, bool)
+            and not 0.0 <= availability <= 1.0
+        ):
+            problems.append(
+                f"{where}:{i + 1}: availability {availability} outside "
+                "[0, 1]"
+            )
 
 
 def check_gateway_stats(path: str, problems: list) -> None:
@@ -448,6 +503,10 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
             check_metric_jsonl(path, problems)
     for path in sorted(gateway_jsonl):
         check_gateway_jsonl(path, problems)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "FLEET_*.jsonl"))
+    ):
+        check_fleet_jsonl(path, problems)
     for path in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "GATEWAY_STATS_*.json"))
     ):

@@ -53,6 +53,11 @@ HOT_PATH_FILES = (
     # them, not one request (the worst place in the repo for this class).
     os.path.join("p2pmicrogrid_tpu", "serve", "gateway.py"),
     os.path.join("p2pmicrogrid_tpu", "serve", "registry.py"),
+    # The fleet tier sits in front of EVERY replica's event loop: a
+    # blocking readback in the router's act path or the fault injector
+    # stalls the whole fleet's traffic, not one process.
+    os.path.join("p2pmicrogrid_tpu", "serve", "router.py"),
+    os.path.join("p2pmicrogrid_tpu", "serve", "faults.py"),
     os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
 )
 
