@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -117,6 +118,13 @@ def _build_cfg(args) -> "ExperimentConfig":
             implementation=args.implementation,
             seed=args.seed,
             episodes_per_jit_block=getattr(args, "jit_block", 1),
+            # Checkpoint cadence: the preemption exposure window (a crash
+            # loses at most save_episodes episodes of work).
+            **(
+                {"save_episodes": args.save_episodes}
+                if getattr(args, "save_episodes", None) is not None
+                else {}
+            ),
         ),
     )
 
@@ -201,7 +209,188 @@ def _explicit_device_ctx(args):
     return contextlib.nullcontext()
 
 
+def _strip_cli_flags(argv, flags=(), value_flags=()):
+    """Remove ``--flag`` / ``--flag VALUE`` / ``--flag=VALUE`` entries from a
+    raw argv (the supervisor rebuilds child command lines from its own)."""
+    out = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        name = a.split("=", 1)[0]
+        if name in flags:
+            i += 1
+            continue
+        if name in value_flags:
+            i += 2 if "=" not in a and i + 1 < len(argv) else 1
+            continue
+        out.append(a)
+        i += 1
+    return out
+
+
+def _build_fault_injector(args):
+    """The deterministic training fault injector (train/faults.py) from
+    ``--fault-plan``/``--fault-seed``, scoped to this supervisor attempt
+    (``P2P_TRAIN_ATTEMPT``). ``None`` when no faults were requested."""
+    plan = None
+    if getattr(args, "fault_plan", None):
+        from p2pmicrogrid_tpu.train.faults import TrainFaultPlan
+
+        with open(args.fault_plan) as f:
+            plan = TrainFaultPlan.from_json(f.read())
+    elif getattr(args, "fault_seed", None) is not None:
+        from p2pmicrogrid_tpu.train.faults import kill_plan
+
+        plan = kill_plan(
+            args.fault_seed, args.episodes,
+            n_kills=getattr(args, "fault_kills", 1),
+        )
+    if plan is None:
+        return None
+    from p2pmicrogrid_tpu.train.faults import TrainFaultInjector
+    from p2pmicrogrid_tpu.train.resilience import ATTEMPT_ENV
+
+    return TrainFaultInjector(
+        plan, attempt=int(os.environ.get(ATTEMPT_ENV, "0"))
+    )
+
+
+def _emit_resilience_row(args, row: dict) -> None:
+    """One resilience metric row: stdout (the supervisor's scan channel)
+    plus the ``--resilience-out`` JSONL capture when set."""
+    line = json.dumps(row)
+    print(line, flush=True)
+    out = getattr(args, "resilience_out", None)
+    if out:
+        d = os.path.dirname(os.path.abspath(out))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out, "a") as f:
+            f.write(line + "\n")
+
+
+def _train_setting(args, cfg) -> str:
+    """The experiment-identity string the TRAIN command will checkpoint
+    under (plain vs scenario-batched naming)."""
+    if getattr(args, "scenarios", 1) > 1:
+        return _scenario_setting(
+            cfg, getattr(args, "shared", False), getattr(args, "chunks", 1)
+        )
+    return cfg.setting
+
+
+def _verify_uninterrupted(args, child_args) -> bool:
+    """Run the SAME training uninterrupted (no faults, fresh model dir) and
+    compare final-checkpoint content digests — the bit-exactness verdict of
+    a supervised chaos run (exact resume makes them identical)."""
+    import subprocess
+
+    from p2pmicrogrid_tpu.train.checkpoint import (
+        checkpoint_dir,
+        latest_checkpoint,
+        load_manifest,
+    )
+
+    base_model_dir = os.path.abspath(args.model_dir) + "_uninterrupted"
+    base_args = _strip_cli_flags(
+        child_args,
+        flags=("--resume",),
+        value_flags=(
+            "--fault-plan", "--fault-seed", "--fault-kills",
+            "--max-rollbacks", "--lr-drop", "--model-dir",
+        ),
+    ) + ["--model-dir", base_model_dir]
+    rc = subprocess.run(
+        [sys.executable, "-m", "p2pmicrogrid_tpu"] + base_args
+    ).returncode
+    if rc != 0:
+        print(f"uninterrupted verification run failed (rc {rc})",
+              file=sys.stderr)
+        return False
+    cfg = _build_cfg(args)
+    setting = _train_setting(args, cfg)
+    impl = cfg.train.implementation
+    steps = [
+        latest_checkpoint(checkpoint_dir(d, setting, impl))
+        for d in (args.model_dir, base_model_dir)
+    ]
+    if None in steps:
+        return False
+    manifests = [load_manifest(s) for s in steps]
+    if any(m is None for m in manifests):
+        return False
+    return (
+        manifests[0]["episode"] == manifests[1]["episode"]
+        and manifests[0]["digest"] == manifests[1]["digest"]
+    )
+
+
+def _cmd_train_supervise(args) -> int:
+    """``train --supervise``: crash-supervise a training child, emitting the
+    RESILIENCE capture (attempt rows + ``train_supervised`` headline)."""
+    from p2pmicrogrid_tpu.train.resilience import supervise
+
+    raw = list(getattr(args, "_argv", None) or sys.argv[1:])
+    child_args = _strip_cli_flags(
+        raw,
+        flags=("--supervise", "--verify-uninterrupted"),
+        value_flags=("--resilience-out", "--max-restarts"),
+    )
+    child_argv = [sys.executable, "-m", "p2pmicrogrid_tpu"] + child_args
+    result = supervise(
+        child_argv,
+        max_restarts=getattr(args, "max_restarts", 8),
+        emit=lambda row: _emit_resilience_row(args, row),
+    )
+    if result.succeeded:
+        final_episode = args.episodes - 1
+    else:
+        # The run never completed: report the newest VERIFIED checkpoint's
+        # episode (how far training provably got), or -1 with none on disk.
+        final_episode = -1
+        try:
+            from p2pmicrogrid_tpu.train.checkpoint import (
+                checkpoint_dir,
+                latest_checkpoint,
+                load_manifest,
+            )
+
+            cfg = _build_cfg(args)
+            step = latest_checkpoint(checkpoint_dir(
+                args.model_dir, _train_setting(args, cfg),
+                cfg.train.implementation,
+            ))
+            manifest = load_manifest(step) if step else None
+            if manifest is not None:
+                final_episode = int(manifest["episode"])
+        except Exception:  # noqa: BLE001 — headline must emit regardless
+            pass
+    headline = {
+        "metric": "train_supervised",
+        "value": len(result.attempts),
+        "unit": "attempts",
+        "vs_baseline": 0.0,
+        "kills": result.kills,
+        "resumes": result.resumes,
+        "rollbacks": result.rollbacks,
+        "final_episode": final_episode,
+        "exit_code": result.exit_code,
+    }
+    ok = result.succeeded
+    if ok and getattr(args, "verify_uninterrupted", False):
+        bit_exact = _verify_uninterrupted(args, child_args)
+        headline["bit_exact"] = bool(bit_exact)
+        ok = ok and bit_exact
+    _emit_resilience_row(args, headline)
+    return 0 if ok else 1
+
+
 def cmd_train(args) -> int:
+    if getattr(args, "supervise", False):
+        # Crash supervisor: relaunch the training child on crash with capped
+        # backoff (train/resilience.py) — before any heavy setup, this
+        # process only spawns children.
+        return _cmd_train_supervise(args)
     if getattr(args, "share_agents", False):
         # DDPGConfig.share_across_agents only reaches the shared-scenario
         # trainer's ddpg_params_init; in any other mode the flag would be
@@ -247,7 +436,6 @@ def cmd_train(args) -> int:
     )
     from p2pmicrogrid_tpu.train.checkpoint import (
         checkpoint_dir,
-        restore_checkpoint,
         save_checkpoint,
     )
 
@@ -262,28 +450,52 @@ def cmd_train(args) -> int:
     store = ResultsStore(args.results_db) if args.results_db else None
     ckpt_dir = checkpoint_dir(args.model_dir, cfg.setting, cfg.train.implementation)
 
+    from p2pmicrogrid_tpu.train.resilience import (
+        checkpoint_callback,
+        prepare_resume,
+    )
+
+    warmup = True
     if args.resume:
         # Resume semantics of the reference's load_agents=True +
         # starting_episodes (community.py:254-256, setup.py:29): restore the
         # learner and continue the episode/decay schedule where it stopped.
-        pol_state, episode = restore_checkpoint(ckpt_dir, pol_state)
-        cfg = cfg.replace(
-            train=dataclasses.replace(cfg.train, starting_episodes=episode + 1)
-        )
-        print(f"resumed {ckpt_dir} at episode {episode}")
-        if cfg.train.starting_episodes >= cfg.train.max_episodes:
-            print("nothing to do: checkpoint is at or past --episodes")
-            return 0
-        # Advance the key chain past the already-trained episodes so the
-        # resumed run does not replay the original run's random stream.
-        key = jax.random.fold_in(key, cfg.train.starting_episodes)
+        # A checkpoint that carries its RNG-key chain resumes EXACTLY — the
+        # surviving episodes replay bit-identically to an uninterrupted run
+        # (train/resilience.py); a legacy checkpoint falls back to the
+        # fold_in continuation. Integrity (digest) verification happens in
+        # the restore itself, corrupt steps falling back to the newest
+        # verified one — the "nothing to do" path below therefore only
+        # reports success over a VERIFIED final checkpoint.
+        plan = prepare_resume(cfg, ckpt_dir, pol_state, key)
+        if not plan.resumed:
+            print(f"resume: no restorable checkpoint under {ckpt_dir}; "
+                  "starting fresh")
+        else:
+            pol_state, cfg, key, warmup = (
+                plan.pol_state, plan.cfg, plan.key, plan.warmup
+            )
+            mode = "exact RNG state" if plan.exact else "legacy (re-keyed)"
+            print(f"resumed {ckpt_dir} at episode {plan.episode} "
+                  f"(integrity verified, {mode})")
+            if cfg.train.starting_episodes >= cfg.train.max_episodes:
+                print("nothing to do: checkpoint is at or past --episodes "
+                      "(final checkpoint integrity verified)")
+                return 0
+
+    fault_injector = _build_fault_injector(args)
 
     def progress(ep, r, e):
         if store:
             store.log_training_progress(cfg.setting, cfg.train.implementation, ep, r, e)
 
-    def checkpoint(ep, ps):
-        save_checkpoint(ckpt_dir, ps, ep)
+    # Resumable checkpoints: the 3-arg callback receives the post-block
+    # RNG-key chain from the loop and persists it with the state + config
+    # hash, then runs the fault injector's post-save hooks.
+    checkpoint = checkpoint_callback(
+        ckpt_dir, cfg, injector=fault_injector,
+        keep_last=getattr(args, "keep_checkpoints", 2),
+    )
 
     # Crossover-driven placement (train/placement.py): single-scenario
     # tabular on a TPU host measured up to 33x slower than the same program
@@ -310,18 +522,72 @@ def cmd_train(args) -> int:
     tel = Telemetry.maybe_create("train", cfg=cfg, extra_sinks=extra_sinks)
     if tel is not None:
         print(f"telemetry run: {tel.run_dir}")
+    rollback_records = []
     try:
         with _profile_ctx(args), device_ctx:
-            result = train_community(
-                cfg, policy, pol_state, train_traces, ratings, key,
-                progress_cb=progress, checkpoint_cb=checkpoint, verbose=True,
-                telemetry=tel, pipeline=pipeline,
-            )
+            if getattr(args, "max_rollbacks", 0) > 0:
+                # Divergence rollback (train/resilience.py): watch the
+                # in-program nonfinite counters, restore the last verified
+                # checkpoint on trip, retrain under a deterministic
+                # perturbation (LR drop + fresh fold_in branch).
+                from p2pmicrogrid_tpu.train.resilience import (
+                    GuardPolicy,
+                    train_community_with_rollback,
+                )
+
+                def on_rollback(rec):
+                    row = {
+                        "metric": "train_rollback",
+                        "value": rec.index,
+                        "unit": "rollback",
+                        "vs_baseline": 0.0,
+                        "tripped_episode": rec.tripped_episode,
+                        "restored_episode": rec.restored_episode,
+                        "lr_scale": rec.lr_scale,
+                        "reason": rec.reason,
+                    }
+                    _emit_resilience_row(args, row)
+
+                result, rollback_records = train_community_with_rollback(
+                    cfg, pol_state, train_traces, ratings, key, ckpt_dir,
+                    guard_policy=GuardPolicy(
+                        max_rollbacks=args.max_rollbacks,
+                        lr_drop=getattr(args, "lr_drop", 0.5),
+                    ),
+                    telemetry=tel, fault_injector=fault_injector,
+                    on_rollback=on_rollback, warmup=warmup,
+                    keep_last=getattr(args, "keep_checkpoints", 2),
+                    progress_cb=progress, verbose=True, pipeline=pipeline,
+                )
+            else:
+                result = train_community(
+                    cfg, policy, pol_state, train_traces, ratings, key,
+                    progress_cb=progress, checkpoint_cb=checkpoint,
+                    verbose=True, telemetry=tel, pipeline=pipeline,
+                    warmup=warmup,
+                    fault_hook=(
+                        fault_injector.on_block_start
+                        if fault_injector is not None else None
+                    ),
+                )
     finally:
         # Close even on a crashed run: the partial record is the evidence.
         if tel is not None:
             tel.close()
-    save_checkpoint(ckpt_dir, result.pol_state, cfg.train.max_episodes - 1)
+    if rollback_records:
+        _emit_resilience_row(args, {
+            "metric": "train_rollback_total",
+            "value": len(rollback_records),
+            "unit": "rollbacks",
+            "vs_baseline": 0.0,
+            "converged": True,
+            "final_episode": cfg.train.max_episodes - 1,
+        })
+    save_checkpoint(
+        ckpt_dir, result.pol_state, cfg.train.max_episodes - 1,
+        rng_key=result.rng_key, cfg=cfg,
+        keep_last=getattr(args, "keep_checkpoints", 2),
+    )
     if args.timing_json:
         _save_times(args.timing_json, cfg.setting, train_time=result.train_seconds)
     n_run = cfg.train.max_episodes - cfg.train.starting_episodes
@@ -342,11 +608,19 @@ def _scenario_setting(cfg, shared: bool, chunks: int = 1) -> str:
     return f"{setting}-k{chunks}" if chunks > 1 else setting
 
 
-def _windowed_episode_cb(cfg, setting, store, ckpt_dir, carry_is_tuple):
+def _windowed_episode_cb(cfg, setting, store, ckpt_dir, carry_is_tuple,
+                         extra_fn=None, injector=None, keep_last=2):
     """Per-episode callback shared by the scenario and multi-community
     trainers: min_episodes_criterion-window averages into training_progress
     (same semantics as train_community's records, so analyse treats all
-    settings alike) plus periodic checkpointing on the save_episodes cadence."""
+    settings alike) plus periodic checkpointing on the save_episodes cadence.
+
+    ``extra_fn()`` (JSON dict — e.g. the HealthMonitor record) rides into
+    each step's integrity manifest for exact resume; ``injector`` (a
+    ``train.faults.TrainFaultInjector``) gets the crash-harness hooks:
+    kill/poison-free episode boundary + post-save corruption + callback
+    stalls (scenario paths support the kill/corrupt/stall kinds — carry
+    poisoning needs the single-community loop's fault_hook)."""
     import collections
     import statistics
 
@@ -356,6 +630,8 @@ def _windowed_episode_cb(cfg, setting, store, ckpt_dir, carry_is_tuple):
     window_l = collections.deque(maxlen=cfg.train.min_episodes_criterion)
 
     def episode_cb(ep, r, l, carry):
+        if injector is not None:
+            injector.on_block_start(ep)
         window_r.append(float(np.mean(r)))
         window_l.append(float(np.mean(l)))
         if ep % cfg.train.min_episodes_criterion == 0:
@@ -367,7 +643,14 @@ def _windowed_episode_cb(cfg, setting, store, ckpt_dir, carry_is_tuple):
             print(f"episode {ep}: avg reward {avg_r:.3f}, avg error {avg_l:.3f}")
         if (ep + 1) % cfg.train.save_episodes == 0:
             ps = carry[0] if carry_is_tuple else carry
-            save_checkpoint(ckpt_dir, ps, ep)
+            step = save_checkpoint(
+                ckpt_dir, ps, ep, cfg=cfg,
+                extra=extra_fn() if extra_fn else None,
+                keep_last=keep_last,
+            )
+            if injector is not None:
+                injector.on_checkpoint_saved(ep, step)
+                injector.on_callback(ep)
 
     return episode_cb
 
@@ -391,7 +674,6 @@ def _cmd_train_scenarios(args) -> int:
     from p2pmicrogrid_tpu.train import init_policy_state, make_policy
     from p2pmicrogrid_tpu.train.checkpoint import (
         checkpoint_dir,
-        restore_checkpoint,
         save_checkpoint,
     )
 
@@ -469,28 +751,50 @@ def _cmd_train_scenarios(args) -> int:
     store = ResultsStore(args.results_db) if args.results_db else None
     ckpt_dir = checkpoint_dir(args.model_dir, setting, cfg.train.implementation)
     episode0 = 0
+    resumed_health = None
     if args.resume:
         # Learnable state only: per-scenario replay/OU is transient warm-up
         # state and is rebuilt fresh (the reference's DQN does the same via
-        # init_buffers after load, community.py:265-267).
-        pol_state, episode = restore_checkpoint(ckpt_dir, pol_state)
-        episode0 = episode + 1
-        print(f"resumed {ckpt_dir} at episode {episode}")
-        if episode0 >= cfg.train.max_episodes:
-            print("nothing to do: checkpoint is at or past --episodes")
-            return 0
-        # Advance the key chain past the trained episodes so the resumed run
-        # does not replay the original run's random stream. Chunked mode
-        # already keys every chunk by the ABSOLUTE episode index
-        # (train_scenarios_chunked's chunk_key_fn), so folding here would
-        # make resumed runs draw different scenarios than straight-through
-        # runs at the same episode.
-        if chunks <= 1:
-            key = jax.random.fold_in(key, episode0)
+        # init_buffers after load, community.py:265-267). The restore
+        # digest-verifies each step and falls back past corrupt ones; the
+        # manifest's extra record carries the HealthMonitor basin state.
+        from p2pmicrogrid_tpu.train.checkpoint import restore_resume_state
 
+        try:
+            st = restore_resume_state(ckpt_dir, pol_state)
+        except FileNotFoundError:
+            st = None
+            print(f"resume: no restorable checkpoint under {ckpt_dir}; "
+                  "starting fresh")
+        if st is not None:
+            pol_state, episode = st.pol_state, st.episode
+            resumed_health = (st.extra or {}).get("health")
+            episode0 = episode + 1
+            print(f"resumed {ckpt_dir} at episode {episode} "
+                  "(integrity verified)")
+            if episode0 >= cfg.train.max_episodes:
+                print("nothing to do: checkpoint is at or past --episodes "
+                      "(final checkpoint integrity verified)")
+                return 0
+            # Advance the key chain past the trained episodes so the
+            # resumed run does not replay the original run's random stream.
+            # Chunked mode already keys every chunk by the ABSOLUTE episode
+            # index (train_scenarios_chunked's chunk_key_fn) — resuming with
+            # the same base key IS the exact schedule there, so folding
+            # would make resumed runs draw different scenarios than
+            # straight-through runs at the same episode.
+            if chunks <= 1:
+                key = jax.random.fold_in(key, episode0)
+
+    fault_injector = _build_fault_injector(args)
     episode_cb = _windowed_episode_cb(
         cfg, setting, store, ckpt_dir,
         carry_is_tuple=args.shared and chunks <= 1,
+        extra_fn=lambda: (
+            {"health": monitor.to_dict()} if monitor is not None else {}
+        ),
+        injector=fault_injector,
+        keep_last=getattr(args, "keep_checkpoints", 2),
     )
     n_episodes = cfg.train.max_episodes - episode0
     agg = f", {chunks} chunks = {S * chunks} aggregate" if chunks > 1 else ""
@@ -511,7 +815,15 @@ def _cmd_train_scenarios(args) -> int:
     if health_every > 0:
         from p2pmicrogrid_tpu.train.health import HealthMonitor
 
-        monitor = HealthMonitor(cfg.sim.slots_per_day)
+        if resumed_health:
+            # Exact resume of the basin bookkeeping + untrained-cost
+            # calibration (saved into the checkpoint manifest's extra).
+            monitor = HealthMonitor.from_dict(resumed_health)
+            if monitor.in_basin:
+                print("resumed INSIDE the don't-heat basin (entry episodes "
+                      f"{monitor.basin_entries}); mitigation state restored")
+        else:
+            monitor = HealthMonitor(cfg.sim.slots_per_day)
 
         def health_cb(point):
             print(
@@ -601,7 +913,11 @@ def _cmd_train_scenarios(args) -> int:
             f"{monitor.basin_entries}, exits at {monitor.basin_exits or '—'} "
             f"(see training_health table / README basin notes)"
         )
-    save_checkpoint(ckpt_dir, pol_state, cfg.train.max_episodes - 1)
+    save_checkpoint(
+        ckpt_dir, pol_state, cfg.train.max_episodes - 1, cfg=cfg,
+        extra={"health": monitor.to_dict()} if monitor is not None else None,
+        keep_last=getattr(args, "keep_checkpoints", 2),
+    )
     if args.timing_json:
         _save_times(args.timing_json, setting, train_time=seconds)
     steps = n_episodes * cfg.sim.slots_per_day * S * max(chunks, 1)
@@ -1784,11 +2100,12 @@ def cmd_telemetry_query(args) -> int:
         return [dict(zip(cols, r)) for r in cur.fetchall()]
 
     if getattr(args, "watch", False):
-        if getattr(args, "fleet", False):
+        if getattr(args, "fleet", False) or getattr(args, "rollbacks", False):
             # Silently tailing the EVAL join when the user asked for the
-            # fleet view would stream unrelated rows; refuse loudly.
+            # fleet/rollback view would stream unrelated rows; refuse loudly.
+            which = "--fleet" if getattr(args, "fleet", False) else "--rollbacks"
             print(
-                "--fleet and --watch cannot combine (the watch tails the "
+                f"{which} and --watch cannot combine (the watch tails the "
                 "eval join); drop one",
                 file=sys.stderr,
             )
@@ -1805,6 +2122,10 @@ def cmd_telemetry_query(args) -> int:
             from p2pmicrogrid_tpu.data.results import FLEET_VIEW_SQL
 
             rows = select(FLEET_VIEW_SQL)
+        elif getattr(args, "rollbacks", False):
+            from p2pmicrogrid_tpu.data.results import ROLLBACK_VIEW_SQL
+
+            rows = select(ROLLBACK_VIEW_SQL)
         else:
             rows = select(TELEMETRY_JOIN_SQL)
             if args.gauges:
@@ -1994,6 +2315,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--battery", action="store_true")
     p.add_argument("--implementation", choices=["tabular", "dqn", "ddpg"], default="tabular")
     p.add_argument("--episodes", type=int, default=1000)
+    p.add_argument("--save-episodes", type=int, default=None,
+                   dest="save_episodes",
+                   help="checkpoint cadence in episodes (default 50, the "
+                        "reference's setup.py:32); the crash-exposure "
+                        "window — a preemption loses at most this many "
+                        "episodes of work (README 'Resilient training')")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--db", help="reference SQLite measurement DB (default: synthetic)")
     p.add_argument("--results-db", help="SQLite results store path")
@@ -2099,6 +2426,54 @@ def main(argv=None) -> int:
                         "final policy state, no per-episode host round trip "
                         "(README 'Training pipeline'); --no-pipeline is the "
                         "synchronous escape hatch")
+    p.add_argument("--supervise", action="store_true",
+                   help="crash supervisor: run training as a child process "
+                        "and relaunch it on crash with capped backoff, "
+                        "appending --resume so it continues from the newest "
+                        "verified checkpoint (README 'Resilient training')")
+    p.add_argument("--max-restarts", type=_nonneg_int, default=8,
+                   dest="max_restarts",
+                   help="--supervise: give up after this many relaunches "
+                        "(default 8)")
+    p.add_argument("--resilience-out", dest="resilience_out",
+                   help="append resilience metric rows (supervise attempts, "
+                        "rollbacks, the train_supervised headline) to this "
+                        "JSONL capture (schema-checked as "
+                        "artifacts/RESILIENCE_*.jsonl)")
+    p.add_argument("--verify-uninterrupted", action="store_true",
+                   dest="verify_uninterrupted",
+                   help="--supervise: after the supervised run completes, "
+                        "run the SAME training uninterrupted into "
+                        "<model-dir>_uninterrupted and report bit_exact = "
+                        "(final checkpoint digests match) in the headline")
+    p.add_argument("--fault-plan", dest="fault_plan",
+                   help="JSON train-fault plan (train/faults.py): "
+                        "kill-at-episode, corrupt-checkpoint, stall-callback, "
+                        "poison-NaN — all deterministic, attempt-scoped")
+    p.add_argument("--fault-seed", type=int, dest="fault_seed",
+                   help="generate a deterministic kill plan from this seed "
+                        "(SIGKILL at a seed-derived episode, once per "
+                        "supervisor attempt; see --fault-kills)")
+    p.add_argument("--fault-kills", type=_nonneg_int, default=1,
+                   dest="fault_kills",
+                   help="--fault-seed: number of kills in the generated "
+                        "plan (the k-th fires on supervisor attempt k; "
+                        "default 1)")
+    p.add_argument("--max-rollbacks", type=_nonneg_int, default=0,
+                   dest="max_rollbacks",
+                   help="divergence rollback budget: watch the in-program "
+                        "nonfinite q/loss counters and, on trip, restore "
+                        "the last verified checkpoint with the effective "
+                        "lrs dropped and a fresh RNG branch, up to this "
+                        "many times (0 = off; train/resilience.py)")
+    p.add_argument("--lr-drop", type=float, default=0.5, dest="lr_drop",
+                   help="rollback perturbation: effective lrs x this "
+                        "factor per rollback (default 0.5)")
+    p.add_argument("--keep-checkpoints", type=int, default=2,
+                   dest="keep_checkpoints",
+                   help="checkpoint steps to keep on disk (default 2: the "
+                        "newest plus one verified fallback for corrupt-step "
+                        "recovery)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser(
@@ -2395,6 +2770,11 @@ def main(argv=None) -> int:
                         "runs (replica bundles + fleet routers) grouped "
                         "by config_hash with serve-trace totals and the "
                         "router's failover/retry/ejection/shed counters")
+    p.add_argument("--rollbacks", action="store_true",
+                   help="rollback view instead of the eval join: training "
+                        "runs grouped by config_hash with their "
+                        "train.rollback/train.divergence counter sums and "
+                        "per-rollback event details (train/resilience.py)")
     p.add_argument("--watch", action="store_true",
                    help="tail mode: poll the warehouse join and stream "
                         "new/updated rows as JSON lines until interrupted "
@@ -2430,6 +2810,9 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_analyse)
 
     args = parser.parse_args(argv)
+    # The raw argv backs `train --supervise`'s child-command reconstruction
+    # (tests pass argv explicitly; interactive use falls back to sys.argv).
+    args._argv = list(sys.argv[1:]) if argv is None else list(argv)
     return args.fn(args)
 
 

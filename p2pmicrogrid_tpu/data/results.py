@@ -330,6 +330,37 @@ GROUP BY t.config_hash
 ORDER BY t.config_hash
 """
 
+# The training-resilience view (train/resilience.py): every config_hash
+# whose runs recorded divergence trips or rollbacks, with the
+# ``train.rollback``/``train.divergence`` counter sums and the last
+# rollback event's detail — the warehouse answer to "did this config ever
+# self-heal, and from what". One LEFT JOIN pass with conditional
+# aggregation (same shape as FLEET_VIEW_SQL).
+ROLLBACK_VIEW_SQL = """
+SELECT t.config_hash,
+       COUNT(DISTINCT t.run_id) AS n_runs,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'train.rollback' THEN p.value END), 0)
+           AS rollbacks,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'train.divergence' THEN p.value END), 0)
+           AS divergence_trips,
+       COUNT(CASE WHEN p.kind = 'rollback' THEN 1 END)
+           AS rollback_events,
+       MAX(CASE WHEN p.kind = 'rollback'
+           THEN json_extract(p.attrs_json, '$.episode') END)
+           AS last_rollback_episode,
+       MAX(CASE WHEN p.kind = 'rollback'
+           THEN json_extract(p.attrs_json, '$.restored_episode') END)
+           AS last_restored_episode
+FROM telemetry_runs t
+LEFT JOIN telemetry_points p ON p.run_id = t.run_id
+WHERE t.config_hash IS NOT NULL
+GROUP BY t.config_hash
+HAVING rollbacks > 0 OR divergence_trips > 0 OR rollback_events > 0
+ORDER BY t.config_hash
+"""
+
 
 # The default telemetry-query join (cli.py `telemetry-query`): one row per
 # (telemetry run, eval run) pair sharing a config_hash, with the run's gauge
@@ -664,6 +695,14 @@ class ResultsStore:
         (``FLEET_VIEW_SQL``): replica/router run counts, serve-trace
         totals and the router's resilience counters, as dicts."""
         cur = self.con.execute(FLEET_VIEW_SQL)
+        cols = [d[0] for d in cur.description]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
+
+    def query_rollback_view(self) -> list:
+        """Training runs aggregated into one resilience view per
+        config_hash (``ROLLBACK_VIEW_SQL``): rollback/divergence counter
+        sums and the last rollback's episode detail, as dicts."""
+        cur = self.con.execute(ROLLBACK_VIEW_SQL)
         cols = [d[0] for d in cur.description]
         return [dict(zip(cols, row)) for row in cur.fetchall()]
 

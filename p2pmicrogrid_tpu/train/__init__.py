@@ -22,14 +22,18 @@ from p2pmicrogrid_tpu.train.checkpoint import (
     checkpoint_dir,
     save_checkpoint,
     restore_checkpoint,
+    restore_resume_state,
     latest_checkpoint,
+    verify_checkpoint,
 )
 
 __all__ = [
     "checkpoint_dir",
     "save_checkpoint",
     "restore_checkpoint",
+    "restore_resume_state",
     "latest_checkpoint",
+    "verify_checkpoint",
     "make_tabular_policy",
     "make_dqn_policy",
     "make_ddpg_policy",

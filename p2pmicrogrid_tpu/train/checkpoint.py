@@ -1,4 +1,4 @@
-"""Checkpoint/resume via Orbax.
+"""Crash-safe checkpoint/resume via Orbax + an integrity manifest.
 
 The reference persists each agent's actor separately — tabular Q as ``.npy``
 (rl.py:83-87), DQN as Keras weight files plus ``_target`` copies
@@ -7,15 +7,52 @@ The reference persists each agent's actor separately — tabular Q as ``.npy``
 (community.py:290-298). Here the unit of persistence is the whole community
 learner state (one PyTree: all agents' params/targets/optimizers/replay plus
 the episode counter), which restores atomically — no per-agent file skew.
+
+Durability contract (the training half of serve/faults.py's resilience
+story; see README "Resilient training"):
+
+* **Atomic saves.** ``save_checkpoint`` writes the Orbax tree to a temp
+  directory, reads it BACK from disk and verifies a content digest against
+  the in-memory state, writes a ``p2p_manifest.json`` (tree structure,
+  shapes/dtypes, sha256 content digest, ``config_hash``, git_rev, RNG key,
+  episode), fsyncs, and only then renames the temp dir to ``ep_<episode>``
+  and prunes older steps. A SIGKILL at ANY instant leaves either the old
+  verified steps or old + new — never zero usable checkpoints (the
+  pre-rewrite code pruned before any verification, so a crash mid-save
+  stranded the run).
+
+* **Verified restores.** ``latest_checkpoint``/``restore_checkpoint``/
+  ``restore_raw`` skip incomplete or digest-mismatched steps (and malformed
+  ``ep_*`` names) with a warning and fall back to the newest step that
+  verifies. Manifest-less steps written by older framework versions are
+  accepted with a warning (no digest to check).
+
+* **Exact resume.** The payload optionally carries the host RNG-key chain
+  (``rng_key``) and JSON-serializable ``extra`` state (HealthMonitor basin
+  record, ...). ``restore_resume_state`` returns everything, so a resumed
+  ``train_community`` run replays the surviving episodes bit-identically to
+  an uninterrupted one (train/resilience.py; tests/test_resilience.py).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Optional, Tuple
+import shutil
+import warnings
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
+
+MANIFEST_NAME = "p2p_manifest.json"
+MANIFEST_FORMAT_VERSION = 1
+_TMP_PREFIX = "_tmp_ep_"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A step failed integrity verification (digest/manifest/readability)."""
 
 
 def _checkpointer():
@@ -32,60 +69,381 @@ def checkpoint_dir(base_dir: str, setting: str, implementation: str) -> str:
     )
 
 
+# --- content digest ----------------------------------------------------------
+
+
+def _plain(tree):
+    """Normalize a payload tree to nested ``{str: ... | np.ndarray}`` form.
+
+    Orbax restores NamedTuples as field-keyed dicts, tuples as lists, and
+    EMPTY containers (e.g. optax's ``EmptyState``) as ``None``; the digest
+    must not depend on which side of those round trips a tree is on, so
+    both the in-memory payload and the read-back are normalized through
+    this before hashing (``None`` and empty containers both become ``{}``).
+    """
+    if tree is None:
+        return {}
+    fields = getattr(tree, "_fields", None)
+    if fields is not None:
+        return {f: _plain(getattr(tree, f)) for f in fields}
+    if isinstance(tree, dict):
+        return {str(k): _plain(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return {str(i): _plain(v) for i, v in enumerate(tree)}
+    return np.asarray(tree)
+
+
+def _walk_leaves(plain, path=""):
+    if isinstance(plain, dict):
+        for k in sorted(plain):
+            yield from _walk_leaves(plain[k], f"{path}/{k}" if path else k)
+    else:
+        yield path, plain
+
+
+def tree_digest(payload) -> Tuple[str, dict]:
+    """sha256 content digest + shape/dtype spec of a payload tree.
+
+    Leaves are hashed in sorted-path order as (path, dtype, shape, bytes) —
+    bit-exact: two payloads digest equal iff every leaf is bit-identical.
+    Returns ``("sha256:<hex>", {path: {"shape": [...], "dtype": str}})``.
+    """
+    h = hashlib.sha256()
+    spec: dict = {}
+    for path, leaf in _walk_leaves(_plain(payload)):
+        arr = np.ascontiguousarray(leaf)
+        spec[path] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+        h.update(path.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return f"sha256:{h.hexdigest()}", spec
+
+
+# --- fsync helpers (best-effort on filesystems without dir fsync) ------------
+
+
+def _fsync_file(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    _fsync_file(path)
+
+
+def _fsync_tree(root: str) -> None:
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for f in filenames:
+            _fsync_file(os.path.join(dirpath, f))
+        _fsync_dir(dirpath)
+
+
+# --- manifest ----------------------------------------------------------------
+
+
+def load_manifest(step_path: str) -> Optional[dict]:
+    """The step's integrity manifest, or ``None`` for a legacy (pre-manifest)
+    step. Raises ``CheckpointCorrupt`` on an unreadable/alien manifest."""
+    mpath = os.path.join(step_path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError) as err:
+        raise CheckpointCorrupt(f"{step_path}: unreadable manifest ({err})")
+    if not isinstance(m, dict) or m.get("kind") != "checkpoint_manifest":
+        raise CheckpointCorrupt(f"{step_path}: {MANIFEST_NAME} is not a checkpoint manifest")
+    return m
+
+
+def _verify_step(step_path: str) -> Tuple[Optional[dict], Optional[dict]]:
+    """``(manifest, raw_payload)`` after full verification of one step.
+
+    Reads the payload back from disk, recomputes the content digest and
+    compares it to the manifest's; the verified raw tree is returned so
+    restore paths reuse it instead of paying a second disk read + Orbax
+    deserialization (replay buffers dominate the step size). Legacy
+    manifest-less steps return ``(None, None)`` — nothing to check, payload
+    unread. Raises ``CheckpointCorrupt`` on mismatch or unreadable payload.
+    """
+    manifest = load_manifest(step_path)
+    if manifest is None:
+        return None, None
+    try:
+        raw = _checkpointer().restore(step_path)
+    except Exception as err:  # orbax raises various types on partial trees
+        raise CheckpointCorrupt(f"{step_path}: payload unreadable ({err})")
+    # The manifest itself is not part of the Orbax tree; orbax restores only
+    # what it saved, so no exclusion needed.
+    digest, _ = tree_digest(raw)
+    expected = manifest.get("digest")
+    if digest != expected:
+        raise CheckpointCorrupt(
+            f"{step_path}: content digest mismatch (manifest {expected}, "
+            f"disk {digest}) — corrupted or partially-written step"
+        )
+    if int(manifest.get("episode", -1)) != int(np.asarray(raw.get("episode", -2))):
+        raise CheckpointCorrupt(
+            f"{step_path}: manifest episode {manifest.get('episode')} != "
+            f"payload episode {raw.get('episode')}"
+        )
+    return manifest, raw
+
+
+def verify_checkpoint(step_path: str) -> Optional[dict]:
+    """Full integrity verification of one step directory; returns the
+    manifest (``None`` for a legacy manifest-less step). Raises
+    ``CheckpointCorrupt`` on mismatch or unreadable payload."""
+    manifest, _raw = _verify_step(step_path)
+    return manifest
+
+
+# --- step listing ------------------------------------------------------------
+
+
+def _steps_newest_first(path: str):
+    """``(episode, step_path)`` for every well-formed ``ep_*`` dir, newest
+    first. Malformed names (``ep_banana``) are skipped with a warning instead
+    of crashing the listing (stray dirs must not take resume down)."""
+    if not os.path.isdir(path):
+        return []
+    steps = []
+    for d in os.listdir(path):
+        if not d.startswith("ep_"):
+            continue
+        try:
+            ep = int(d.split("_", 1)[1])
+        except (IndexError, ValueError):
+            warnings.warn(
+                f"skipping malformed checkpoint entry {d!r} under {path} "
+                "(not an ep_<int> step directory)",
+                stacklevel=3,
+            )
+            continue
+        steps.append((ep, os.path.join(path, d)))
+    steps.sort(key=lambda t: t[0], reverse=True)
+    return steps
+
+
+def _verified_steps(path: str):
+    """Yield ``(episode, step_path, manifest | None, raw | None)`` newest
+    first, full-verifying each step and warning-and-skipping the corrupt
+    ones. ``raw`` is the already-deserialized payload of a verified
+    manifest-bearing step, for restore paths to reuse."""
+    for ep, step in _steps_newest_first(path):
+        try:
+            manifest, raw = _verify_step(step)
+        except CheckpointCorrupt as err:
+            warnings.warn(
+                f"skipping corrupt checkpoint step: {err} — falling back to "
+                "the next newest step",
+                stacklevel=3,
+            )
+            continue
+        yield ep, step, manifest, raw
+
+
+def latest_checkpoint(path: str, verify: bool = True) -> Optional[str]:
+    """Newest restorable step under ``path``, or ``None``.
+
+    ``verify`` (default) runs the full digest check and falls back past
+    corrupt/incomplete steps; ``verify=False`` is the cheap listing (name
+    order only — callers that re-verify at restore time).
+    """
+    if verify:
+        for _ep, step, _m, _raw in _verified_steps(path):
+            return step
+        return None
+    steps = _steps_newest_first(path)
+    return steps[0][1] if steps else None
+
+
+# --- save --------------------------------------------------------------------
+
+
 def save_checkpoint(
-    path: str, pol_state, episode: int, keep_old: bool = False
+    path: str,
+    pol_state,
+    episode: int,
+    keep_old: bool = False,
+    rng_key=None,
+    extra: Optional[dict] = None,
+    cfg=None,
+    keep_last: int = 2,
 ) -> str:
-    """Write the learner state + episode counter. Returns the step path."""
+    """Atomically write the learner state + episode counter; returns the
+    step path.
+
+    Write-to-temp → read-back digest verification → manifest → fsync →
+    atomic rename → prune. The previous steps are ONLY pruned after the new
+    step has passed read-back verification and been renamed into place, so a
+    crash at any instant leaves at least one restorable checkpoint.
+
+    ``rng_key`` (the host key chain at this episode boundary) and ``extra``
+    (JSON-serializable resume state, e.g. the HealthMonitor record) make the
+    step exactly resumable (``restore_resume_state``). ``cfg`` stamps
+    ``config_hash`` into the manifest so checkpoints join the telemetry
+    warehouse. ``keep_last`` newest steps survive the prune (default 2: the
+    newest step plus one fallback for corrupt-step recovery); ``keep_old``
+    keeps everything. Steps with a HIGHER episode than this save (stale
+    leftovers of a previous, longer run) are always pruned — they must not
+    shadow the new save.
+    """
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
     ckptr = _checkpointer()
-    step_path = os.path.join(os.path.abspath(path), f"ep_{episode}")
+    step_name = f"ep_{episode}"
+    step_path = os.path.join(path, step_name)
+    tmp_path = os.path.join(path, f"{_TMP_PREFIX}{episode}_{os.getpid()}")
+
+    # Stale temp dirs from previously-crashed saves: never restorable (no
+    # ep_ prefix), reclaim the disk here. Only OUR pid's leftovers plus
+    # clearly-abandoned ones (an hour stale) — the pid suffix exists so a
+    # concurrent saver's in-flight temp is never yanked out from under its
+    # read-back verification.
+    import time as _time
+
+    for d in os.listdir(path):
+        if not d.startswith(_TMP_PREFIX):
+            continue
+        p = os.path.join(path, d)
+        stale = False
+        if d.endswith(f"_{os.getpid()}"):
+            stale = True
+        else:
+            try:
+                stale = _time.time() - os.path.getmtime(p) > 3600.0
+            except OSError:
+                pass
+        if stale:
+            shutil.rmtree(p, ignore_errors=True)
+
     payload = {
         "pol_state": jax.tree_util.tree_map(np.asarray, pol_state),
         "episode": episode,
     }
-    ckptr.save(step_path, payload, force=True)
-    if not keep_old:
-        # Prune everything EXCEPT the step just written (not the max-numbered
-        # one: a stale higher-episode dir from a previous run must not survive
-        # and shadow this save).
-        import shutil
+    if rng_key is not None:
+        payload["rng_key"] = np.asarray(rng_key)
+    digest, spec = tree_digest(payload)
 
-        keep = os.path.basename(step_path)
-        for d in os.listdir(path):
-            if d.startswith("ep_") and d != keep:
-                shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+    ckptr.save(tmp_path, payload, force=True)
+    _verify_readback(tmp_path, digest)
+
+    manifest = {
+        "kind": "checkpoint_manifest",
+        "format_version": MANIFEST_FORMAT_VERSION,
+        "episode": int(episode),
+        "payload_keys": sorted(payload),
+        "rng_key": (
+            None if rng_key is None else np.asarray(rng_key).tolist()
+        ),
+        "digest": digest,
+        "tree": spec,
+        "config_hash": None,
+        "git_rev": None,
+        "extra": extra or {},
+    }
+    if cfg is not None:
+        from p2pmicrogrid_tpu.telemetry.registry import config_hash, git_rev
+
+        manifest["config_hash"] = config_hash(cfg)
+        manifest["git_rev"] = git_rev()
+    mpath = os.path.join(tmp_path, MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        try:
+            os.fsync(f.fileno())
+        except OSError:
+            pass
+    _fsync_tree(tmp_path)
+
+    if os.path.exists(step_path):
+        # Re-saving the same episode: the verified temp replaces it. Not
+        # atomic against a concurrent reader of the SAME episode, but older
+        # steps remain as fallback and the rename below is still atomic.
+        shutil.rmtree(step_path, ignore_errors=True)
+    os.rename(tmp_path, step_path)
+    _fsync_dir(path)
+
+    # Prune AFTER the new step is verified and in place (the pre-rewrite
+    # hazard: prune-then-crash stranded the run with zero checkpoints).
+    survivors = {step_name}
+    kept_older = 0
+    for ep, step in _steps_newest_first(path):
+        base = os.path.basename(step)
+        if base in survivors:
+            continue
+        if ep > episode:
+            # A stale higher-episode dir from a previous run must not
+            # survive and shadow this save.
+            shutil.rmtree(step, ignore_errors=True)
+            continue
+        if keep_old or kept_older < max(keep_last, 1) - 1:
+            kept_older += 1
+            continue
+        shutil.rmtree(step, ignore_errors=True)
     return step_path
 
 
-def latest_checkpoint(path: str) -> Optional[str]:
-    if not os.path.isdir(path):
-        return None
-    steps = [d for d in os.listdir(path) if d.startswith("ep_")]
-    if not steps:
-        return None
-    return os.path.join(path, max(steps, key=lambda d: int(d.split("_")[1])))
+def _verify_readback(tmp_path: str, expected_digest: str) -> None:
+    """Read the just-written step back from disk and compare digests (the
+    write barrier the prune waits on). Split out so tests can simulate a
+    failing write path."""
+    try:
+        raw = _checkpointer().restore(tmp_path)
+    except Exception as err:
+        shutil.rmtree(tmp_path, ignore_errors=True)
+        raise CheckpointCorrupt(
+            f"checkpoint write verification failed: {tmp_path} unreadable "
+            f"after save ({err}); previous checkpoints left untouched"
+        )
+    got, _ = tree_digest(raw)
+    if got != expected_digest:
+        shutil.rmtree(tmp_path, ignore_errors=True)
+        raise CheckpointCorrupt(
+            f"checkpoint write verification failed: read-back digest {got} "
+            f"!= in-memory {expected_digest}; previous checkpoints left "
+            "untouched"
+        )
+
+
+# --- restore -----------------------------------------------------------------
 
 
 def restore_raw(path: str) -> Tuple[dict, int, str]:
-    """Structure-free read of the newest checkpoint step under ``path``.
+    """Structure-free read of the newest VERIFIED checkpoint step under
+    ``path``.
 
     The serving-export hook (serve/export.py): a bundle export needs ONLY
     the greedy parameter subtree, so it reads the checkpoint without a
     learner-state template — no optimizer/replay/target reconstruction, and
     the raw field-keyed dicts orbax returns are exactly what
-    ``serve.export.greedy_params`` consumes. Returns
+    ``serve.export.greedy_params`` consumes. Corrupt steps are skipped with
+    a warning (falls back to the next newest verified one). Returns
     ``(raw_pol_state, episode, step_path)``.
     """
-    step_path = latest_checkpoint(path)
-    if step_path is None:
-        raise FileNotFoundError(f"no checkpoint under {path}")
-    raw = _checkpointer().restore(step_path)
-    if not isinstance(raw, dict) or "pol_state" not in raw:
-        raise RuntimeError(
-            f"checkpoint {step_path} has no 'pol_state' tree (root keys: "
-            f"{sorted(raw) if isinstance(raw, dict) else type(raw).__name__}); "
-            "not a checkpoint of this framework"
-        )
-    return raw["pol_state"], int(raw.get("episode", 0)), step_path
+    for _ep, step_path, _manifest, raw in _verified_steps(path):
+        if raw is None:  # legacy manifest-less step: verification read nothing
+            raw = _checkpointer().restore(step_path)
+        if not isinstance(raw, dict) or "pol_state" not in raw:
+            raise RuntimeError(
+                f"checkpoint {step_path} has no 'pol_state' tree (root keys: "
+                f"{sorted(raw) if isinstance(raw, dict) else type(raw).__name__}); "
+                "not a checkpoint of this framework"
+            )
+        return raw["pol_state"], int(raw.get("episode", 0)), step_path
+    raise FileNotFoundError(f"no restorable checkpoint under {path}")
 
 
 def _graft_old_checkpoint(template, raw):
@@ -104,6 +462,10 @@ def _graft_old_checkpoint(template, raw):
 
     def walk(tpl, node, path):
         if node is None:
+            if not jax.tree_util.tree_leaves(tpl):
+                # An empty container (e.g. optax EmptyState) round-trips
+                # through orbax as None: nothing is missing, don't flag it.
+                return tpl
             grafted.append(path or "<root>")
             return tpl
         fields = getattr(tpl, "_fields", None)
@@ -159,23 +521,58 @@ def _graft_old_checkpoint(template, raw):
     return walk(template, raw, ""), grafted, extra
 
 
-def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
-    """Restore (pol_state, episode) from the newest step under ``path``.
+def _restore_step(
+    step_path: str, template_pol_state, manifest: Optional[dict], raw=None
+):
+    """Restore one (already-verified) step against the learner-state
+    template. Returns the full restored payload dict with ``pol_state``
+    rebuilt into the template's PyTree structure.
 
-    ``template_pol_state`` provides the PyTree structure/dtypes (e.g. a fresh
-    ``init_policy_state`` result). Checkpoints written by an older framework
-    version whose state is a strict subset of the current one (fields added
-    since, e.g. DDPG ``noise_scale`` in 0.2.0) restore with the missing
-    leaves grafted at their template (init) values, with a warning.
+    ``raw`` is the payload tree the digest verification already
+    deserialized: when present, the graft walker maps it onto the template
+    (field order, dtype preservation, subset grafting) with NO second disk
+    read; legacy manifest-less steps (``raw=None``) keep the Orbax
+    item-template restore.
     """
-    step_path = latest_checkpoint(path)
-    if step_path is None:
-        raise FileNotFoundError(f"no checkpoint under {path}")
     ckptr = _checkpointer()
     template = {
         "pol_state": jax.tree_util.tree_map(np.asarray, template_pol_state),
         "episode": 0,
     }
+    if raw is not None:
+        if not isinstance(raw, dict) or "pol_state" not in raw:
+            raise RuntimeError(
+                f"checkpoint {step_path} has no 'pol_state' tree (root keys: "
+                f"{sorted(raw) if isinstance(raw, dict) else type(raw).__name__}); "
+                "not a checkpoint of this framework"
+            )
+        pol_state, grafted, extra = _graft_old_checkpoint(
+            template["pol_state"], raw["pol_state"]
+        )
+        if extra:
+            raise RuntimeError(
+                f"checkpoint {step_path} does not match the current learner "
+                f"state structure and is not an older-version subset "
+                f"(unknown fields: {extra[:5]}); delete it and retrain, or "
+                "restore with the matching version"
+            )
+        if grafted:
+            warnings.warn(
+                f"checkpoint {step_path} is an older-version state "
+                f"({grafted}); missing fields restored at their init "
+                "defaults, narrowed dtypes cast to the template dtype",
+                stacklevel=2,
+            )
+        restored = dict(raw)
+        restored["pol_state"] = pol_state
+        restored.setdefault("episode", 0)
+        return _rebuild_payload(restored, template_pol_state)
+    payload_keys = (manifest or {}).get("payload_keys") or ["episode", "pol_state"]
+    if "rng_key" in payload_keys:
+        rk = (manifest or {}).get("rng_key")
+        template["rng_key"] = (
+            np.zeros(np.shape(rk), np.uint32) if rk is not None else np.zeros(2, np.uint32)
+        )
     try:
         restored = ckptr.restore(step_path, item=template)
     except Exception as e:  # orbax raises various types on tree mismatch
@@ -184,7 +581,7 @@ def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
         except Exception:
             # Corrupted/partial checkpoint: not even readable without a
             # template — keep the actionable message.
-            raise RuntimeError(
+            raise CheckpointCorrupt(
                 f"checkpoint {step_path} cannot be read (corrupted or "
                 f"partial save?); delete it and retrain. Original error: {e}"
             ) from e
@@ -206,17 +603,101 @@ def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
                 f"(unknown fields: {extra[:5]}); delete it and retrain, or "
                 f"restore with the matching version. Original error: {e}"
             ) from e
-        import warnings
-
         warnings.warn(
             f"checkpoint {step_path} is an older-version state ({grafted}); "
             f"missing fields restored at their init defaults, narrowed "
             f"dtypes cast to the template dtype",
             stacklevel=2,
         )
-        restored = {"pol_state": pol_state, "episode": raw.get("episode", 0)}
-    # Rebuild the original NamedTuple/PyTree structure with restored leaves.
+        restored = dict(raw)
+        restored["pol_state"] = pol_state
+        restored["episode"] = raw.get("episode", 0)
+    return _rebuild_payload(restored, template_pol_state)
+
+
+def _rebuild_payload(restored: dict, template_pol_state) -> dict:
+    """Rebuild the original NamedTuple/PyTree structure with restored
+    leaves (the graft walker / item restore already put them in template
+    field order)."""
     _, treedef = jax.tree_util.tree_flatten(template_pol_state)
     restored_leaves = jax.tree_util.tree_leaves(restored["pol_state"])
-    pol_state = jax.tree_util.tree_unflatten(treedef, restored_leaves)
-    return pol_state, int(restored["episode"])
+    restored["pol_state"] = jax.tree_util.tree_unflatten(treedef, restored_leaves)
+    return restored
+
+
+def _iter_restorable(path: str):
+    """``(episode, step_path, manifest, raw)`` newest-first over verified
+    steps, warning when a manifest-less legacy step is accepted
+    unverified."""
+    any_step = False
+    for ep, step, manifest, raw in _verified_steps(path):
+        any_step = True
+        if manifest is None:
+            warnings.warn(
+                f"checkpoint {step} predates integrity manifests; restoring "
+                "without digest verification",
+                stacklevel=3,
+            )
+        yield ep, step, manifest, raw
+    if not any_step:
+        raise FileNotFoundError(f"no restorable checkpoint under {path}")
+
+
+def restore_checkpoint(path: str, template_pol_state) -> Tuple[object, int]:
+    """Restore (pol_state, episode) from the newest VERIFIED step under
+    ``path``, falling back past corrupt/incomplete steps with a warning.
+
+    ``template_pol_state`` provides the PyTree structure/dtypes (e.g. a fresh
+    ``init_policy_state`` result). Checkpoints written by an older framework
+    version whose state is a strict subset of the current one (fields added
+    since, e.g. DDPG ``noise_scale`` in 0.2.0) restore with the missing
+    leaves grafted at their template (init) values, with a warning.
+    """
+    last_err: Optional[Exception] = None
+    for _ep, step, manifest, raw in _iter_restorable(path):
+        try:
+            restored = _restore_step(step, template_pol_state, manifest, raw)
+        except CheckpointCorrupt as err:
+            warnings.warn(f"skipping corrupt checkpoint step: {err}", stacklevel=2)
+            last_err = err
+            continue
+        return restored["pol_state"], int(np.asarray(restored["episode"]))
+    raise last_err or FileNotFoundError(f"no restorable checkpoint under {path}")
+
+
+class ResumeState(NamedTuple):
+    """Everything a checkpoint knows, for exact resume (train/resilience.py)."""
+
+    pol_state: object
+    episode: int
+    rng_key: Optional[np.ndarray]   # host key chain at the boundary, or None
+    extra: dict                     # JSON extra state (health record, ...)
+    step_path: str
+    manifest: Optional[dict]
+
+
+def restore_resume_state(path: str, template_pol_state) -> ResumeState:
+    """``restore_checkpoint`` plus the resume payload: RNG-key chain and the
+    manifest's ``extra`` record. ``rng_key`` is ``None`` for checkpoints
+    saved without one (legacy / scenario paths) — callers fall back to the
+    fold_in resume schedule there."""
+    last_err: Optional[Exception] = None
+    for _ep, step, manifest, raw in _iter_restorable(path):
+        try:
+            restored = _restore_step(step, template_pol_state, manifest, raw)
+        except CheckpointCorrupt as err:
+            warnings.warn(f"skipping corrupt checkpoint step: {err}", stacklevel=2)
+            last_err = err
+            continue
+        rng_key = restored.get("rng_key")
+        if rng_key is not None:
+            rng_key = np.asarray(rng_key)
+        return ResumeState(
+            pol_state=restored["pol_state"],
+            episode=int(np.asarray(restored["episode"])),
+            rng_key=rng_key,
+            extra=(manifest or {}).get("extra") or {},
+            step_path=step,
+            manifest=manifest,
+        )
+    raise last_err or FileNotFoundError(f"no restorable checkpoint under {path}")

@@ -281,6 +281,31 @@ class HealthMonitor:
             "points": [p._asdict() for p in self.points],
         }
 
+    @classmethod
+    def from_dict(cls, d: dict, warn_stream=None, telemetry=None) -> "HealthMonitor":
+        """Rebuild a monitor from ``to_dict()`` output — the exact-resume
+        path (train/checkpoint.py stores the record in the step manifest's
+        ``extra``): basin entry/exit bookkeeping and the untrained-cost
+        calibration survive a preemption instead of being re-derived, so a
+        resumed run classifies (and mitigates) exactly like the original
+        would have."""
+        m = cls(
+            slots=int(d.get("slots", 0)),
+            warn_stream=warn_stream,
+            initial_cost=d.get("initial_cost"),
+            telemetry=telemetry,
+        )
+        m.basin_entries = [int(e) for e in d.get("basin_entries", [])]
+        m.basin_exits = [int(e) for e in d.get("basin_exits", [])]
+        m.points = [
+            HealthPoint(
+                int(p["episode"]), float(p["greedy_cost_eur"]),
+                float(p["greedy_reward"]), str(p["status"]),
+            )
+            for p in d.get("points", [])
+        ]
+        return m
+
     def emit_summary(self) -> None:
         """Serialize through the telemetry sink (one ``health_summary``
         event in the run's metrics.jsonl) — the replacement for callers
@@ -350,6 +375,7 @@ def train_chunked_with_health(
     pipeline: bool = True,
     carry_sync: Optional[Callable] = None,
     results_db: Optional[str] = None,
+    guard=None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float, HealthMonitor]:
     """``train_scenarios_chunked`` with the health surface on.
 
@@ -399,6 +425,12 @@ def train_chunked_with_health(
     ``pipeline=False`` is the synchronous escape hatch. ``carry_sync`` is
     forwarded to the chunked driver for callbacks that read the carry
     mid-block (checkpoint cadence).
+
+    ``guard`` (a ``resilience.DivergenceGuard``): every block-boundary eval
+    feeds it — the in-scan device counters (nonfinite q/loss) when telemetry
+    is on, and the ``classify_health`` verdict always — so a chunked run can
+    trip ``DivergenceTripped`` for a rollback driver exactly like the
+    single-community path (train/resilience.py).
     """
     from p2pmicrogrid_tpu.parallel.scenarios import (
         make_chunked_episode_runner,
@@ -497,9 +529,13 @@ def train_chunked_with_health(
             dcd = dc_to_dict(dc)
             telemetry.record_device_counters(dcd)
             telemetry.event("device_counters", episode=ep, phase="eval", **dcd)
+            if guard is not None:
+                guard.observe_counters(ep, dcd)
         else:
             c, r = greedy_eval(pol_state, jax.random.PRNGKey(1))
-        monitor.update(ep, c, r)
+        status = monitor.update(ep, c, r)
+        if guard is not None:
+            guard.observe_health(ep, status)
         if health_cb:
             health_cb(monitor.points[-1])
 

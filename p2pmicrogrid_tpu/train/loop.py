@@ -55,6 +55,9 @@ class TrainResult:
     progress: List[Tuple[int, float, float]] = field(default_factory=list)
     train_seconds: float = 0.0
     env_steps: int = 0
+    # End-of-run host RNG-key chain: the final checkpoint saves it so a
+    # completed run's checkpoint is exactly resumable too (train/resilience).
+    rng_key: Optional[object] = None
 
     @property
     def env_steps_per_sec(self) -> float:
@@ -192,6 +195,9 @@ def train_community(
     verbose: bool = False,
     telemetry=None,
     pipeline: bool = True,
+    guard=None,
+    fault_hook: Optional[Callable[[int, object], object]] = None,
+    warmup: bool = True,
 ) -> TrainResult:
     """The reference's training driver (community.py:248-298).
 
@@ -199,6 +205,26 @@ def train_community(
     running-average progress record (community.py:279-288). Every
     ``save_episodes`` episodes: invoke the checkpoint callback
     (community.py:290-292). Returns final states plus metric histories.
+
+    **Crash-safe resume** (train/resilience.py): a ``checkpoint_cb`` that
+    accepts a third argument additionally receives the host RNG-key chain
+    as it stands AFTER the block's split — saving it alongside the learner
+    state (``save_checkpoint(rng_key=...)``) makes the checkpoint exactly
+    resumable: restore the state, set ``starting_episodes = episode + 1``,
+    pass the saved key back as ``key`` with ``warmup=False``, and the
+    surviving episodes replay bit-identically to an uninterrupted run
+    (the block schedule is a pure function of the episode index, and the
+    DQN replay contents ride inside ``pol_state``). ``warmup=False`` skips
+    the DQN replay warmup AND its key split — both already happened before
+    the checkpoint was taken. ``TrainResult.rng_key`` is the end-of-run
+    chain for the final save.
+
+    ``guard`` (a ``resilience.DivergenceGuard``) observes each block's
+    in-program device counters BEFORE any checkpoint for that block is
+    saved — a divergence trip raises out of the loop without persisting
+    the poisoned state. ``fault_hook(episode, pol_state)`` runs at each
+    block boundary (the deterministic crash harness, train/faults.py); a
+    non-``None`` return replaces the carry (NaN poisoning).
 
     ``telemetry`` (a ``telemetry.Telemetry``) turns the run observable:
     progress records become ``progress`` events, each fused block runs under
@@ -219,11 +245,11 @@ def train_community(
     t = cfg.train
     arrays = build_episode_arrays(cfg, traces, ratings)
 
-    if t.implementation == "dqn":
+    if t.implementation == "dqn" and warmup:
         key, k_warm = jax.random.split(key)
         pol_state = init_dqn_buffers(cfg, policy, pol_state, arrays, ratings, k_warm)
 
-    collect_dc = telemetry is not None
+    collect_dc = telemetry is not None or guard is not None
     train_block = make_train_step(
         cfg, policy, arrays, ratings, collect_device_metrics=collect_dc,
         donate=pipeline,
@@ -258,12 +284,44 @@ def train_community(
             )
         return step_fns[size]
 
-    def consume_block(episode0_b, host, pol_state_b):
+    # A checkpoint callback that accepts (ep, pol_state, rng_key) gets the
+    # post-split key chain for exact resume; the 2-arg form stays supported.
+    ckpt_wants_key = False
+    if checkpoint_cb is not None:
+        import inspect
+
+        try:
+            params = [
+                p
+                for p in inspect.signature(checkpoint_cb).parameters.values()
+                if p.kind
+                in (
+                    inspect.Parameter.POSITIONAL_ONLY,
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.VAR_POSITIONAL,
+                )
+            ]
+            ckpt_wants_key = len(params) >= 3 or any(
+                p.kind is inspect.Parameter.VAR_POSITIONAL for p in params
+            )
+        except (TypeError, ValueError):
+            ckpt_wants_key = False
+
+    def consume_block(episode0_b, host, pol_state_b, key_b):
         rewards, losses = host[0], host[1]
         if collect_dc:
             from p2pmicrogrid_tpu.telemetry import dc_to_dict
 
-            telemetry.record_device_counters(dc_to_dict(host[2]))
+            dcd = dc_to_dict(host[2])
+            if telemetry is not None:
+                telemetry.record_device_counters(dcd)
+            if guard is not None:
+                # BEFORE the per-episode loop below: a trip here raises out
+                # of the drain before the poisoned block's checkpoint
+                # callback can persist the diverged state.
+                guard.observe_counters(
+                    episode0_b + rewards.shape[0] - 1, dcd
+                )
         for i in range(rewards.shape[0]):
             window_r.append(float(rewards[i]))
             window_l.append(float(losses[i]))
@@ -291,10 +349,19 @@ def train_community(
             # loop drains synchronously before the next dispatch can donate
             # it whenever a block ends on a save boundary).
             if (ep + 1) % t.save_episodes == 0 and checkpoint_cb:
-                checkpoint_cb(ep, pol_state_b)
+                if ckpt_wants_key:
+                    checkpoint_cb(ep, pol_state_b, key_b)
+                else:
+                    checkpoint_cb(ep, pol_state_b)
 
     profiled = False
     while episode < t.max_episodes:
+        if fault_hook is not None:
+            # Deterministic crash harness (train/faults.py): kill fires here
+            # (SIGKILL / SimulatedPreemption), poison replaces the carry.
+            mutated = fault_hook(episode, pol_state)
+            if mutated is not None:
+                pol_state = mutated
         key, k_block = jax.random.split(key)
         # Clamp the final block so exactly max_episodes episodes run (a full
         # extra block would overshoot the configured count).
@@ -342,7 +409,7 @@ def train_community(
         drain.push(
             episode,
             payload,
-            lambda e0, host, ps=pol_state: consume_block(e0, host, ps),
+            lambda e0, host, ps=pol_state, k=key: consume_block(e0, host, ps, k),
         )
         if checkpoint_cb and (episode + step_size) % t.save_episodes == 0:
             # This block's consumption will checkpoint: drain before the
@@ -358,6 +425,7 @@ def train_community(
     result.env_steps = (episode - t.starting_episodes) * arrays.n_slots
     result.pol_state = pol_state
     result.phys = phys
+    result.rng_key = key
     if telemetry is not None:
         telemetry.gauge("train.seconds_total", result.train_seconds)
         telemetry.gauge("train.env_steps_per_sec", result.env_steps_per_sec)

@@ -1,0 +1,474 @@
+"""Training-side resilience: divergence rollback, exact resume, supervision.
+
+PR 6 made the SERVING tier survive kills, stalls and corruption behind a
+replayable fault harness (serve/faults.py); this module is the mirror for
+the training tier that produces every served bundle. Three layers:
+
+* **DivergenceGuard** — watches the in-program ``nonfinite_q``/
+  ``nonfinite_loss`` device counters (telemetry/device_metrics.py) and the
+  ``classify_health`` basin verdicts (train/health.py) and raises
+  ``DivergenceTripped`` the moment training goes non-finite or enters the
+  don't-heat basin with rollback armed. ``train_community`` runs the guard
+  BEFORE each block's checkpoint callback, so a diverged state is never
+  persisted as "good".
+
+* **train_community_with_rollback** — the self-healing driver: on a trip it
+  restores the newest VERIFIED checkpoint (train/checkpoint.py falls back
+  past corrupt steps), applies a deterministic perturbation — the effective
+  learning rates x ``lr_drop**attempt`` plus a fresh ``fold_in`` branch of
+  the restored RNG chain — and re-enters the loop, up to ``max_rollbacks``
+  times. Every rollback lands in the telemetry warehouse (``train.rollback``
+  counter, ``rollback`` event + span) joinable on ``config_hash``
+  (``telemetry-query --rollbacks``).
+
+* **supervise** — the preemption harness: relaunches a training child
+  process on crash with capped exponential backoff, appending ``--resume``
+  from the second attempt on and exporting ``P2P_TRAIN_ATTEMPT`` so the
+  deterministic fault plan (train/faults.py) does not re-fire. With exact
+  resume (``prepare_resume``) the supervised run's final params are
+  bit-identical to an uninterrupted run — the acceptance capture
+  (artifacts/RESILIENCE_r08.jsonl) asserts it.
+
+Host-sync note: this module sits on the training dispatch path
+(tools/check_host_sync.py); everything here runs at block/crash boundaries
+where blocking is the point.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+ROLLBACK_KEY_SALT = 7919  # fixed prime: rollback r trains on fold_in(key, SALT + r)
+
+
+class DivergenceTripped(RuntimeError):
+    """Training diverged (non-finite counters / basin verdict)."""
+
+    def __init__(self, episode: int, reason: str, counters: Optional[dict] = None):
+        super().__init__(f"divergence at episode {episode}: {reason}")
+        self.episode = episode
+        self.reason = reason
+        self.counters = counters or {}
+
+
+class RollbackExhausted(RuntimeError):
+    """The rollback budget ran out without recovering."""
+
+
+@dataclass(frozen=True)
+class GuardPolicy:
+    """When to trip and how to perturb on rollback."""
+
+    nonfinite_q_tolerance: int = 0      # trip when a block exceeds this
+    nonfinite_loss_tolerance: int = 0
+    trip_on_basin: bool = False         # also trip on a 'basin' health verdict
+    max_rollbacks: int = 3
+    lr_drop: float = 0.5                # effective lrs x lr_drop**attempt
+
+
+class DivergenceGuard:
+    """Feeds block counters / health verdicts to the trip rule.
+
+    ``observe_counters(episode, counters)`` takes the per-block device-
+    counter dict (``dc_to_dict``); ``observe_health(episode, status)`` takes
+    a ``classify_health`` verdict. Both raise ``DivergenceTripped`` on trip
+    (once — a tripped guard is spent; the rollback driver builds a fresh one
+    per attempt). Trips are recorded as ``train.divergence`` counters +
+    ``divergence`` events when telemetry is attached.
+    """
+
+    def __init__(self, policy: GuardPolicy = GuardPolicy(), telemetry=None):
+        self.policy = policy
+        self.telemetry = telemetry
+        self.tripped: Optional[DivergenceTripped] = None
+        self.observations = 0
+
+    def _trip(self, episode: int, reason: str, counters: Optional[dict] = None):
+        trip = DivergenceTripped(episode, reason, counters)
+        self.tripped = trip
+        if self.telemetry is not None:
+            self.telemetry.counter("train.divergence")
+            self.telemetry.event(
+                "divergence", episode=episode, reason=reason, **(counters or {})
+            )
+        raise trip
+
+    def observe_counters(self, episode: int, counters: dict) -> None:
+        if self.tripped is not None:
+            return
+        self.observations += 1
+        nq = int(counters.get("nonfinite_q", 0) or 0)
+        nl = int(counters.get("nonfinite_loss", 0) or 0)
+        if nq > self.policy.nonfinite_q_tolerance or nl > self.policy.nonfinite_loss_tolerance:
+            self._trip(
+                episode,
+                f"nonfinite_q={nq} nonfinite_loss={nl}",
+                {"nonfinite_q": nq, "nonfinite_loss": nl},
+            )
+
+    def observe_health(self, episode: int, status: str) -> None:
+        if self.tripped is not None:
+            return
+        self.observations += 1
+        if self.policy.trip_on_basin and status == "basin":
+            self._trip(episode, "health classifier verdict 'basin'")
+
+
+# --- deterministic perturbation ----------------------------------------------
+
+
+def scaled_lr_cfg(cfg, scale: float):
+    """The rollback perturbation's LR half: the implementation's effective
+    learning rates x ``scale`` (tabular alpha, DQN learning_rate, DDPG
+    actor/critic lrs — the auto-scale rule, where active, applies on top of
+    the scaled bases, so the drop composes deterministically)."""
+    if scale == 1.0:
+        return cfg
+    impl = cfg.train.implementation
+    if impl == "tabular":
+        return cfg.replace(
+            qlearning=dataclasses.replace(cfg.qlearning, alpha=cfg.qlearning.alpha * scale)
+        )
+    if impl == "dqn":
+        return cfg.replace(
+            dqn=dataclasses.replace(cfg.dqn, learning_rate=cfg.dqn.learning_rate * scale)
+        )
+    if impl == "ddpg":
+        return cfg.replace(
+            ddpg=dataclasses.replace(
+                cfg.ddpg,
+                actor_lr=cfg.ddpg.actor_lr * scale,
+                critic_lr=cfg.ddpg.critic_lr * scale,
+            )
+        )
+    return cfg
+
+
+# --- exact resume ------------------------------------------------------------
+
+
+@dataclass
+class ResumePlan:
+    """What ``prepare_resume`` decided (feeds ``train_community`` directly)."""
+
+    pol_state: object
+    cfg: object
+    key: object
+    warmup: bool
+    resumed: bool
+    exact: bool
+    episode: int = -1           # checkpoint episode (-1 = fresh start)
+    extra: dict = field(default_factory=dict)
+
+
+def prepare_resume(cfg, ckpt_dir: str, template_pol_state, base_key) -> ResumePlan:
+    """Resolve a ``--resume`` request against what the checkpoint knows.
+
+    A checkpoint carrying its RNG-key chain resumes EXACTLY: the saved key
+    replaces the chain, the DQN warmup is skipped (its effect — replay
+    contents + target copy — rides inside the restored state), and the
+    surviving episodes replay bit-identically to an uninterrupted run. A
+    legacy checkpoint (no key) falls back to the historical semantics:
+    ``fold_in(base_key, episode0)`` and a fresh warmup pass — a valid
+    continuation, but a different stream than the original run's.
+
+    No restorable checkpoint at all returns a fresh-start plan (the
+    supervisor relaunches with ``--resume`` unconditionally; a child that
+    died before its first save must start over, not crash-loop).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.train.checkpoint import restore_resume_state
+
+    try:
+        st = restore_resume_state(ckpt_dir, template_pol_state)
+    except FileNotFoundError:
+        return ResumePlan(
+            pol_state=template_pol_state, cfg=cfg, key=base_key,
+            warmup=True, resumed=False, exact=False,
+        )
+    episode0 = st.episode + 1
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, starting_episodes=episode0)
+    )
+    if st.rng_key is not None:
+        key = jnp.asarray(st.rng_key)
+        return ResumePlan(
+            pol_state=st.pol_state, cfg=cfg, key=key, warmup=False,
+            resumed=True, exact=True, episode=st.episode, extra=st.extra,
+        )
+    key = jax.random.fold_in(base_key, episode0)
+    return ResumePlan(
+        pol_state=st.pol_state, cfg=cfg, key=key, warmup=True,
+        resumed=True, exact=False, episode=st.episode, extra=st.extra,
+    )
+
+
+def checkpoint_callback(
+    ckpt_dir: str,
+    cfg,
+    injector=None,
+    extra_fn: Optional[Callable[[], dict]] = None,
+    keep_last: int = 2,
+) -> Callable:
+    """The resumable checkpoint callback for ``train_community``: saves the
+    learner state WITH the RNG-key chain (3-arg form — the loop hands the
+    post-split key over) and the ``extra_fn()`` record, stamps the config
+    hash, and runs the fault injector's post-save hooks (checkpoint
+    corruption, callback stalls — train/faults.py)."""
+    from p2pmicrogrid_tpu.train.checkpoint import save_checkpoint
+
+    def cb(ep, ps, rng_key=None):
+        step = save_checkpoint(
+            ckpt_dir, ps, ep,
+            rng_key=rng_key,
+            extra=extra_fn() if extra_fn else None,
+            cfg=cfg, keep_last=keep_last,
+        )
+        if injector is not None:
+            injector.on_checkpoint_saved(ep, step)
+            injector.on_callback(ep)
+        return step
+
+    return cb
+
+
+# --- divergence rollback driver ----------------------------------------------
+
+
+@dataclass
+class RollbackRecord:
+    index: int                 # 1-based rollback count
+    tripped_episode: int
+    reason: str
+    restored_episode: int      # -1 = restored the initial state
+    lr_scale: float
+
+
+def train_community_with_rollback(
+    cfg,
+    pol_state,
+    traces,
+    ratings,
+    key,
+    ckpt_dir: str,
+    policy_factory: Optional[Callable] = None,
+    guard_policy: GuardPolicy = GuardPolicy(),
+    telemetry=None,
+    fault_injector=None,
+    on_rollback: Optional[Callable[[RollbackRecord], None]] = None,
+    warmup: bool = True,
+    extra_fn: Optional[Callable[[], dict]] = None,
+    keep_last: int = 2,
+    **train_kw,
+) -> Tuple[object, List[RollbackRecord]]:
+    """``train_community`` under the divergence guard, with capped rollback.
+
+    On a ``DivergenceTripped``: restore the newest verified checkpoint
+    (or the caller's initial state when none exists yet), drop the
+    effective lrs by ``lr_drop**attempt``, branch the restored RNG chain
+    with ``fold_in(key, ROLLBACK_KEY_SALT + attempt)`` (a fresh,
+    deterministic stream — replaying the exact trajectory that diverged
+    would diverge again), and re-enter. ``policy_factory(cfg)`` rebuilds
+    the policy for the perturbed config (defaults to ``train.make_policy``).
+    Raises ``RollbackExhausted`` after ``max_rollbacks`` failed recoveries.
+
+    Returns ``(TrainResult, rollback_records)``. ``**train_kw`` forwards to
+    ``train_community`` (pipeline, progress_cb, verbose, ...).
+    """
+    import jax
+
+    from p2pmicrogrid_tpu.train import make_policy, train_community
+    from p2pmicrogrid_tpu.train.checkpoint import restore_resume_state
+
+    if policy_factory is None:
+        policy_factory = make_policy
+    base_cfg, base_key = cfg, key
+    cur_cfg, cur_ps, cur_key, cur_warmup = cfg, pol_state, key, warmup
+    rollbacks: List[RollbackRecord] = []
+    attempt = 0
+    while True:
+        guard = DivergenceGuard(guard_policy, telemetry=telemetry)
+        policy = policy_factory(cur_cfg)
+        ckpt_cb = checkpoint_callback(
+            ckpt_dir, cur_cfg, injector=fault_injector, extra_fn=extra_fn,
+            keep_last=keep_last,
+        )
+        fault_hook = (
+            fault_injector.on_block_start if fault_injector is not None else None
+        )
+        try:
+            result = train_community(
+                cur_cfg, policy, cur_ps, traces, ratings, cur_key,
+                checkpoint_cb=ckpt_cb, telemetry=telemetry, guard=guard,
+                fault_hook=fault_hook, warmup=cur_warmup, **train_kw,
+            )
+            return result, rollbacks
+        except DivergenceTripped as trip:
+            attempt += 1
+            if attempt > guard_policy.max_rollbacks:
+                raise RollbackExhausted(
+                    f"divergence persisted through {guard_policy.max_rollbacks} "
+                    f"rollback(s); last trip: {trip}"
+                ) from trip
+            span = (
+                telemetry.span("rollback", attempt=attempt, episode=trip.episode)
+                if telemetry is not None
+                else contextlib.nullcontext()
+            )
+            with span:
+                try:
+                    st = restore_resume_state(ckpt_dir, pol_state)
+                    restored_ep, cur_ps = st.episode, st.pol_state
+                    restore_key = (
+                        jax.numpy.asarray(st.rng_key)
+                        if st.rng_key is not None
+                        else jax.random.fold_in(base_key, st.episode + 1)
+                    )
+                    episode0 = st.episode + 1
+                    cur_warmup = False
+                except FileNotFoundError:
+                    # Tripped before the first save: the initial state is
+                    # the last good one.
+                    restored_ep, cur_ps = -1, pol_state
+                    restore_key = base_key
+                    episode0 = base_cfg.train.starting_episodes
+                    cur_warmup = warmup
+            lr_scale = guard_policy.lr_drop ** attempt
+            cur_cfg = scaled_lr_cfg(base_cfg, lr_scale).replace(
+                train=dataclasses.replace(
+                    base_cfg.train, starting_episodes=episode0
+                )
+            )
+            cur_key = jax.random.fold_in(restore_key, ROLLBACK_KEY_SALT + attempt)
+            record = RollbackRecord(
+                index=attempt,
+                tripped_episode=trip.episode,
+                reason=trip.reason,
+                restored_episode=restored_ep,
+                lr_scale=lr_scale,
+            )
+            rollbacks.append(record)
+            if telemetry is not None:
+                telemetry.counter("train.rollback")
+                telemetry.event(
+                    "rollback",
+                    attempt=attempt,
+                    episode=trip.episode,
+                    restored_episode=restored_ep,
+                    lr_scale=lr_scale,
+                    reason=trip.reason,
+                )
+            if on_rollback is not None:
+                on_rollback(record)
+
+
+# --- crash supervisor ---------------------------------------------------------
+
+
+ATTEMPT_ENV = "P2P_TRAIN_ATTEMPT"
+
+
+@dataclass
+class SuperviseResult:
+    exit_code: int
+    attempts: List[dict] = field(default_factory=list)
+    kills: int = 0              # attempts that died to a signal
+    resumes: int = 0            # relaunches (attempts after the first)
+    rollbacks: int = 0          # train_rollback rows seen in child stdout
+
+    @property
+    def succeeded(self) -> bool:
+        return self.exit_code == 0
+
+
+def supervise(
+    child_argv: List[str],
+    max_restarts: int = 8,
+    backoff_s: float = 0.5,
+    backoff_cap_s: float = 8.0,
+    resume_flag: Optional[str] = "--resume",
+    env: Optional[dict] = None,
+    emit: Optional[Callable[[dict], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    passthrough=None,
+) -> SuperviseResult:
+    """Run a training child under crash supervision.
+
+    The child is relaunched on any non-zero exit (SIGKILL preemption, OOM,
+    divergence the child could not roll back from) with deterministic capped
+    exponential backoff (``min(backoff_cap_s, backoff_s * 2**restarts)`` —
+    no jitter: replayability over thundering herds of one). From the second
+    attempt on ``resume_flag`` is appended (unless already present) so the
+    child continues from its newest verified checkpoint, and every attempt
+    exports ``P2P_TRAIN_ATTEMPT`` so a deterministic fault plan
+    (train/faults.py) fires each crash exactly once.
+
+    Child stdout is streamed through (``passthrough``, default this
+    process's stdout) and scanned for ``train_rollback`` metric rows so the
+    harness can report rollback counts without a side channel. ``emit`` (if
+    given) receives one ``supervise_attempt`` metric row per attempt.
+    """
+    out = passthrough if passthrough is not None else sys.stdout
+    result = SuperviseResult(exit_code=1)
+    attempt = 0
+    while True:
+        argv = list(child_argv)
+        if attempt > 0 and resume_flag and resume_flag not in argv:
+            argv.append(resume_flag)
+        child_env = dict(os.environ)
+        child_env.update(env or {})
+        child_env[ATTEMPT_ENV] = str(attempt)
+        t0 = time.time()
+        proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=None, text=True, env=child_env
+        )
+        rollbacks_this = 0
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            out.write(line)
+            if '"train_rollback"' in line:
+                try:
+                    row = json.loads(line)
+                    if isinstance(row, dict) and row.get("metric") == "train_rollback":
+                        rollbacks_this += 1
+                except json.JSONDecodeError:
+                    pass
+        rc = proc.wait()
+        duration = time.time() - t0
+        row = {
+            "metric": "supervise_attempt",
+            "value": attempt,
+            "unit": "attempt",
+            "vs_baseline": 0.0,
+            "exit_code": rc,
+            "signal": -rc if rc < 0 else 0,
+            "duration_s": round(duration, 3),
+            "resumed": attempt > 0,
+            "rollbacks": rollbacks_this,
+        }
+        result.attempts.append(row)
+        result.rollbacks += rollbacks_this
+        if rc < 0:
+            result.kills += 1
+        if attempt > 0:
+            result.resumes += 1
+        if emit is not None:
+            emit(row)
+        if rc == 0:
+            result.exit_code = 0
+            return result
+        if attempt >= max_restarts:
+            result.exit_code = rc if rc > 0 else 1
+            return result
+        sleep(min(backoff_cap_s, backoff_s * (2 ** attempt)))
+        attempt += 1

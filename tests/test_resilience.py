@@ -1,0 +1,664 @@
+"""Crash-safe training: atomic checkpoints, exact resume, rollback, harness.
+
+The training-tier acceptance contract (ISSUE 8, mirroring the serve fleet's
+tests/test_fleet.py):
+
+* kill-at-episode-k + auto-resume == uninterrupted run, bit-exact
+  (tabular + DQN, pipelined and sync);
+* a corrupted newest checkpoint falls back to the previous verified step;
+* an injected-NaN run rolls back to the last good checkpoint and converges;
+* the supervisor relaunches crashed children with capped backoff;
+* RESILIENCE captures and checkpoint manifests validate in check_all.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    DQNConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.data import synthetic_traces
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.train import (
+    init_policy_state,
+    make_policy,
+    train_community,
+)
+from p2pmicrogrid_tpu.train.checkpoint import (
+    CheckpointCorrupt,
+    latest_checkpoint,
+    load_manifest,
+    restore_checkpoint,
+    restore_resume_state,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from p2pmicrogrid_tpu.train.faults import (
+    SimulatedPreemption,
+    TrainFaultEvent,
+    TrainFaultInjector,
+    TrainFaultPlan,
+    corrupt_step_files,
+    kill_plan,
+    poison_pol_state,
+)
+from p2pmicrogrid_tpu.train.resilience import (
+    DivergenceGuard,
+    DivergenceTripped,
+    GuardPolicy,
+    RollbackExhausted,
+    checkpoint_callback,
+    prepare_resume,
+    supervise,
+    train_community_with_rollback,
+)
+
+
+def _cfg(impl="tabular", max_episodes=8):
+    return default_config(
+        sim=SimConfig(n_agents=2),
+        train=TrainConfig(
+            implementation=impl,
+            max_episodes=max_episodes,
+            episodes_per_jit_block=2,
+            save_episodes=2,
+            min_episodes_criterion=2,
+        ),
+        dqn=DQNConfig(buffer_size=32, warmup_passes=1),
+    )
+
+
+@pytest.fixture(scope="module")
+def traces():
+    return synthetic_traces(n_days=1, seed=0, start_day=11).normalized()
+
+
+def _leaves(ps):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(ps)]
+
+
+def _assert_trees_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+# --- exact resume ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl", ["tabular", "dqn"])
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_kill_resume_bit_exact(tmp_path, traces, impl, pipeline):
+    """SIGKILL (simulated in-process) at a seeded episode + auto-resume
+    produces bit-identical final params to the uninterrupted run."""
+    cfg = _cfg(impl)
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    policy = make_policy(cfg)
+    ps0 = init_policy_state(cfg, jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(2)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    # Uninterrupted reference. The checkpoint callback must be present so
+    # the fused blocks chop at the same save boundaries as the crashed run.
+    ref = train_community(
+        cfg, policy, ps0, traces, ratings, key,
+        checkpoint_cb=lambda ep, ps: None, pipeline=pipeline,
+    )
+
+    # Crashed run: kill before episode 4 (last checkpoint: episode 3).
+    plan = TrainFaultPlan(
+        seed=0, events=(TrainFaultEvent(kind="kill", episode=4),)
+    )
+    injector = TrainFaultInjector(plan, kill_mode="raise")
+    with pytest.raises(SimulatedPreemption):
+        train_community(
+            cfg, policy, ps0, traces, ratings, key,
+            checkpoint_cb=checkpoint_callback(ckpt_dir, cfg),
+            pipeline=pipeline, fault_hook=injector.on_block_start,
+        )
+    assert injector.history == [("kill", 4, 0)]
+
+    # Auto-resume: the restored RNG chain + warmup skip replay the
+    # surviving episodes exactly.
+    template = init_policy_state(cfg, jax.random.PRNGKey(1))
+    resume = prepare_resume(cfg, ckpt_dir, template, key)
+    assert resume.resumed and resume.exact
+    assert resume.episode == 3
+    assert resume.cfg.train.starting_episodes == 4
+    res = train_community(
+        resume.cfg, policy, resume.pol_state, traces, ratings, resume.key,
+        checkpoint_cb=checkpoint_callback(ckpt_dir, resume.cfg),
+        pipeline=pipeline, warmup=resume.warmup,
+    )
+    _assert_trees_equal(ref.pol_state, res.pol_state)
+
+
+def test_final_checkpoint_carries_rng_key(tmp_path, traces):
+    """A completed run's final save (rng_key=result.rng_key) resumes as a
+    verified no-op: episode at max, exact key present."""
+    cfg = _cfg()
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    policy = make_policy(cfg)
+    ps0 = init_policy_state(cfg, jax.random.PRNGKey(1))
+    ckpt_dir = str(tmp_path / "ckpt")
+    res = train_community(
+        cfg, policy, ps0, traces, ratings, jax.random.PRNGKey(2),
+        checkpoint_cb=checkpoint_callback(ckpt_dir, cfg),
+    )
+    save_checkpoint(
+        ckpt_dir, res.pol_state, cfg.train.max_episodes - 1,
+        rng_key=res.rng_key, cfg=cfg,
+    )
+    st = restore_resume_state(ckpt_dir, ps0)
+    assert st.episode == cfg.train.max_episodes - 1
+    assert st.rng_key is not None
+    np.testing.assert_array_equal(st.rng_key, np.asarray(res.rng_key))
+    manifest = load_manifest(st.step_path)
+    assert manifest["config_hash"]
+
+
+def test_legacy_checkpoint_resumes_rekeyed(tmp_path):
+    """A checkpoint without an RNG key (pre-rewrite / scenario path) resumes
+    through the historical fold_in schedule, flagged non-exact."""
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    ckpt_dir = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt_dir, ps, episode=3)
+    plan = prepare_resume(cfg, ckpt_dir, ps, jax.random.PRNGKey(2))
+    assert plan.resumed and not plan.exact and plan.warmup
+    assert plan.cfg.train.starting_episodes == 4
+
+
+def test_prepare_resume_without_checkpoint_starts_fresh(tmp_path):
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    plan = prepare_resume(cfg, str(tmp_path / "none"), ps, jax.random.PRNGKey(2))
+    assert not plan.resumed and plan.warmup
+    assert plan.cfg.train.starting_episodes == 0
+
+
+# --- atomic checkpoints ------------------------------------------------------
+
+
+def test_corrupt_newest_falls_back_to_verified(tmp_path):
+    cfg = _cfg()
+    ps3 = init_policy_state(cfg, jax.random.PRNGKey(3))
+    ps5 = init_policy_state(cfg, jax.random.PRNGKey(5))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, ps3, episode=3, rng_key=jax.random.PRNGKey(3))
+    step5 = save_checkpoint(path, ps5, episode=5, rng_key=jax.random.PRNGKey(5))
+    assert latest_checkpoint(path).endswith("ep_5")
+
+    assert corrupt_step_files(step5) is not None
+    with pytest.warns(UserWarning, match="corrupt"):
+        assert latest_checkpoint(path).endswith("ep_3")
+    # Unverified listing still names the newest (cheap path).
+    assert latest_checkpoint(path, verify=False).endswith("ep_5")
+
+    template = init_policy_state(cfg, jax.random.PRNGKey(99))
+    with pytest.warns(UserWarning, match="corrupt"):
+        restored, episode = restore_checkpoint(path, template)
+    assert episode == 3
+    _assert_trees_equal(restored, ps3)
+
+
+def test_all_steps_corrupt_raises(tmp_path):
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    step = save_checkpoint(path, ps, episode=1)
+    corrupt_step_files(step)
+    with pytest.warns(UserWarning, match="corrupt"):
+        with pytest.raises(FileNotFoundError):
+            restore_checkpoint(path, ps)
+
+
+def test_malformed_step_dir_skipped_with_warning(tmp_path):
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, ps, episode=2)
+    os.makedirs(os.path.join(path, "ep_banana"))
+    with pytest.warns(UserWarning, match="malformed"):
+        assert latest_checkpoint(path).endswith("ep_2")
+
+
+def test_prune_waits_for_readback_verification(tmp_path, monkeypatch):
+    """A failing write NEVER strands the run: the previous step survives a
+    save whose read-back verification fails (the pre-rewrite hazard was
+    prune-before-verify)."""
+    import p2pmicrogrid_tpu.train.checkpoint as ckpt_mod
+
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, ps, episode=1)
+
+    def broken(tmp_path_, digest_):
+        raise CheckpointCorrupt("simulated torn write")
+
+    monkeypatch.setattr(ckpt_mod, "_verify_readback", broken)
+    with pytest.raises(CheckpointCorrupt, match="torn write"):
+        save_checkpoint(path, ps, episode=3)
+    monkeypatch.undo()
+    assert latest_checkpoint(path).endswith("ep_1")
+    restored, episode = restore_checkpoint(path, ps)
+    assert episode == 1
+    # The next good save reclaims the stale temp dir.
+    save_checkpoint(path, ps, episode=3)
+    assert not [d for d in os.listdir(path) if d.startswith("_tmp_ep_")]
+    assert latest_checkpoint(path).endswith("ep_3")
+
+
+def test_prune_keeps_fallback_and_removes_stale_higher(tmp_path):
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    for ep in (1, 3, 5):
+        save_checkpoint(path, ps, episode=ep)
+    names = sorted(d for d in os.listdir(path) if d.startswith("ep_"))
+    assert names == ["ep_3", "ep_5"]  # keep_last=2: newest + one fallback
+    # A lower-episode save (fresh shorter run) prunes the stale higher steps
+    # so they can never shadow it.
+    save_checkpoint(path, ps, episode=2)
+    names = sorted(d for d in os.listdir(path) if d.startswith("ep_"))
+    assert names == ["ep_2"]
+
+
+def test_verify_checkpoint_detects_manifest_payload_skew(tmp_path):
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    step = save_checkpoint(path, ps, episode=4)
+    assert verify_checkpoint(step)["episode"] == 4
+    m = load_manifest(step)
+    m["digest"] = "sha256:" + "0" * 64
+    with open(os.path.join(step, "p2p_manifest.json"), "w") as f:
+        json.dump(m, f)
+    with pytest.raises(CheckpointCorrupt, match="digest mismatch"):
+        verify_checkpoint(step)
+
+
+def test_health_state_rides_checkpoint_extra(tmp_path):
+    from p2pmicrogrid_tpu.train.health import HealthMonitor
+
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    monitor = HealthMonitor(slots=96, warn_stream=open(os.devnull, "w"))
+    monitor.update(0, 3000.0, -800.0)      # untrained
+    monitor.update(10, -50.0, -1500.0)     # basin entry
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, ps, episode=10, extra={"health": monitor.to_dict()})
+    st = restore_resume_state(path, ps)
+    restored = HealthMonitor.from_dict(st.extra["health"])
+    assert restored.in_basin
+    assert restored.basin_entries == monitor.basin_entries
+    assert restored.initial_cost == monitor.initial_cost
+    assert [p.status for p in restored.points] == [
+        p.status for p in monitor.points
+    ]
+    assert len(restored.points) == 2
+
+
+# --- divergence rollback -----------------------------------------------------
+
+
+def test_rollback_on_injected_nan(tmp_path, traces):
+    """poison-NaN at a seeded episode: the guard trips on the in-program
+    nonfinite counters, training rolls back to the last GOOD checkpoint and
+    converges to a finite final state."""
+    from p2pmicrogrid_tpu.telemetry import MemorySink, Telemetry
+
+    cfg = _cfg()
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    ps0 = init_policy_state(cfg, jax.random.PRNGKey(1))
+    ckpt_dir = str(tmp_path / "ckpt")
+    plan = TrainFaultPlan(
+        seed=0, events=(TrainFaultEvent(kind="poison_nan", episode=4),)
+    )
+    injector = TrainFaultInjector(plan, kill_mode="raise")
+    sink = MemorySink()
+    tel = Telemetry(run_id="rollback-test", sinks=[sink])
+    result, rollbacks = train_community_with_rollback(
+        cfg, ps0, traces, ratings, jax.random.PRNGKey(2), ckpt_dir,
+        guard_policy=GuardPolicy(max_rollbacks=2),
+        telemetry=tel, fault_injector=injector,
+    )
+    tel.close()
+    assert len(rollbacks) == 1
+    assert rollbacks[0].restored_episode == 3
+    assert rollbacks[0].tripped_episode >= 4
+    assert rollbacks[0].lr_scale == 0.5
+    for leaf in _leaves(result.pol_state):
+        if np.issubdtype(leaf.dtype, np.floating):
+            assert np.isfinite(leaf).all()
+    assert tel.counters["train.rollback"] == 1
+    kinds = [r.get("kind") for r in sink.records]
+    assert "divergence" in kinds and "rollback" in kinds
+
+
+def test_rollback_exhausted_raises(tmp_path, traces):
+    """A fault that re-poisons every attempt exhausts the budget loudly."""
+
+    class AlwaysPoison:
+        def on_block_start(self, ep, pol_state=None):
+            if ep >= 4 and pol_state is not None:
+                return poison_pol_state(pol_state)
+            return None
+
+        def on_checkpoint_saved(self, ep, step):
+            pass
+
+        def on_callback(self, ep):
+            pass
+
+    cfg = _cfg()
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    ps0 = init_policy_state(cfg, jax.random.PRNGKey(1))
+    with pytest.raises(RollbackExhausted):
+        train_community_with_rollback(
+            cfg, ps0, traces, ratings, jax.random.PRNGKey(2),
+            str(tmp_path / "ckpt"),
+            guard_policy=GuardPolicy(max_rollbacks=2),
+            fault_injector=AlwaysPoison(),
+        )
+
+
+def test_guard_trips_and_is_single_shot():
+    guard = DivergenceGuard(GuardPolicy())
+    guard.observe_counters(3, {"nonfinite_q": 0, "nonfinite_loss": 0})
+    with pytest.raises(DivergenceTripped) as exc:
+        guard.observe_counters(5, {"nonfinite_q": 7, "nonfinite_loss": 0})
+    assert exc.value.episode == 5
+    # Spent: further observations are no-ops (the rollback driver builds a
+    # fresh guard per attempt).
+    guard.observe_counters(7, {"nonfinite_q": 9})
+    guard.observe_health(7, "basin")
+
+
+def test_guard_basin_verdict():
+    guard = DivergenceGuard(GuardPolicy(trip_on_basin=True))
+    guard.observe_health(10, "healthy")
+    guard.observe_health(20, "slide")
+    with pytest.raises(DivergenceTripped, match="basin"):
+        guard.observe_health(30, "basin")
+    # Default policy: basin is the health monitor's business, not a trip.
+    DivergenceGuard(GuardPolicy()).observe_health(30, "basin")
+
+
+# --- fault plans -------------------------------------------------------------
+
+
+def test_fault_plan_json_roundtrip():
+    plan = TrainFaultPlan(
+        seed=7,
+        events=(
+            TrainFaultEvent(kind="kill", episode=5, attempt=0),
+            TrainFaultEvent(kind="corrupt_checkpoint", episode=3, attempt=1),
+            TrainFaultEvent(kind="stall_callback", episode=2, stall_s=0.01),
+            TrainFaultEvent(kind="poison_nan", episode=4, attempt=None),
+        ),
+    )
+    assert TrainFaultPlan.from_json(plan.to_json()) == plan
+    with pytest.raises(ValueError):
+        TrainFaultPlan.from_json(json.dumps({"kind": "fault_plan", "seed": 1}))
+    with pytest.raises(ValueError):
+        TrainFaultEvent(kind="meteor", episode=1)
+
+
+def test_kill_plan_deterministic_and_attempt_scoped():
+    a = kill_plan(seed=3, n_episodes=100, n_kills=3)
+    b = kill_plan(seed=3, n_episodes=100, n_kills=3)
+    assert a == b
+    assert [e.attempt for e in a.events] == [0, 1, 2]
+    assert all(1 <= e.episode < 100 for e in a.events)
+    assert kill_plan(seed=4, n_episodes=100).events != a.events[:1]
+    # Attempt scoping: attempt-1's injector ignores the attempt-0 kill.
+    scoped = TrainFaultPlan(
+        seed=0,
+        events=(
+            TrainFaultEvent(kind="kill", episode=5, attempt=0),
+            TrainFaultEvent(kind="kill", episode=50, attempt=1),
+        ),
+    )
+    inj = TrainFaultInjector(scoped, attempt=1, kill_mode="raise")
+    inj.on_block_start(10)  # past the attempt-0 kill: no fire
+    assert inj.history == []
+    with pytest.raises(SimulatedPreemption):
+        inj.on_block_start(50)
+
+
+def test_stall_callback_fires_once():
+    naps = []
+    plan = TrainFaultPlan(
+        seed=0, events=(TrainFaultEvent(kind="stall_callback", episode=2, stall_s=0.5),)
+    )
+    inj = TrainFaultInjector(plan, sleep=naps.append)
+    inj.on_callback(1)
+    inj.on_callback(2)
+    inj.on_callback(3)
+    assert naps == [0.5]
+
+
+# --- supervisor --------------------------------------------------------------
+
+
+_CRASHY_CHILD = """
+import os, sys
+attempt = int(os.environ["P2P_TRAIN_ATTEMPT"])
+if attempt < 2:
+    os.kill(os.getpid(), 9)
+print('{"metric": "train_rollback", "value": 1, "unit": "rollback", "vs_baseline": 0.0}')
+"""
+
+
+def test_supervise_restarts_until_success():
+    rows = []
+    result = supervise(
+        [sys.executable, "-c", _CRASHY_CHILD],
+        max_restarts=4, backoff_s=0.01, backoff_cap_s=0.02,
+        resume_flag=None, emit=rows.append,
+        passthrough=open(os.devnull, "w"),
+    )
+    assert result.succeeded
+    assert len(result.attempts) == 3
+    assert result.kills == 2 and result.resumes == 2
+    assert result.rollbacks == 1  # scanned from child stdout
+    assert [r["exit_code"] for r in rows] == [-9, -9, 0]
+    assert rows[0]["signal"] == 9 and rows[2]["signal"] == 0
+
+
+def test_supervise_appends_resume_flag():
+    child = (
+        "import sys; sys.exit(0 if '--resume' in sys.argv else 7)"
+    )
+    result = supervise(
+        [sys.executable, "-c", child],
+        max_restarts=2, backoff_s=0.01, backoff_cap_s=0.02,
+        passthrough=open(os.devnull, "w"),
+    )
+    assert result.succeeded and len(result.attempts) == 2
+    assert result.attempts[0]["exit_code"] == 7
+
+
+def test_supervise_gives_up_after_cap():
+    result = supervise(
+        [sys.executable, "-c", "import sys; sys.exit(3)"],
+        max_restarts=2, backoff_s=0.01, backoff_cap_s=0.02,
+        resume_flag=None, passthrough=open(os.devnull, "w"),
+    )
+    assert not result.succeeded
+    assert result.exit_code == 3
+    assert len(result.attempts) == 3  # initial + 2 restarts
+
+
+# --- schema checks -----------------------------------------------------------
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_artifacts_schema",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_artifacts_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+GOOD_HEADLINE = {
+    "metric": "train_supervised", "value": 2, "unit": "attempts",
+    "vs_baseline": 0.0, "kills": 1, "resumes": 1, "rollbacks": 0,
+    "final_episode": 7, "bit_exact": True,
+}
+
+
+def test_resilience_jsonl_schema(tmp_path):
+    checker = _load_checker()
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    good = art / "RESILIENCE_r98.jsonl"
+    rows = [
+        {"metric": "supervise_attempt", "value": 0, "unit": "attempt",
+         "vs_baseline": 0.0, "exit_code": -9},
+        GOOD_HEADLINE,
+        {"metric": "train_rollback_total", "value": 1, "unit": "rollbacks",
+         "vs_baseline": 0.0, "converged": True},
+    ]
+    good.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    problems = []
+    checker.check_resilience_jsonl(str(good), problems)
+    assert problems == []
+
+    bad = art / "RESILIENCE_r99.jsonl"
+    bad_headline = dict(GOOD_HEADLINE)
+    del bad_headline["bit_exact"]
+    bad_headline["kills"] = "one"
+    bad.write_text(json.dumps(bad_headline) + "\n")
+    problems = []
+    checker.check_resilience_jsonl(str(bad), problems)
+    assert any("bit_exact" in p for p in problems)
+    assert any("kills" in p for p in problems)
+    # check_all picks RESILIENCE files up from an artifact root.
+    all_problems = checker.check_all(str(tmp_path))
+    assert any("RESILIENCE_r99" in p for p in all_problems)
+    assert not any("RESILIENCE_r98" in p for p in all_problems)
+
+
+def test_checkpoint_manifest_schema(tmp_path):
+    checker = _load_checker()
+    cfg = _cfg()
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    from p2pmicrogrid_tpu.train.checkpoint import checkpoint_dir
+
+    ckpt_dir = checkpoint_dir(str(tmp_path / "models"), cfg.setting, "tabular")
+    step = save_checkpoint(ckpt_dir, ps, episode=3, cfg=cfg)
+    problems = checker.check_all(str(tmp_path))
+    assert not [p for p in problems if "p2p_manifest" in p]
+
+    m = load_manifest(step)
+    del m["digest"]
+    m["tree"] = {}
+    with open(os.path.join(step, "p2p_manifest.json"), "w") as f:
+        json.dump(m, f)
+    problems = checker.check_all(str(tmp_path))
+    assert any("digest" in p for p in problems)
+    assert any("tree" in p for p in problems)
+
+
+# --- warehouse ---------------------------------------------------------------
+
+
+def test_rollback_view_joins_on_config_hash(tmp_path):
+    from p2pmicrogrid_tpu.data import ResultsStore
+    from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+    from p2pmicrogrid_tpu.telemetry.registry import run_manifest
+
+    cfg = _cfg()
+    db = str(tmp_path / "results.db")
+    tel = Telemetry(
+        run_id="resilience-run",
+        sinks=[SqliteSink(db)],
+        manifest=run_manifest(cfg),
+    )
+    tel.counter("train.divergence")
+    tel.counter("train.rollback")
+    tel.event(
+        "rollback", attempt=1, episode=5, restored_episode=3,
+        lr_scale=0.5, reason="nonfinite_q=7 nonfinite_loss=0",
+    )
+    tel.close()
+    store = ResultsStore(db)
+    rows = store.query_rollback_view()
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["rollbacks"] == 1
+    assert row["divergence_trips"] == 1
+    assert row["rollback_events"] == 1
+    assert row["last_rollback_episode"] == 5
+    assert row["last_restored_episode"] == 3
+    assert row["config_hash"]
+
+
+# --- CLI (in-process; the real-SIGKILL end-to-end run is marked slow) --------
+
+
+def test_cli_resume_noop_verifies_integrity(tmp_path, traces, capsys, monkeypatch):
+    """`train --resume` with the checkpoint at --episodes verifies the final
+    checkpoint's integrity and reports the no-op."""
+    from p2pmicrogrid_tpu import cli
+
+    monkeypatch.setenv("P2P_TELEMETRY", "0")
+    monkeypatch.chdir(tmp_path)
+    argv = [
+        "train", "--agents", "2", "--episodes", "4", "--seed", "3",
+        "--model-dir", str(tmp_path / "models"), "--no-pipeline",
+    ]
+    assert cli.main(argv) == 0
+    capsys.readouterr()
+    assert cli.main(argv + ["--resume"]) == 0
+    out = capsys.readouterr().out
+    assert "nothing to do" in out and "integrity verified" in out
+
+
+@pytest.mark.slow
+def test_cli_supervised_sigkill_bit_exact(tmp_path):
+    """End-to-end acceptance: real SIGKILL mid-training under
+    `train --supervise`, auto-resume, bit-exact vs uninterrupted."""
+    import subprocess
+
+    out_path = tmp_path / "RESILIENCE_test.jsonl"
+    argv = [
+        sys.executable, "-m", "p2pmicrogrid_tpu", "train",
+        "--agents", "2", "--episodes", "8", "--seed", "3",
+        "--model-dir", str(tmp_path / "models"),
+        "--supervise", "--fault-seed", "0", "--fault-kills", "1",
+        "--verify-uninterrupted", "--resilience-out", str(out_path),
+        "--max-restarts", "3",
+    ]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["P2P_TELEMETRY"] = "0"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(argv, env=env, cwd=str(tmp_path),
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [json.loads(l) for l in out_path.read_text().splitlines()]
+    headline = [r for r in rows if r.get("metric") == "train_supervised"][-1]
+    assert headline["bit_exact"] is True
+    assert headline["kills"] >= 1 and headline["resumes"] >= 1
+    checker = _load_checker()
+    problems = []
+    checker.check_resilience_jsonl(str(out_path), problems)
+    assert problems == []

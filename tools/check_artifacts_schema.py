@@ -50,6 +50,18 @@ the contracts executable:
   ``throughput_rps``/``availability``/``failover_count``/``retry_rate``/
   ``shed_rate`` — with ``availability`` in [0, 1].
 
+* Resilience captures (``artifacts/RESILIENCE_*.jsonl``, `train
+  --supervise` / rollback runs): metric rows, any ``train_supervised``
+  headline must carry numeric ``kills``/``resumes``/``rollbacks``/
+  ``final_episode`` and a boolean ``bit_exact``; ``train_rollback_total``
+  rows must carry a boolean ``converged``.
+
+* Checkpoint integrity manifests (``models*/models_*/<setting>/ep_*/
+  p2p_manifest.json``, the atomic-save record of train/checkpoint.py):
+  ``kind: "checkpoint_manifest"`` with integer format_version/episode, a
+  ``sha256:`` digest, a non-empty tree spec (shape/dtype per leaf) and
+  ``payload_keys`` including ``pol_state``, next to actual payload files.
+
 * Results databases (``*.db``/``*.sqlite`` at the root and under
   ``artifacts/``): when a DB carries telemetry warehouse tables
   (``data/results.py``), its ``PRAGMA user_version`` must match the
@@ -229,6 +241,119 @@ def check_fleet_jsonl(path: str, problems: list) -> None:
                 f"{where}:{i + 1}: availability {availability} outside "
                 "[0, 1]"
             )
+
+
+# Numeric keys every train_supervised headline row must carry — the
+# crash-resume contract of train/resilience.py:supervise + `train
+# --supervise`. kill/resume/rollback counts plus the bit_exact boolean are
+# the point of a resilience capture: a headline without them measured
+# nothing the training tier promises.
+RESILIENCE_HEADLINE_KEYS = ("kills", "resumes", "rollbacks", "final_episode")
+
+
+def check_resilience_jsonl(path: str, problems: list) -> None:
+    """RESILIENCE_*.jsonl: metric rows + the supervised-run contract."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return  # already reported by check_metric_jsonl
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # already reported
+        if not isinstance(row, dict):
+            continue
+        metric = row.get("metric")
+        if metric == "train_supervised":
+            for key in RESILIENCE_HEADLINE_KEYS:
+                v = row.get(key)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    problems.append(
+                        f"{where}:{i + 1}: train_supervised headline "
+                        f"missing numeric {key!r}"
+                    )
+            if not isinstance(row.get("bit_exact"), bool):
+                problems.append(
+                    f"{where}:{i + 1}: train_supervised headline missing "
+                    "boolean 'bit_exact' (committed captures must run "
+                    "--verify-uninterrupted)"
+                )
+        elif metric == "train_rollback_total":
+            if not isinstance(row.get("converged"), bool):
+                problems.append(
+                    f"{where}:{i + 1}: train_rollback_total row missing "
+                    "boolean 'converged'"
+                )
+
+
+# Checkpoint integrity manifests (train/checkpoint.py save layout):
+# models_<impl>/<setting>/ep_<episode>/p2p_manifest.json.
+CHECKPOINT_MANIFEST_GLOBS = (
+    os.path.join("models*", "models_*", "*", "ep_*", "p2p_manifest.json"),
+    os.path.join("models_*", "*", "ep_*", "p2p_manifest.json"),
+    os.path.join("artifacts", "models_*", "*", "ep_*", "p2p_manifest.json"),
+)
+
+
+def check_checkpoint_manifest(path: str, problems: list) -> None:
+    """Validate one checkpoint step's p2p_manifest.json (the atomic-save
+    integrity record of train/checkpoint.py). Structure only — the content
+    digest itself is verified by the restore path, which can parse the
+    Orbax payload; this stdlib checker enforces the manifest contract."""
+    where = os.path.relpath(path)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError) as err:
+        problems.append(f"{where}: unreadable ({err})")
+        return
+    if not isinstance(m, dict):
+        problems.append(f"{where}: not an object")
+        return
+    if m.get("kind") != "checkpoint_manifest":
+        problems.append(
+            f"{where}: kind is {m.get('kind')!r}, expected "
+            "'checkpoint_manifest'"
+        )
+    for key, typ in (("format_version", int), ("episode", int)):
+        if not isinstance(m.get(key), typ) or isinstance(m.get(key), bool):
+            problems.append(f"{where}: missing integer {key!r}")
+    digest = m.get("digest")
+    if not (isinstance(digest, str) and digest.startswith("sha256:")):
+        problems.append(f"{where}: 'digest' is not a sha256:<hex> string")
+    tree = m.get("tree")
+    if not isinstance(tree, dict) or not tree:
+        problems.append(f"{where}: 'tree' missing or empty")
+    else:
+        for leaf, spec in tree.items():
+            if (
+                not isinstance(spec, dict)
+                or not isinstance(spec.get("shape"), list)
+                or not isinstance(spec.get("dtype"), str)
+            ):
+                problems.append(
+                    f"{where}: tree leaf {leaf!r} missing shape/dtype"
+                )
+                break
+    keys = m.get("payload_keys")
+    if not isinstance(keys, list) or "pol_state" not in keys:
+        problems.append(
+            f"{where}: payload_keys missing or lacks 'pol_state'"
+        )
+    # The step directory must hold more than the manifest (a manifest next
+    # to zero payload files is a stripped/partial step).
+    step_dir = os.path.dirname(path)
+    payload_entries = [
+        e for e in os.listdir(step_dir) if e != os.path.basename(path)
+    ]
+    if not payload_entries:
+        problems.append(f"{where}: step directory has no payload files")
 
 
 def check_gateway_stats(path: str, problems: list) -> None:
@@ -508,6 +633,13 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
     ):
         check_fleet_jsonl(path, problems)
     for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "RESILIENCE_*.jsonl"))
+    ):
+        check_resilience_jsonl(path, problems)
+    for pattern in CHECKPOINT_MANIFEST_GLOBS:
+        for path in sorted(glob.glob(os.path.join(repo_root, pattern))):
+            check_checkpoint_manifest(path, problems)
+    for path in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "GATEWAY_STATS_*.json"))
     ):
         check_gateway_stats(path, problems)
@@ -571,10 +703,14 @@ def main(argv=None) -> int:
     n_dbs = sum(
         len(glob.glob(os.path.join(root, pat))) for pat in RESULTS_DB_GLOBS
     )
+    n_ckpts = sum(
+        len(glob.glob(os.path.join(root, pat)))
+        for pat in CHECKPOINT_MANIFEST_GLOBS
+    )
     print(
         f"checked {n_bench} bench captures, {n_runs} telemetry runs, "
-        f"{n_bundles} policy bundles, {n_dbs} results DBs: "
-        f"{len(problems)} problem(s)"
+        f"{n_bundles} policy bundles, {n_dbs} results DBs, "
+        f"{n_ckpts} checkpoint manifests: {len(problems)} problem(s)"
     )
     return 1 if problems else 0
 

@@ -58,6 +58,10 @@ HOT_PATH_FILES = (
     # stalls the whole fleet's traffic, not one process.
     os.path.join("p2pmicrogrid_tpu", "serve", "router.py"),
     os.path.join("p2pmicrogrid_tpu", "serve", "faults.py"),
+    # The resilience layer wraps every training dispatch (guard observation
+    # per block, checkpoint callbacks on the save cadence): a blocking
+    # readback here would serialize the whole async pipeline it guards.
+    os.path.join("p2pmicrogrid_tpu", "train", "resilience.py"),
     os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
 )
 
