@@ -849,8 +849,80 @@ def _cmd_train_scenarios(args) -> int:
             return True
         return _health > 0 and chunks <= 1 and ep % _health == 0
 
+    max_rollbacks = getattr(args, "max_rollbacks", 0)
+    if max_rollbacks > 0 and (chunks <= 1 or health_every <= 0):
+        raise SystemExit(
+            "--max-rollbacks on the scenario path requires --chunks > 1 "
+            "and --health-every > 0: the divergence guard observes the "
+            "chunked block-boundary evals (the single-community `train` "
+            "path supports rollback without chunks)"
+        )
     with _profile_ctx(args):
-        if chunks > 1 and health_every > 0:
+        if chunks > 1 and max_rollbacks > 0:
+            # Chunked divergence rollback (train/resilience.py): watch the
+            # block-boundary eval counters/verdicts, restore the newest
+            # verified checkpoint on trip, retrain under a deterministic
+            # perturbation (LR drop + re-keyed chunk stream).
+            from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+            from p2pmicrogrid_tpu.train.resilience import (
+                GuardPolicy,
+                train_chunked_with_rollback,
+            )
+
+            extra_sinks = (
+                [SqliteSink(args.results_db)] if args.results_db else ()
+            )
+            tel = Telemetry.maybe_create(
+                "train-chunked-rollback", cfg=cfg, extra_sinks=extra_sinks
+            )
+
+            def on_rollback(rec):
+                _emit_resilience_row(args, {
+                    "metric": "train_rollback",
+                    "value": rec.index,
+                    "unit": "rollback",
+                    "vs_baseline": 0.0,
+                    "tripped_episode": rec.tripped_episode,
+                    "restored_episode": rec.restored_episode,
+                    "lr_scale": rec.lr_scale,
+                    "reason": rec.reason,
+                })
+
+            try:
+                result, rollback_records = train_chunked_with_rollback(
+                    cfg, pol_state, ratings, key, ckpt_dir,
+                    n_episodes=n_episodes,
+                    n_chunks=chunks,
+                    eval_every=health_every,
+                    episode0=episode0,
+                    guard_policy=GuardPolicy(
+                        max_rollbacks=max_rollbacks,
+                        lr_drop=getattr(args, "lr_drop", 0.5),
+                    ),
+                    telemetry=tel,
+                    on_rollback=on_rollback,
+                    episode_cb=episode_cb,
+                    carry_sync=carry_sync,
+                    health_cb=health_cb,
+                    monitor=monitor,
+                    pipeline=pipeline,
+                    chunk_parallel=chunk_parallel,
+                    mitigate=basin_mitigate,
+                )
+            finally:
+                if tel is not None:
+                    tel.close()
+            pol_state, rewards, _, seconds, monitor = result
+            if rollback_records:
+                _emit_resilience_row(args, {
+                    "metric": "train_rollback_total",
+                    "value": len(rollback_records),
+                    "unit": "rollbacks",
+                    "vs_baseline": 0.0,
+                    "converged": True,
+                    "final_episode": cfg.train.max_episodes - 1,
+                })
+        elif chunks > 1 and health_every > 0:
             from p2pmicrogrid_tpu.train.health import train_chunked_with_health
 
             pol_state, rewards, _, seconds, monitor = train_chunked_with_health(
@@ -1616,19 +1688,28 @@ def cmd_serve_bench(args) -> int:
                 flush=True,
             )
         if getattr(args, "fleet", False):
-            # Fleet mode: N in-process gateway replicas behind the
-            # consistent-hash router, the open-loop schedule fired THROUGH
-            # the router (retry/failover semantics included), optionally
-            # with a deterministic kill/restart fault plan mid-run. The
-            # committed FLEET_*.jsonl captures come from here.
+            # Fleet mode: N gateway replicas behind the consistent-hash
+            # router, the open-loop schedule fired THROUGH the router
+            # (retry/failover semantics included), optionally with a
+            # deterministic kill/restart fault plan mid-run. --process
+            # swaps the in-process LocalFleet for real subprocess
+            # replicas (serve/procfleet.py) — kills become SIGKILLs, the
+            # supervisor relaunches, and --tls/--auth terminate trust at
+            # every replica. The committed FLEET_*.jsonl /
+            # FLEET_PROC_*.jsonl captures come from here.
+            import os as _os
+            import tempfile as _tempfile
+
             from p2pmicrogrid_tpu.serve import (
                 AdmissionConfig,
                 FaultPlan,
                 FleetRouter,
                 LocalFleet,
+                ProcessFleet,
                 RetryPolicy,
                 kill_restart_plan,
                 serve_bench_fleet,
+                serve_bench_wire_compare,
             )
 
             plan = None
@@ -1649,22 +1730,115 @@ def cmd_serve_bench(args) -> int:
                 plan = kill_restart_plan(
                     victim, kill_at, restart_at, seed=args.chaos_seed
                 )
-            fleet = LocalFleet(
-                [bundle],
-                n_replicas=args.replicas,
-                max_batch=args.max_batch,
-                max_wait_s=args.max_wait_ms / 1e3,
-                admission=AdmissionConfig(
+            process_mode = getattr(args, "process", False)
+            transport = getattr(args, "fleet_transport", "auto")
+            use_tls = getattr(args, "tls", False)
+            use_auth = getattr(args, "auth", False)
+            cert = key = server_ctx = client_ctx = None
+            authenticator = router_token = secret_file = None
+            if use_tls:
+                from p2pmicrogrid_tpu.serve import (
+                    client_ssl_context,
+                    ensure_test_certs,
+                    server_ssl_context,
+                )
+
+                cert, key = ensure_test_certs()
+                server_ctx = server_ssl_context(cert, key)
+                client_ctx = client_ssl_context(cert)
+                print(f"serve-bench: TLS on (test cert {cert})",
+                      file=sys.stderr, flush=True)
+            if use_auth:
+                from p2pmicrogrid_tpu.serve import (
+                    TokenAuthenticator,
+                    generate_secret,
+                )
+
+                fd, secret_file = _tempfile.mkstemp(prefix="p2p-secret-")
+                _os.close(fd)
+                authenticator = TokenAuthenticator(
+                    generate_secret(secret_file)
+                )
+                router_token = authenticator.mint("*")
+                print("serve-bench: per-household token auth on",
+                      file=sys.stderr, flush=True)
+            plan_file = None
+            has_request_faults = plan is not None and any(
+                e.kind not in ("kill", "restart") for e in plan.events
+            )
+            if getattr(args, "wire_compare", False):
+                # Refuse impossible combinations BEFORE paying fleet
+                # startup (in process mode: several subprocess spawns).
+                if transport == "http":
+                    raise SystemExit(
+                        "--wire-compare needs the mux wire "
+                        "(drop --transport http)"
+                    )
+                if has_request_faults:
+                    # A request-fault injector anchors at the first
+                    # request it sees (process children) or first-wins
+                    # activate (in-process) — the compare pre-pass would
+                    # start replica-0's fault clock, shift its coin
+                    # indices and absorb its injected faults, corrupting
+                    # both measurements AND seed replay.
+                    raise SystemExit(
+                        "--wire-compare cannot run in the same "
+                        "invocation as a request-fault chaos plan "
+                        "(the pre-pass would anchor and consume "
+                        "replica-0's fault windows); capture them in "
+                        "two runs"
+                    )
+            if process_mode:
+                if has_request_faults:
+                    # Request-kind faults execute INSIDE each child's
+                    # injector; lifecycle events stay parent-driven.
+                    fd, plan_file = _tempfile.mkstemp(
+                        prefix="p2p-plan-", suffix=".json"
+                    )
+                    with _os.fdopen(fd, "w") as f:
+                        f.write(plan.to_json())
+                fleet = ProcessFleet(
+                    [bundle],
+                    n_replicas=args.replicas,
+                    max_batch=args.max_batch,
+                    max_wait_s=args.max_wait_ms / 1e3,
                     max_queue_depth=args.max_queue_depth,
                     wait_budget_ms=args.wait_budget_ms,
-                ),
-                results_db=args.results_db,
-                device=getattr(args, "serve_device", "auto"),
-                fault_plan=plan,
-                run_name="serve-bench-fleet",
-            )
-            fleet.start()
-            reference = fleet.reference_engine()
+                    mux=(transport != "http"),
+                    tls_cert=cert,
+                    tls_key=key,
+                    auth_secret_file=secret_file,
+                    fault_plan_file=plan_file,
+                    results_db=args.results_db,
+                    serve_device=getattr(args, "serve_device", "auto"),
+                )
+                fleet.start()
+                # The bit-exactness comparator lives in THIS process: the
+                # same bundle the children serve, loaded directly.
+                reference = PolicyEngine(
+                    bundle_dir=bundle, max_batch=args.max_batch,
+                    device=getattr(args, "serve_device", "auto"),
+                )
+            else:
+                fleet = LocalFleet(
+                    [bundle],
+                    n_replicas=args.replicas,
+                    max_batch=args.max_batch,
+                    max_wait_s=args.max_wait_ms / 1e3,
+                    admission=AdmissionConfig(
+                        max_queue_depth=args.max_queue_depth,
+                        wait_budget_ms=args.wait_budget_ms,
+                    ),
+                    results_db=args.results_db,
+                    device=getattr(args, "serve_device", "auto"),
+                    fault_plan=plan,
+                    run_name="serve-bench-fleet",
+                    mux=(transport != "http"),
+                    tls=server_ctx,
+                    authenticator=authenticator,
+                )
+                fleet.start()
+                reference = fleet.reference_engine()
             # The router gets its own warehouse-keyed telemetry: ejection/
             # failover/retry counters and the aggregated fleet_stats event
             # land next to the per-replica bundle traces, joined on the
@@ -1692,9 +1866,27 @@ def cmd_serve_bench(args) -> int:
                 fail_threshold=2,
                 ok_threshold=1,
                 telemetry=router_tel,
+                ssl_context=client_ctx,
+                token=router_token,
+                transport=transport,
             )
+            unauth_router = None
+            if use_auth:
+                # The auth acceptance probe: a second router over the SAME
+                # fleet holding NO credential — its requests must 401
+                # without a single retry or budget token spent.
+                unauth_router = FleetRouter(
+                    fleet.replicas,
+                    retry=RetryPolicy(
+                        max_attempts=args.retry_attempts,
+                        deadline_s=args.retry_deadline_s,
+                    ),
+                    ssl_context=client_ctx,
+                    transport=transport,
+                )
             print(
-                f"serve-bench: fleet of {args.replicas} replicas on "
+                f"serve-bench: {'process' if process_mode else 'in-process'}"
+                f" fleet of {args.replicas} replicas on "
                 + ", ".join(f"{r.replica_id}:{r.port}" for r in fleet.replicas)
                 + (
                     f"; chaos plan: {len(plan.events)} event(s), "
@@ -1704,6 +1896,30 @@ def cmd_serve_bench(args) -> int:
                 flush=True,
             )
             try:
+                gateway_baseline = None
+                if getattr(args, "wire_compare", False):
+                    rep0 = fleet.replicas[0]
+                    token_fn = (
+                        (lambda h: authenticator.mint(h))
+                        if authenticator is not None else None
+                    )
+                    serve_bench_wire_compare(
+                        rep0.host, rep0.port, rep0.mux_port,
+                        reference.n_agents,
+                        rate_hz=args.rate,
+                        n_requests=min(args.requests, 512),
+                        n_households=args.households,
+                        seed=args.bench_seed,
+                        ssl=client_ctx,
+                        token_fn=token_fn,
+                        emit=lambda row: (sink.emit(row),
+                                          router_tel.emit(row)),
+                    )
+                    # Gateway stats are cumulative: snapshot the pre-pass
+                    # totals so the chaos headline reports only ITS run.
+                    gateway_baseline = router.fleet_stats()[
+                        "gateway_totals"
+                    ]
                 serve_bench_fleet(
                     router,
                     n_agents=reference.n_agents,
@@ -1717,6 +1933,15 @@ def cmd_serve_bench(args) -> int:
                     slo_ms=args.slo_ms,
                     probe_interval_s=0.05,
                     emit=lambda row: (sink.emit(row), router_tel.emit(row)),
+                    unauth_router=unauth_router,
+                    # Process relaunches pay a child's full startup; wait
+                    # for the supervisor's relaunch so the headline's
+                    # fleet stats SHOW the restarted replica.
+                    chaos_join_grace_s=180.0 if process_mode else 10.0,
+                    recover_wait_s=180.0 if (
+                        process_mode and plan is not None
+                    ) else 0.0,
+                    gateway_baseline=gateway_baseline,
                     extra_headline={
                         "config_hash": reference.manifest.get("config_hash"),
                         "implementation": reference.manifest.get(
@@ -1725,11 +1950,21 @@ def cmd_serve_bench(args) -> int:
                         "n_agents": reference.n_agents,
                         "max_batch": args.max_batch,
                         "max_wait_ms": round(args.max_wait_ms, 3),
+                        "process_mode": process_mode,
                     },
                 )
             finally:
                 fleet.stop_all()
                 router_tel.close()
+                # The bench minted these credentials/plans for ITS fleet
+                # only — a live signing secret must not outlive the
+                # processes it authorized.
+                for path in (secret_file, plan_file):
+                    if path is not None:
+                        try:
+                            _os.unlink(path)
+                        except OSError:
+                            pass
             return 0
         if getattr(args, "network", False):
             # Wire-level mode: the same open-loop schedule, fired over real
@@ -1856,11 +2091,19 @@ def cmd_serve_gateway(args) -> int:
     traffic at runtime. Without ``--bundle``, a fresh-init bundle for the
     configured setting is exported first (the smoke path).
 
-    Prints one ``gateway_listening`` JSON line (host, resolved port,
-    registered bundle hashes) once the socket accepts, then serves until
-    SIGINT/Ctrl-C (or ``--serve-seconds``), drains in-flight requests, and
-    optionally writes the final ``/stats`` snapshot to ``--stats-out``
-    (the ``GATEWAY_STATS_*.json`` capture schema).
+    Prints one ``gateway_listening`` JSON line (host, resolved port + mux
+    port, registered bundle hashes) once the socket accepts, then serves
+    until SIGINT/Ctrl-C (or ``--serve-seconds``), drains in-flight
+    requests, and optionally writes the final ``/stats`` snapshot to
+    ``--stats-out`` (the ``GATEWAY_STATS_*.json`` capture schema).
+
+    Process-fleet flags (serve/procfleet.py spawns this command per
+    replica): ``--mux-port`` serves the persistent multiplexed wire,
+    ``--tls-cert``/``--tls-key`` terminate TLS on both listeners,
+    ``--auth-secret-file`` enforces per-household bearer tokens
+    (``serve-token``), ``--replica-id``/``--restarts`` identify the
+    replica to fleet stats, and ``--chaos-plan`` builds this replica's
+    deterministic fault injector.
     """
     import asyncio
 
@@ -1885,6 +2128,29 @@ def cmd_serve_gateway(args) -> int:
             file=sys.stderr,
             flush=True,
         )
+    tls = None
+    if bool(getattr(args, "tls_cert", None)) != bool(
+        getattr(args, "tls_key", None)
+    ):
+        raise SystemExit("pass --tls-cert AND --tls-key together")
+    if getattr(args, "tls_cert", None):
+        from p2pmicrogrid_tpu.serve import server_ssl_context
+
+        tls = server_ssl_context(args.tls_cert, args.tls_key)
+    authenticator = None
+    if getattr(args, "auth_secret_file", None):
+        from p2pmicrogrid_tpu.serve import TokenAuthenticator, load_secret
+
+        authenticator = TokenAuthenticator(load_secret(args.auth_secret_file))
+    fault_injector = None
+    if getattr(args, "chaos_plan", None):
+        from p2pmicrogrid_tpu.serve import FaultInjector, FaultPlan
+
+        with open(args.chaos_plan) as f:
+            plan = FaultPlan.from_json(f.read())
+        fault_injector = FaultInjector(
+            plan, getattr(args, "replica_id", None) or "replica-0"
+        )
     gateway = build_gateway(
         bundles,
         max_batch=args.max_batch,
@@ -1898,9 +2164,17 @@ def cmd_serve_gateway(args) -> int:
         ),
         host=args.host,
         port=args.port,
+        mux_port=getattr(args, "mux_port", None),
+        tls=tls,
+        authenticator=authenticator,
+        replica_id=getattr(args, "replica_id", None),
+        restarts=getattr(args, "restarts", 0),
+        fault_injector=fault_injector,
     )
 
     async def run() -> None:
+        import os as _os
+
         host, port = await gateway.start()
         print(
             json.dumps(
@@ -1908,6 +2182,11 @@ def cmd_serve_gateway(args) -> int:
                     "kind": "gateway_listening",
                     "host": host,
                     "port": port,
+                    "mux_port": gateway.mux_port,
+                    "tls": tls is not None,
+                    "auth": authenticator is not None,
+                    "replica_id": gateway.replica_id,
+                    "pid": _os.getpid(),
                     "bundles": gateway.registry.hashes,
                     "default": gateway.registry.default_hash,
                 }
@@ -1930,6 +2209,157 @@ def cmd_serve_gateway(args) -> int:
         with open(args.stats_out, "w") as f:
             json.dump(gateway.stats_snapshot(), f, indent=2)
         print(f"serve-gateway: stats -> {args.stats_out}", file=sys.stderr)
+    return 0
+
+
+def cmd_serve_token(args) -> int:
+    """Mint fleet secrets and per-household bearer tokens (serve/auth.py).
+
+    ``--new-secret PATH`` writes a fresh 32-byte fleet secret (mode 0600)
+    — distribute it to every gateway/router process. With ``--secret-file``
+    plus ``--household`` (or ``--wildcard`` for the operator credential),
+    prints one signed bearer token on stdout, optionally bounded by
+    ``--ttl-s``. Verification (`--verify TOKEN`) prints the claims.
+    """
+    from p2pmicrogrid_tpu.serve import auth as serve_auth
+
+    if args.new_secret:
+        serve_auth.generate_secret(args.new_secret)
+        print(f"serve-token: secret -> {args.new_secret}", file=sys.stderr)
+        return 0
+    if not args.secret_file:
+        raise SystemExit("pass --new-secret PATH, or --secret-file PATH")
+    secret = serve_auth.load_secret(args.secret_file)
+    if args.verify:
+        try:
+            claims = serve_auth.verify_token(secret, args.verify)
+        except serve_auth.AuthError as err:
+            print(json.dumps({"valid": False, "error": str(err),
+                              "status": err.status}))
+            return 1
+        print(json.dumps({"valid": True, **claims}))
+        return 0
+    household = (
+        serve_auth.WILDCARD_HOUSEHOLD if args.wildcard else args.household
+    )
+    if not household:
+        raise SystemExit(
+            "pass --household ID (or --wildcard for the operator token)"
+        )
+    print(serve_auth.mint_token(secret, household, ttl_s=args.ttl_s))
+    return 0
+
+
+def cmd_serve_router(args) -> int:
+    """Run the fleet router as a standalone proxy process (serve/proxy.py).
+
+    ``--replica host:port[/muxport]`` (repeat per replica) names the
+    gateway fleet; the proxy terminates TLS + per-household auth at its
+    own socket and forwards over the persistent multiplexed wire with the
+    router's retry/failover/health discipline. Prints one
+    ``router_listening`` JSON line, serves until Ctrl-C (or
+    ``--serve-seconds``), optionally writing the final fleet-stats
+    snapshot to ``--stats-out``.
+    """
+    import asyncio
+
+    from p2pmicrogrid_tpu.serve import (
+        FleetRouter,
+        Replica,
+        RetryPolicy,
+        RouterProxy,
+    )
+
+    replicas = []
+    for i, spec in enumerate(args.replica or []):
+        addr, _, mux = spec.partition("/")
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit() or (mux and not mux.isdigit()):
+            raise SystemExit(
+                f"--replica must be host:port[/muxport], got {spec!r}"
+            )
+        replicas.append(Replica(
+            replica_id=f"replica-{i}", host=host, port=int(port),
+            mux_port=int(mux) if mux else None,
+        ))
+    if not replicas:
+        raise SystemExit("pass at least one --replica host:port[/muxport]")
+
+    if bool(args.tls_cert) != bool(args.tls_key):
+        raise SystemExit("pass --tls-cert AND --tls-key together")
+    backend_ssl = None
+    if args.backend_cafile:
+        from p2pmicrogrid_tpu.serve import client_ssl_context
+
+        backend_ssl = client_ssl_context(args.backend_cafile)
+    tls = None
+    if args.tls_cert:
+        from p2pmicrogrid_tpu.serve import server_ssl_context
+
+        tls = server_ssl_context(args.tls_cert, args.tls_key)
+    authenticator = router_token = None
+    if args.auth_secret_file:
+        from p2pmicrogrid_tpu.serve import TokenAuthenticator, load_secret
+
+        authenticator = TokenAuthenticator(load_secret(args.auth_secret_file))
+        # The router's own credential toward the replicas: the operator
+        # wildcard (it probes /stats and pushes /admin/swap).
+        router_token = authenticator.mint("*")
+
+    router = FleetRouter(
+        replicas,
+        retry=RetryPolicy(
+            max_attempts=args.retry_attempts,
+            deadline_s=args.retry_deadline_s,
+        ),
+        ssl_context=backend_ssl,
+        token=router_token,
+    )
+    proxy = RouterProxy(
+        router, host=args.host, port=args.port,
+        mux_port=getattr(args, "mux_port", None),
+        tls=tls, authenticator=authenticator,
+    )
+
+    async def run() -> None:
+        import os as _os
+
+        host, port = await proxy.start()
+        router.start_probing(args.probe_interval_s)
+        print(
+            json.dumps({
+                "kind": "router_listening",
+                "host": host,
+                "port": port,
+                "mux_port": proxy.mux_port,
+                "tls": tls is not None,
+                "auth": authenticator is not None,
+                "pid": _os.getpid(),
+                "replicas": [
+                    {"replica_id": r.replica_id, "host": r.host,
+                     "port": r.port, "mux_port": r.mux_port}
+                    for r in replicas
+                ],
+            }),
+            flush=True,
+        )
+        try:
+            if args.serve_seconds > 0:
+                await asyncio.sleep(args.serve_seconds)
+            else:
+                await asyncio.Event().wait()
+        finally:
+            router.stop_probing()
+            await proxy.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    if args.stats_out:
+        with open(args.stats_out, "w") as f:
+            json.dump(router.fleet_stats(), f, indent=2)
+        print(f"serve-router: stats -> {args.stats_out}", file=sys.stderr)
     return 0
 
 
@@ -2698,6 +3128,30 @@ def main(argv=None) -> int:
                    dest="retry_deadline_s",
                    help="client retry policy: per-request deadline in "
                         "seconds (default 15)")
+    p.add_argument("--process", action="store_true",
+                   help="--fleet: spawn each replica as a REAL subprocess "
+                        "(serve-gateway children) under a relaunch "
+                        "supervisor; chaos kills become SIGKILLs "
+                        "(FLEET_PROC_*.jsonl captures)")
+    p.add_argument("--tls", action="store_true",
+                   help="--fleet: terminate TLS at every replica (test "
+                        "certs auto-generated under artifacts/tls/, never "
+                        "committed)")
+    p.add_argument("--auth", action="store_true",
+                   help="--fleet: enforce per-household bearer tokens "
+                        "(fresh fleet secret; the router holds the "
+                        "operator wildcard) and run the 401 auth probe "
+                        "after the schedule")
+    p.add_argument("--transport", choices=["auto", "http", "mux"],
+                   default="auto", dest="fleet_transport",
+                   help="--fleet: client wire — auto (default) prefers "
+                        "each replica's persistent multiplexed listener; "
+                        "http forces the per-request-connection client")
+    p.add_argument("--wire-compare", action="store_true",
+                   dest="wire_compare",
+                   help="--fleet: emit a wire_comparison row first — the "
+                        "same open-loop schedule through per-request HTTP "
+                        "vs the persistent mux wire against replica-0")
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser(
@@ -2748,7 +3202,104 @@ def main(argv=None) -> int:
     p.add_argument("--stats-out", dest="stats_out",
                    help="write the final /stats snapshot JSON here on exit "
                         "(the GATEWAY_STATS_*.json capture schema)")
+    p.add_argument("--mux-port", type=_nonneg_int, default=None,
+                   dest="mux_port",
+                   help="also serve the persistent multiplexed framed "
+                        "wire on this port (0 = ephemeral; resolved port "
+                        "rides the gateway_listening line; omitted = "
+                        "HTTP/1.1 only)")
+    p.add_argument("--tls-cert", dest="tls_cert",
+                   help="TLS certificate PEM; terminates TLS on both "
+                        "listeners (pair with --tls-key)")
+    p.add_argument("--tls-key", dest="tls_key",
+                   help="TLS private-key PEM (pair with --tls-cert; keep "
+                        "OUT of the repo — the schema checker refuses "
+                        "committed keys)")
+    p.add_argument("--auth-secret-file", dest="auth_secret_file",
+                   help="fleet secret file (serve-token --new-secret): "
+                        "enforce per-household bearer tokens on /v1/act "
+                        "and the operator wildcard on /stats + /admin/*")
+    p.add_argument("--replica-id", dest="replica_id",
+                   help="this replica's fleet identity (rides /readyz, "
+                        "/stats and the fault injector's coins)")
+    p.add_argument("--restarts", type=_nonneg_int, default=0,
+                   help="relaunch count (the process-fleet supervisor "
+                        "passes it so fleet stats attribute churn)")
+    p.add_argument("--chaos-plan", dest="chaos_plan",
+                   help="fault-plan JSON (serve/faults.py) for this "
+                        "replica's deterministic request-fault injector")
     p.set_defaults(fn=cmd_serve_gateway)
+
+    p = sub.add_parser(
+        "serve-token",
+        help="mint fleet auth secrets and HMAC-signed per-household "
+             "bearer tokens (serve/auth.py)",
+    )
+    p.add_argument("--new-secret", dest="new_secret",
+                   help="write a fresh 32-byte fleet secret here (0600) "
+                        "and exit")
+    p.add_argument("--secret-file", dest="secret_file",
+                   help="existing fleet secret to mint/verify with")
+    p.add_argument("--household",
+                   help="household id the token authorizes")
+    p.add_argument("--wildcard", action="store_true",
+                   help="mint the operator wildcard token (any household "
+                        "+ the admin surface) instead of --household")
+    p.add_argument("--ttl-s", type=float, default=None, dest="ttl_s",
+                   help="token lifetime in seconds (default: no expiry)")
+    p.add_argument("--verify",
+                   help="verify this token against --secret-file and "
+                        "print its claims instead of minting")
+    p.set_defaults(fn=cmd_serve_token)
+
+    p = sub.add_parser(
+        "serve-router",
+        help="run the fleet router as a standalone proxy process: TLS + "
+             "per-household auth terminate here; replicas are reached "
+             "over the persistent multiplexed wire with retry/failover",
+    )
+    p.add_argument("--replica", action="append",
+                   help="replica address host:port[/muxport]; repeat per "
+                        "replica (port = HTTP endpoint, muxport = its "
+                        "persistent framed listener)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default 127.0.0.1)")
+    p.add_argument("--port", type=_nonneg_int, default=8378,
+                   help="bind port; 0 picks an ephemeral port, printed in "
+                        "the router_listening line (default 8378)")
+    p.add_argument("--mux-port", type=_nonneg_int, default=None,
+                   dest="mux_port",
+                   help="also serve the framed mux wire to clients on "
+                        "this port (0 = ephemeral; omitted = HTTP only)")
+    p.add_argument("--tls-cert", dest="tls_cert",
+                   help="front TLS certificate PEM (pair with --tls-key)")
+    p.add_argument("--tls-key", dest="tls_key",
+                   help="front TLS private-key PEM")
+    p.add_argument("--backend-cafile", dest="backend_cafile",
+                   help="CA/cert PEM to verify TLS replicas with")
+    p.add_argument("--auth-secret-file", dest="auth_secret_file",
+                   help="fleet secret: verify household tokens at the "
+                        "proxy and mint the router's wildcard credential "
+                        "toward the replicas")
+    p.add_argument("--retry-attempts", type=int, default=5,
+                   dest="retry_attempts",
+                   help="router retry policy: max attempts per request "
+                        "(default 5)")
+    p.add_argument("--retry-deadline-s", type=float, default=15.0,
+                   dest="retry_deadline_s",
+                   help="router retry policy: per-request deadline, "
+                        "seconds (default 15)")
+    p.add_argument("--probe-interval-s", type=float, default=0.5,
+                   dest="probe_interval_s",
+                   help="/readyz health-probe sweep interval, seconds "
+                        "(default 0.5)")
+    p.add_argument("--serve-seconds", type=float, default=0.0,
+                   dest="serve_seconds",
+                   help="serve this long then exit (0 = until Ctrl-C)")
+    p.add_argument("--stats-out", dest="stats_out",
+                   help="write the final fleet-stats snapshot JSON here "
+                        "on exit")
+    p.set_defaults(fn=cmd_serve_router)
 
     p = sub.add_parser(
         "telemetry-query",

@@ -14,6 +14,7 @@ The loggers accept numpy arrays straight from the simulator's ``SlotOutputs``
 
 from __future__ import annotations
 
+import json
 import sqlite3
 import time as _time
 from typing import Optional, Sequence
@@ -321,7 +322,24 @@ SELECT t.config_hash,
            AS router_ejections,
        COALESCE(SUM(CASE WHEN p.kind = 'counter'
            AND p.name = 'router.shed' THEN p.value END), 0)
-           AS router_shed
+           AS router_shed,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'router.reconnects' THEN p.value END), 0)
+           AS router_reconnects,
+       COALESCE(SUM(CASE WHEN p.kind = 'counter'
+           AND p.name = 'router.auth_denied' THEN p.value END), 0)
+           AS router_auth_denied,
+       (SELECT json_extract(p2.attrs_json, '$.processes')
+          FROM telemetry_points p2
+          JOIN telemetry_runs t2 ON t2.run_id = p2.run_id
+         WHERE t2.config_hash = t.config_hash
+           AND p2.kind = 'fleet_stats'
+           AND json_extract(p2.attrs_json, '$.processes') IS NOT NULL
+         -- seq is per-run (PRIMARY KEY (run_id, seq)); ts orders the
+         -- newest event ACROSS the runs sharing this config_hash, seq
+         -- breaks ties within one run.
+         ORDER BY p2.ts DESC, p2.seq DESC LIMIT 1)
+           AS last_processes
 FROM telemetry_runs t
 LEFT JOIN telemetry_points p ON p.run_id = t.run_id
 WHERE json_extract(t.manifest_json, '$.serve_role') IS NOT NULL
@@ -693,10 +711,20 @@ class ResultsStore:
     def query_fleet_view(self) -> list:
         """Serving runs aggregated into one fleet view per config_hash
         (``FLEET_VIEW_SQL``): replica/router run counts, serve-trace
-        totals and the router's resilience counters, as dicts."""
+        totals, the router's resilience + wire/auth counters, and the
+        newest fleet_stats event's per-replica process attribution
+        (pid / RSS / restart count), as dicts."""
         cur = self.con.execute(FLEET_VIEW_SQL)
         cols = [d[0] for d in cur.description]
-        return [dict(zip(cols, row)) for row in cur.fetchall()]
+        rows = [dict(zip(cols, row)) for row in cur.fetchall()]
+        for row in rows:
+            lp = row.get("last_processes")
+            if isinstance(lp, str):
+                try:
+                    row["last_processes"] = json.loads(lp)
+                except json.JSONDecodeError:
+                    pass
+        return rows
 
     def query_rollback_view(self) -> list:
         """Training runs aggregated into one resilience view per

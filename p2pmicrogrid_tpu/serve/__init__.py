@@ -26,6 +26,19 @@ trained policy given its observation:
 * ``faults``   deterministic, seed-driven fault injection (kill/restart,
                stall, 500s, connection drops, payload corruption) so
                chaos runs replay exactly (``serve-bench --fleet --chaos``).
+* ``wire``     the persistent multiplexed transport: length-prefixed JSON
+               frames with request ids over keep-alive connections
+               (client pool with reconnect + idempotent replay; the
+               shared server accept-loop body).
+* ``auth``     trust termination: HMAC-signed per-household bearer tokens
+               (``serve-token`` CLI) and stdlib-``ssl`` TLS helpers
+               (test certs via the system openssl).
+* ``procfleet`` real-subprocess replicas under a relaunch supervisor —
+               ``serve-bench --fleet --process`` measures SLOs through
+               actual SIGKILLs and OS process boundaries.
+* ``proxy``    the router as a standalone proxy process (``serve-router``
+               CLI): TLS + auth terminate at the fleet front, not in the
+               client library.
 """
 
 from p2pmicrogrid_tpu.serve.engine import (
@@ -62,7 +75,28 @@ from p2pmicrogrid_tpu.serve.loadgen import (
     serve_bench,
     serve_bench_network,
 )
+from p2pmicrogrid_tpu.serve.auth import (
+    AuthError,
+    TokenAuthenticator,
+    ensure_test_certs,
+    client_ssl_context,
+    generate_secret,
+    load_secret,
+    mint_token,
+    server_ssl_context,
+    verify_token,
+)
+from p2pmicrogrid_tpu.serve.loadgen import serve_bench_wire_compare
+from p2pmicrogrid_tpu.serve.procfleet import ProcessFleet
+from p2pmicrogrid_tpu.serve.proxy import ProxyServer, RouterProxy
 from p2pmicrogrid_tpu.serve.registry import BundleRegistry, ServingBundle
+from p2pmicrogrid_tpu.serve.wire import (
+    MuxConnection,
+    MuxPool,
+    WireProtocolError,
+    encode_frame,
+    read_frame,
+)
 from p2pmicrogrid_tpu.serve.router import (
     ConsistentHashRing,
     FleetRouter,
@@ -77,6 +111,7 @@ from p2pmicrogrid_tpu.serve.router import (
 
 __all__ = [
     "AdmissionConfig",
+    "AuthError",
     "BUNDLE_FORMAT_VERSION",
     "BundleRegistry",
     "ConsistentHashRing",
@@ -89,26 +124,43 @@ __all__ = [
     "GatewayServer",
     "LocalFleet",
     "MicroBatchQueue",
+    "MuxConnection",
+    "MuxPool",
     "NoHealthyReplicas",
     "PolicyEngine",
+    "ProcessFleet",
+    "ProxyServer",
     "Replica",
     "RetryBudget",
     "RetryPolicy",
+    "RouterProxy",
     "RouterResult",
     "ServeGateway",
     "ServingBundle",
     "Sessions",
+    "TokenAuthenticator",
+    "WireProtocolError",
     "build_gateway",
     "build_registry",
+    "client_ssl_context",
+    "encode_frame",
+    "ensure_test_certs",
     "export_bundle_from_checkpoint",
     "export_policy_bundle",
+    "generate_secret",
     "kill_restart_plan",
     "load_policy_bundle",
+    "load_secret",
+    "mint_token",
     "plan_open_loop",
     "poisson_arrivals",
+    "read_frame",
     "run_fleet_loadgen",
     "run_network_loadgen",
     "serve_bench",
     "serve_bench_fleet",
     "serve_bench_network",
+    "serve_bench_wire_compare",
+    "server_ssl_context",
+    "verify_token",
 ]

@@ -54,12 +54,21 @@ Design points:
   responses per request — the chaos harness the fleet router's
   retry/failover paths are tested against. ``abort()`` is the replica
   kill switch: sever every open connection with a reset, no drain.
+* **Two wires, one trust boundary (PR 9).** ``mux_port`` serves the
+  persistent multiplexed framed wire (serve/wire.py) next to HTTP/1.1
+  (which stays as the compatibility endpoint); both listeners terminate
+  TLS (``tls=ssl.SSLContext``) and enforce per-household bearer tokens
+  (``authenticator=auth.TokenAuthenticator``): 401/403 are auth sheds
+  counted on their own stats — never server errors, never retryable —
+  with the admin surface (/stats, /admin/*) gated on the operator
+  wildcard and health endpoints left open for probes.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -67,15 +76,84 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from p2pmicrogrid_tpu.serve.auth import AuthError
 from p2pmicrogrid_tpu.serve.registry import BundleRegistry, ServingBundle
+from p2pmicrogrid_tpu.serve.wire import serve_mux_connection
 
 _JSON_HEADERS = (("Content-Type", "application/json"),)
 _REASONS = {
-    200: "OK", 400: "Bad Request", 404: "Not Found",
-    405: "Method Not Allowed", 413: "Payload Too Large",
+    200: "OK", 400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
+    404: "Not Found", 405: "Method Not Allowed", 413: "Payload Too Large",
     429: "Too Many Requests", 500: "Internal Server Error",
     503: "Service Unavailable",
 }
+
+
+def _process_rss_bytes() -> int:
+    """This process's resident set (bytes) — /proc on Linux, ru_maxrss as
+    the portable fallback (peak, not current; documented in the README)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # noqa: BLE001 — stats must never fail a request
+        return 0
+
+
+def enforce_auth(check, stats: dict):
+    """Run one ``TokenAuthenticator`` check, translating an ``AuthError``
+    into the HTTP taxonomy: bump the ``auth_401``/``auth_403`` stat and
+    raise the matching ``_HttpError``. Returns the verified claims. The
+    ONE copy of the auth-shed accounting — the gateway's act/admin checks
+    and the router proxy's (serve/proxy.py) all route through here."""
+    try:
+        return check()
+    except AuthError as err:
+        stats["auth_401" if err.status == 401 else "auth_403"] += 1
+        raise _HttpError(err.status, str(err)) from None
+
+
+async def route_safely(route_call, stats: dict):
+    """Await one routing coroutine, translating failures into the wire's
+    ``(status, payload, extra_headers)`` shape: ``_HttpError`` keeps its
+    status (with ``Retry-After`` when set), anything else answers 500.
+    ``http_errors`` counts server-side failures only — 429 is an honest
+    shed and 401/403 are auth sheds with their own stats. The ONE copy of
+    this accounting, shared by the gateway's HTTP and mux fronts and the
+    router proxy's (serve/proxy.py)."""
+    try:
+        return await route_call
+    except _HttpError as err:
+        extra = (
+            [("Retry-After", f"{err.retry_after_s:g}")]
+            if err.retry_after_s is not None else []
+        )
+        if err.status not in (401, 403, 429):
+            stats["http_errors"] += 1
+        return err.status, err.payload, extra
+    except Exception as err:  # noqa: BLE001 — a handler bug must answer
+        # 500, not kill the connection loop for every other request
+        # multiplexed onto this server.
+        stats["http_errors"] += 1
+        return 500, {"error": f"{type(err).__name__}: {err}"}, []
+
+
+def bearer_token(headers: dict) -> Optional[str]:
+    """The bearer credential out of a parsed header dict (lower-cased
+    names), or None when absent."""
+    value = headers.get("authorization")
+    if not value:
+        return None
+    if value.lower().startswith("bearer "):
+        return value[7:].strip() or None
+    return value.strip() or None
 
 
 @dataclass(frozen=True)
@@ -109,6 +187,78 @@ class _HttpError(Exception):
         self.retry_after_s = retry_after_s
 
 
+_MAX_HEADERS = 128
+
+
+async def read_http_request(
+    reader, max_body_bytes: int, max_headers: int = _MAX_HEADERS
+):
+    """One HTTP/1.1 request: (method, path, headers, body), or None on a
+    cleanly closed connection. Module-level so the standalone router proxy
+    (serve/proxy.py) parses the wire exactly like the gateway does."""
+    try:
+        line = await reader.readline()
+    except ValueError:
+        # asyncio's stream limit (64 KiB) overran mid-line
+        # (LimitOverrunError is a ValueError): an abusive or broken
+        # client, not a server fault.
+        raise _HttpError(400, "request line too long") from None
+    if not line:
+        return None
+    parts = line.decode("latin-1").split()
+    if len(parts) < 3:
+        raise _HttpError(400, "malformed request line")
+    method, path = parts[0].upper(), parts[1]
+    headers = {}
+    while True:
+        if len(headers) >= max_headers:
+            # An endless header stream would grow this dict without
+            # ever reaching the body-size check — cap it.
+            raise _HttpError(400, "too many headers")
+        try:
+            h = await reader.readline()
+        except ValueError:
+            raise _HttpError(400, "header line too long") from None
+        if h in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = h.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+    try:
+        length = int(headers.get("content-length", 0))
+    except ValueError:
+        raise _HttpError(400, "malformed Content-Length") from None
+    if length > max_body_bytes:
+        raise _HttpError(
+            413,
+            f"body {length} bytes exceeds the {max_body_bytes}-byte limit",
+        )
+    body = await reader.readexactly(length) if length else b""
+    return method, path, headers, body
+
+
+async def send_http_response(
+    writer, status: int, payload: dict, extra_headers, keep_alive,
+    corrupt: bool = False,
+) -> None:
+    body = json.dumps(payload).encode()
+    if corrupt:
+        # Injected payload corruption (faults.py): same length so the
+        # HTTP framing stays valid, but 0xff bytes are never valid
+        # UTF-8/JSON — every client DETECTS the corruption instead of
+        # mistaking it for a real answer.
+        k = min(8, len(body))
+        body = b"\xff" * k + body[k:]
+    headers = [
+        f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+        f"Content-Length: {len(body)}",
+        f"Connection: {'keep-alive' if keep_alive else 'close'}",
+    ]
+    headers.extend(f"{k}: {v}" for k, v in _JSON_HEADERS)
+    headers.extend(f"{k}: {v}" for k, v in extra_headers)
+    writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
+    await writer.drain()
+
+
 class ServeGateway:
     """Asyncio HTTP front over a ``BundleRegistry``.
 
@@ -125,6 +275,10 @@ class ServeGateway:
         own_bundles: bool = False,
         fault_injector=None,
         replica_id: Optional[str] = None,
+        mux_port: Optional[int] = None,
+        tls=None,
+        authenticator=None,
+        restarts: int = 0,
     ):
         self.registry = registry
         self.admission = admission or AdmissionConfig()
@@ -137,9 +291,24 @@ class ServeGateway:
         # the failure-path tests wire one in.
         self.fault_injector = fault_injector
         self.replica_id = replica_id
+        # The persistent multiplexed listener (serve/wire.py): None keeps
+        # it off, 0 binds an ephemeral port (resolved by start()). The
+        # HTTP/1.1 port stays up regardless — the compatibility endpoint.
+        self.mux_port = mux_port
+        # ssl.SSLContext terminating TLS on BOTH listeners, or None for
+        # plaintext (in-process tests, trusted networks).
+        self.tls = tls
+        # auth.TokenAuthenticator enforcing per-household bearers on
+        # /v1/act and the operator wildcard on /stats + /admin/*; None
+        # leaves the gateway open (the pre-PR-9 behavior).
+        self.authenticator = authenticator
+        # Relaunch count (set by the process-fleet supervisor via
+        # --restarts) so fleet stats attribute churn per replica.
+        self.restarts = restarts
         self.created = time.strftime("%Y-%m-%dT%H:%M:%S%z")
         self._t0 = time.monotonic()
         self._server: Optional[asyncio.AbstractServer] = None
+        self._mux_server: Optional[asyncio.AbstractServer] = None
         self._draining = False
         self._inflight = 0
         self._idle = asyncio.Event()
@@ -154,17 +323,25 @@ class ServeGateway:
         self.stats = {
             "requests": 0, "act_requests": 0, "act_rows": 0, "act_ok": 0,
             "shed": 0, "http_errors": 0, "swaps": 0, "drained": 0,
-            "faults_injected": 0,
+            "faults_injected": 0, "auth_401": 0, "auth_403": 0,
+            "mux_connections": 0, "mux_requests": 0,
         }
 
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> Tuple[str, int]:
-        """Bind and accept; returns (host, port) — port resolved when 0."""
+        """Bind and accept; returns (host, port) — port resolved when 0.
+        With ``mux_port`` set, the framed multiplexed listener comes up
+        next to the HTTP one (``self.mux_port`` resolves its port)."""
         self._server = await asyncio.start_server(
-            self._handle_connection, self.host, self.port
+            self._handle_connection, self.host, self.port, ssl=self.tls
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.mux_port is not None:
+            self._mux_server = await asyncio.start_server(
+                self._handle_mux, self.host, self.mux_port, ssl=self.tls
+            )
+            self.mux_port = self._mux_server.sockets[0].getsockname()[1]
         # NOTE: the fault injector is deliberately NOT activated here. Its
         # windows anchor either at the harness's explicit activate() (the
         # fleet bench pins every replica to the loadgen start instant —
@@ -202,10 +379,12 @@ class ServeGateway:
                 return
             if drain:
                 await self.drain(timeout_s)
-            if self._server is not None:
-                self._server.close()
-                await self._server.wait_closed()
-                self._server = None
+            for attr in ("_server", "_mux_server"):
+                server = getattr(self, attr)
+                if server is not None:
+                    server.close()
+                    await server.wait_closed()
+                    setattr(self, attr, None)
             if self.own_bundles:
                 self.registry.close_all()
             self._stopped = True
@@ -217,9 +396,11 @@ class ServeGateway:
         (a restart reuses them warm). This is deliberately NOT stop():
         a kill must look like a crash to clients, not a rolling drain."""
         self._draining = True
-        if self._server is not None:
-            self._server.close()
-            self._server = None
+        for attr in ("_server", "_mux_server"):
+            server = getattr(self, attr)
+            if server is not None:
+                server.close()
+                setattr(self, attr, None)
         for writer in list(self._conns):
             transport = writer.transport
             if transport is not None:
@@ -277,27 +458,17 @@ class ServeGateway:
                         break
                     if fault.kind == "stall":
                         await asyncio.sleep(fault.stall_s)
-                try:
+                async def _call(fault=fault, method=method, path=path,
+                                body=body, headers=headers):
                     if fault is not None and fault.kind == "error":
                         raise _HttpError(500, "injected fault")
-                    status, payload, extra = await self._route(
-                        method, path, body
+                    return await self._route(
+                        method, path, body, token=bearer_token(headers)
                     )
-                except _HttpError as err:
-                    status, payload = err.status, err.payload
-                    extra = (
-                        [("Retry-After", f"{err.retry_after_s:g}")]
-                        if err.retry_after_s is not None else []
-                    )
-                    if status != 429:
-                        self.stats["http_errors"] += 1
-                except Exception as err:  # noqa: BLE001 — a handler bug must
-                    # answer 500, not kill the connection loop for every
-                    # other household multiplexed onto this server.
-                    status = 500
-                    payload = {"error": f"{type(err).__name__}: {err}"}
-                    extra = []
-                    self.stats["http_errors"] += 1
+
+                status, payload, extra = await route_safely(
+                    _call(), self.stats
+                )
                 keep_alive = headers.get("connection", "").lower() != "close"
                 await self._send(
                     writer, status, payload, extra, keep_alive,
@@ -315,76 +486,96 @@ class ServeGateway:
             except (ConnectionError, OSError):
                 pass
 
-    _MAX_HEADERS = 128
-
     async def _read_request(self, reader):
-        """One HTTP/1.1 request: (method, path, headers, body), or None on
-        a cleanly closed connection."""
-        try:
-            line = await reader.readline()
-        except ValueError:
-            # asyncio's stream limit (64 KiB) overran mid-line
-            # (LimitOverrunError is a ValueError): an abusive or broken
-            # client, not a server fault.
-            raise _HttpError(400, "request line too long") from None
-        if not line:
-            return None
-        parts = line.decode("latin-1").split()
-        if len(parts) < 3:
-            raise _HttpError(400, "malformed request line")
-        method, path = parts[0].upper(), parts[1]
-        headers = {}
-        while True:
-            if len(headers) >= self._MAX_HEADERS:
-                # An endless header stream would grow this dict without
-                # ever reaching the body-size check — cap it.
-                raise _HttpError(400, "too many headers")
-            try:
-                h = await reader.readline()
-            except ValueError:
-                raise _HttpError(400, "header line too long") from None
-            if h in (b"\r\n", b"\n", b""):
-                break
-            name, _, value = h.decode("latin-1").partition(":")
-            headers[name.strip().lower()] = value.strip()
-        try:
-            length = int(headers.get("content-length", 0))
-        except ValueError:
-            raise _HttpError(400, "malformed Content-Length") from None
-        if length > self.admission.max_body_bytes:
-            raise _HttpError(
-                413,
-                f"body {length} bytes exceeds the "
-                f"{self.admission.max_body_bytes}-byte limit",
-            )
-        body = await reader.readexactly(length) if length else b""
-        return method, path, headers, body
+        return await read_http_request(reader, self.admission.max_body_bytes)
 
     async def _send(
         self, writer, status: int, payload: dict, extra_headers, keep_alive,
         corrupt: bool = False,
     ) -> None:
-        body = json.dumps(payload).encode()
-        if corrupt:
-            # Injected payload corruption (faults.py): same length so the
-            # HTTP framing stays valid, but 0xff bytes are never valid
-            # UTF-8/JSON — every client DETECTS the corruption instead of
-            # mistaking it for a real answer.
-            k = min(8, len(body))
-            body = b"\xff" * k + body[k:]
-        headers = [
-            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
-            f"Content-Length: {len(body)}",
-            f"Connection: {'keep-alive' if keep_alive else 'close'}",
-        ]
-        headers.extend(f"{k}: {v}" for k, v in _JSON_HEADERS)
-        headers.extend(f"{k}: {v}" for k, v in extra_headers)
-        writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
-        await writer.drain()
+        await send_http_response(
+            writer, status, payload, extra_headers, keep_alive,
+            corrupt=corrupt,
+        )
+
+    # -- the multiplexed listener --------------------------------------------
+
+    async def _mux_route(self, method: str, path: str, body_doc, token):
+        """One mux frame's request through the SAME routing/admission/auth
+        path HTTP requests take (the frame body re-serializes so /v1/act
+        and /admin/swap parse identically on both wires)."""
+        self.stats["requests"] += 1
+        self.stats["mux_requests"] += 1
+        body = json.dumps(body_doc).encode() if body_doc is not None else b""
+        return await route_safely(
+            self._route(method, path, body, token=token), self.stats
+        )
+
+    def _on_mux_fault(self, fault) -> None:
+        self.stats["faults_injected"] += 1
+        if fault.kind == "error":
+            # Mirror the HTTP path, where the injected 500 raises
+            # _HttpError through route_safely and counts as a server
+            # error: identical fault plans must produce identical
+            # http_errors totals on both wires.
+            self.stats["http_errors"] += 1
+
+    async def _handle_mux(self, reader, writer) -> None:
+        self._conns.add(writer)
+        self.stats["mux_connections"] += 1
+        try:
+            await serve_mux_connection(
+                reader, writer, self._mux_route,
+                max_frame_bytes=self.admission.max_body_bytes,
+                fault_decide=(
+                    self.fault_injector.decide
+                    if self.fault_injector is not None else None
+                ),
+                on_fault=self._on_mux_fault,
+            )
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
 
     # -- routing -------------------------------------------------------------
 
-    async def _route(self, method: str, path: str, body: bytes):
+    def _check_act_auth(self, token, household) -> Optional[str]:
+        """Per-household bearer check for /v1/act (no-op with auth off).
+        401 = authenticates nobody, 403 = wrong household; both are
+        counted as auth sheds, not server errors, and clients treat them
+        as terminal (never retried, never charged to the retry budget).
+
+        Returns the EFFECTIVE household: a request that omits the field
+        while presenting a non-wildcard token routes as the token's
+        household — the token IS the identity, and letting an
+        authenticated household drop the field would let it escape its
+        A/B-split pinning into the default bundle."""
+        if self.authenticator is None:
+            return household
+        claims = enforce_auth(
+            lambda: self.authenticator.check(token, household),
+            self.stats,
+        )
+        from p2pmicrogrid_tpu.serve.auth import WILDCARD_HOUSEHOLD
+
+        claimed = claims.get("household")
+        if household is None and claimed != WILDCARD_HOUSEHOLD:
+            return claimed
+        return household
+
+    def _check_admin_auth(self, token) -> None:
+        """Operator-wildcard check for /stats + /admin/* (no-op with auth
+        off). Health endpoints stay open — load balancers probe them."""
+        if self.authenticator is not None:
+            enforce_auth(
+                lambda: self.authenticator.check_admin(token), self.stats
+            )
+
+    async def _route(self, method: str, path: str, body: bytes, token=None):
         if path == "/healthz":
             if method != "GET":
                 raise _HttpError(405, "GET only")
@@ -409,18 +600,21 @@ class ServeGateway:
         if path == "/stats":
             if method != "GET":
                 raise _HttpError(405, "GET only")
+            self._check_admin_auth(token)
             return 200, self.stats_snapshot(), []
         if path == "/v1/act":
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._act(body)
+            return await self._act(body, token=token)
         if path == "/admin/swap":
             if method != "POST":
                 raise _HttpError(405, "POST only")
+            self._check_admin_auth(token)
             return self._swap(body)
         if path == "/admin/drain":
             if method != "POST":
                 raise _HttpError(405, "POST only")
+            self._check_admin_auth(token)
             self.begin_drain()
             return 200, {"draining": True, "inflight": self._inflight}, []
         raise _HttpError(404, f"no route {path}")
@@ -488,7 +682,7 @@ class ServeGateway:
                     retry_after_s=adm.retry_after_s,
                 )
 
-    async def _act(self, body: bytes):
+    async def _act(self, body: bytes, token=None):
         self.stats["act_requests"] += 1
         if self._draining:
             raise _HttpError(
@@ -499,6 +693,10 @@ class ServeGateway:
         household = doc.get("household")
         if household is not None and not isinstance(household, str):
             raise _HttpError(400, "household must be a string")
+        # Auth BEFORE admission: an unauthenticated request must be
+        # refused at the door, never counted against (or shed by) the
+        # capacity budgets honest households share.
+        household = self._check_act_auth(token, household)
         try:
             bundle = self.registry.route(household)
         except RuntimeError as err:
@@ -627,6 +825,19 @@ class ServeGateway:
             "created": self.created,
             "uptime_s": self.uptime_s,
             "draining": self._draining,
+            # Process identity: in process-fleet mode every replica is its
+            # own pid, so fleet stats attribute RSS + restart churn per
+            # replica (in-process fleets share one pid — also true).
+            "process": {
+                "pid": os.getpid(),
+                "rss_bytes": _process_rss_bytes(),
+                "restarts": self.restarts,
+            },
+            "wire": {
+                "mux_port": self.mux_port,
+                "tls": self.tls is not None,
+                "auth": self.authenticator is not None,
+            },
             "default": reg["default"],
             "split": reg["split"],
             "swap_count": reg["swap_count"],
@@ -738,6 +949,10 @@ def build_gateway(
     run_name: str = "gateway",
     fault_injector=None,
     replica_id: Optional[str] = None,
+    mux_port: Optional[int] = None,
+    tls=None,
+    authenticator=None,
+    restarts: int = 0,
 ) -> ServeGateway:
     """``build_registry`` + a gateway owning the result (the one-process
     serving entry point; the fleet harness composes the pieces itself)."""
@@ -753,6 +968,8 @@ def build_gateway(
     return ServeGateway(
         registry, admission=admission, host=host, port=port, own_bundles=True,
         fault_injector=fault_injector, replica_id=replica_id,
+        mux_port=mux_port, tls=tls, authenticator=authenticator,
+        restarts=restarts,
     )
 
 

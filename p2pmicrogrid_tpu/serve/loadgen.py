@@ -38,6 +38,8 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from p2pmicrogrid_tpu.serve.wire import FrameTooLarge, WireProtocolError
+
 
 # --- client retry primitives --------------------------------------------------
 #
@@ -292,6 +294,13 @@ class NetworkLoadgenResult:
     # loadgen runs in its default no-retry mode).
     retries: Optional[np.ndarray] = None
     gave_up: Optional[np.ndarray] = None
+    # Wire bookkeeping: which transport ran, and (mux) how many physical
+    # connections it cost — the whole point of the persistent wire is
+    # that wire_connects stays tiny while n_requests grows.
+    transport: str = "http"
+    wire_connects: int = 0
+    wire_reconnects: int = 0
+    wire_replays: int = 0
 
     def __post_init__(self):
         n = int(self.statuses.shape[0])
@@ -355,15 +364,21 @@ async def _http_request_json(
     path: str,
     payload: Optional[dict],
     timeout_s: float,
+    ssl=None,
+    token: Optional[str] = None,
 ):
     """One JSON request over a fresh connection; returns (status, parsed
     body, response headers). A non-empty body that fails to parse comes
     back as ``None`` (NOT ``{}``) so callers can tell payload corruption
     from an intentionally empty response and retry it. Stdlib-only
     HTTP/1.1 — mirrors the gateway's server side; the ONE copy of the
-    client framing logic (the fleet router's GETs share it)."""
+    client framing logic (the fleet router's GETs share it). ``ssl`` is a
+    client SSLContext for TLS-terminating gateways; ``token`` rides as the
+    ``Authorization: Bearer`` credential (serve/auth.py)."""
     body = json.dumps(payload).encode() if payload is not None else b""
     head = f"{method} {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+    if token is not None:
+        head += f"Authorization: Bearer {token}\r\n"
     if payload is not None:
         head += (
             "Content-Type: application/json\r\n"
@@ -371,7 +386,7 @@ async def _http_request_json(
         )
     request = (head + "Connection: close\r\n\r\n").encode() + body
     reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, port), timeout_s
+        asyncio.open_connection(host, port, ssl=ssl), timeout_s
     )
     try:
         writer.write(request)
@@ -407,11 +422,12 @@ async def _http_request_json(
 
 
 async def _http_post_json(
-    host: str, port: int, path: str, payload: dict, timeout_s: float
+    host: str, port: int, path: str, payload: dict, timeout_s: float,
+    ssl=None, token: Optional[str] = None,
 ):
     """(status, doc, headers) of one POST — see ``_http_request_json``."""
     return await _http_request_json(
-        host, port, "POST", path, payload, timeout_s
+        host, port, "POST", path, payload, timeout_s, ssl=ssl, token=token
     )
 
 
@@ -436,13 +452,28 @@ def run_network_loadgen(
     timeout_s: float = 30.0,
     retry: Optional[RetryPolicy] = None,
     retry_seed: int = 0,
+    transport: str = "http",
+    ssl=None,
+    token_fn=None,
+    mux_pool_size: int = 2,
+    mux_max_frame_bytes: Optional[int] = None,
 ) -> NetworkLoadgenResult:
     """Fire ``obs[i]`` at the gateway at ``arrivals[i]`` seconds (open loop:
     send times never wait on completions) and measure wire latencies.
 
-    One connection per request — each simulated household is an independent
-    remote client; connection reuse would serialize them onto shared
-    sockets and hide queueing the open-loop methodology exists to expose.
+    ``transport="http"`` (the committed-capture default) opens one
+    connection per request — each simulated household is an independent
+    remote client, and this is exactly the per-request wire cost the
+    persistent protocol exists to kill. ``transport="mux"`` drives the
+    SAME schedule through a shared persistent multiplexed pool
+    (serve/wire.py ``MuxPool`` against the gateway's mux listener at
+    ``port``): keep-alive framed connections, responses matched by id —
+    the head-to-head comparison ``serve-bench --wire-compare`` reports.
+
+    ``ssl`` is a client SSLContext (TLS gateways); ``token_fn(household)``
+    supplies the per-household bearer (None = unauthenticated). 401/403
+    answers are TERMINAL: never retried, never charged to the retry
+    machinery — an auth failure cannot become a retry storm.
 
     ``retry=None`` (the default) preserves the capture semantics every
     committed ``SERVE_GATEWAY_*`` row was measured under: a 429 is a
@@ -455,6 +486,8 @@ def run_network_loadgen(
     would spend. Retry sleeps are seeded (``retry_seed``) so two runs
     draw identical jitter.
     """
+    if transport not in ("http", "mux"):
+        raise ValueError(f"transport must be 'http' or 'mux', got {transport!r}")
     obs = np.asarray(obs, dtype=np.float32)  # host-sync: host-side inputs
     arrivals = np.asarray(arrivals, dtype=float)
     n = int(arrivals.shape[0])
@@ -463,16 +496,42 @@ def run_network_loadgen(
     retries = np.zeros(n, dtype=np.int64)
     gave_up = np.zeros(n, dtype=bool)
     hashes: List = [None] * n
+    pool_box: List = [None]  # MuxPool, created inside the event loop
 
-    async def attempt(payload: dict, attempt_timeout_s: float):
+    async def attempt(
+        payload: dict, attempt_timeout_s: float, token: Optional[str]
+    ):
         """(status, doc, headers); transport failures -> status -1."""
         try:
+            if transport == "mux":
+                if pool_box[0] is None:
+                    from p2pmicrogrid_tpu.serve.wire import MuxPool
+
+                    # Match the gateway's admission.max_body_bytes when
+                    # it is configured below the wire default: the
+                    # client-side cap is what makes an over-cap request
+                    # a terminal 413 instead of an unattributable hang.
+                    kw = {}
+                    if mux_max_frame_bytes is not None:
+                        kw["max_frame_bytes"] = mux_max_frame_bytes
+                    pool_box[0] = MuxPool(
+                        host, port, size=mux_pool_size, ssl=ssl, **kw
+                    )
+                return await pool_box[0].request(
+                    path, payload, attempt_timeout_s, token=token
+                )
             return await _http_post_json(
-                host, port, path, payload, attempt_timeout_s
+                host, port, path, payload, attempt_timeout_s,
+                ssl=ssl, token=token,
             )
+        except FrameTooLarge as err:
+            # Over-cap REQUEST on the mux wire: the terminal 413 the HTTP
+            # wire answers for the same payload, not a transport failure.
+            return 413, {"error": str(err)}, {}
         except (
             ConnectionError, OSError, EOFError, ValueError,
             asyncio.TimeoutError, asyncio.IncompleteReadError,
+            WireProtocolError,  # malformed peer frames (mux transport)
         ):
             # Transport failures score as status -1 (n_errors), they must
             # not abort the whole open-loop schedule mid-run.
@@ -482,10 +541,9 @@ def run_network_loadgen(
         delay = (arrivals[i] - arrivals[0]) - (time.perf_counter() - t0)
         if delay > 0:
             await asyncio.sleep(delay)
-        payload = {
-            "household": households[i % len(households)],
-            "obs": obs[i].tolist(),
-        }
+        household = households[i % len(households)]
+        payload = {"household": household, "obs": obs[i].tolist()}
+        token = token_fn(household) if token_fn is not None else None
         rng = random.Random((retry_seed << 20) ^ i)
         t_send = time.perf_counter()
         deadline = t_send + (retry.deadline_s if retry else timeout_s)
@@ -497,13 +555,18 @@ def run_network_loadgen(
             attempt_timeout = timeout_s if retry is None else max(
                 0.05, min(timeout_s, deadline - time.perf_counter())
             )
-            status, doc, headers = await attempt(payload, attempt_timeout)
+            status, doc, headers = await attempt(
+                payload, attempt_timeout, token
+            )
             tries += 1
             # A 200 whose payload failed to parse is a corrupt answer —
             # retryable, never reported as success.
             corrupt = status == 200 and doc is None
             ok = status == 200 and not corrupt
-            terminal_client_err = status in (400, 404, 405, 413)
+            # 401/403 join the terminal set: retrying a rejected
+            # credential cannot succeed and must not consume the retry
+            # machinery honest failures depend on.
+            terminal_client_err = status in (400, 401, 403, 404, 405, 413)
             if corrupt:
                 status = -1
             if (
@@ -529,10 +592,15 @@ def run_network_loadgen(
 
     async def run() -> float:
         t0 = time.perf_counter()
-        await asyncio.gather(*(one(i, t0) for i in range(n)))
+        try:
+            await asyncio.gather(*(one(i, t0) for i in range(n)))
+        finally:
+            if pool_box[0] is not None:
+                await pool_box[0].close()
         return time.perf_counter() - t0
 
     makespan = asyncio.run(run())
+    pool = pool_box[0]
     return NetworkLoadgenResult(
         latencies_s=latencies,
         statuses=statuses,
@@ -540,6 +608,10 @@ def run_network_loadgen(
         makespan_s=makespan,
         retries=retries,
         gave_up=gave_up,
+        transport=transport,
+        wire_connects=pool.connects if pool is not None else 0,
+        wire_reconnects=pool.reconnects if pool is not None else 0,
+        wire_replays=pool.replays if pool is not None else 0,
     )
 
 
@@ -556,6 +628,9 @@ def serve_bench_network(
     emit: Optional[Callable[[dict], None]] = None,
     extra_headline: Optional[dict] = None,
     retry: Optional[RetryPolicy] = None,
+    transport: str = "http",
+    ssl=None,
+    token_fn=None,
 ) -> List[dict]:
     """Wire-level SLO benchmark: the serve-bench schedule over real sockets.
 
@@ -564,7 +639,9 @@ def serve_bench_network(
     SLO headroom for latency rows, served/offered for throughput, and the
     served fraction (1 - shed_rate) for the shed row. With ``retry`` the
     client retries sheds/transients (see ``run_network_loadgen``) and the
-    headline grows ``retry_rate``/``n_gave_up``.
+    headline grows ``retry_rate``/``n_gave_up``. ``transport``/``ssl``/
+    ``token_fn`` select the wire (see ``run_network_loadgen``); with
+    ``transport="mux"``, ``port`` is the gateway's MUX port.
     """
     arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
     obs = synthetic_obs(n_requests, n_agents, seed=seed)
@@ -572,6 +649,7 @@ def serve_bench_network(
     result = run_network_loadgen(
         host, port, obs, arrivals, households, timeout_s=timeout_s,
         retry=retry, retry_seed=seed,
+        transport=transport, ssl=ssl, token_fn=token_fn,
     )
     p50, p95, p99 = (result.latency_ms(q) for q in (50, 95, 99))
     rows = [
@@ -620,6 +698,11 @@ def serve_bench_network(
             "retry_rate": round(result.retry_rate, 4),
             "n_gave_up": result.n_gave_up,
             "retry_enabled": retry is not None,
+            "transport": transport,
+            "tls": ssl is not None,
+            "auth": token_fn is not None,
+            "wire_connects": result.wire_connects,
+            "wire_reconnects": result.wire_reconnects,
             "n_households": n_households,
             "offered_rate_rps": rate_hz,
             "slo_ms": slo_ms,
@@ -631,6 +714,62 @@ def serve_bench_network(
         for row in rows:
             emit(row)
     return rows
+
+
+def serve_bench_wire_compare(
+    host: str,
+    http_port: int,
+    mux_port: int,
+    n_agents: int,
+    rate_hz: float = 256.0,
+    n_requests: int = 512,
+    n_households: int = 16,
+    seed: int = 0,
+    timeout_s: float = 30.0,
+    ssl=None,
+    token_fn=None,
+    emit: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """The per-request-connection client vs the persistent multiplexed
+    wire, SAME open-loop schedule and observations, one ``wire_comparison``
+    row: per-transport p50/p95/p99 and the mux/http speedups. This is the
+    acceptance measurement for the persistent wire — the committed
+    ``FLEET_PROC_*`` captures carry it next to the chaos headline."""
+    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    obs = synthetic_obs(n_requests, n_agents, seed=seed)
+    households = [f"house-{i:04d}" for i in range(n_households)]
+    results = {}
+    for transport, port in (("http", http_port), ("mux", mux_port)):
+        results[transport] = run_network_loadgen(
+            host, port, obs, arrivals, households, timeout_s=timeout_s,
+            transport=transport, ssl=ssl, token_fn=token_fn,
+        )
+    http_r, mux_r = results["http"], results["mux"]
+    p95_http, p95_mux = http_r.latency_ms(95), mux_r.latency_ms(95)
+    row = {
+        "metric": "wire_comparison",
+        "value": round(p95_http / p95_mux, 3) if p95_mux > 0 else 0.0,
+        "unit": "x_p95_speedup",
+        # >= 1.0 means the persistent wire beats per-request connections
+        # on p95 — the acceptance bar.
+        "vs_baseline": round(p95_http / p95_mux, 3) if p95_mux > 0 else 0.0,
+        "n_requests": n_requests,
+        "offered_rate_rps": rate_hz,
+        "tls": ssl is not None,
+        "auth": token_fn is not None,
+        "http_p50_ms": round(http_r.latency_ms(50), 3),
+        "http_p95_ms": round(p95_http, 3),
+        "http_p99_ms": round(http_r.latency_ms(99), 3),
+        "http_n_ok": http_r.n_ok,
+        "mux_p50_ms": round(mux_r.latency_ms(50), 3),
+        "mux_p95_ms": round(p95_mux, 3),
+        "mux_p99_ms": round(mux_r.latency_ms(99), 3),
+        "mux_n_ok": mux_r.n_ok,
+        "mux_connections": mux_r.wire_connects,
+    }
+    if emit is not None:
+        emit(row)
+    return row
 
 
 def serve_bench(

@@ -66,6 +66,7 @@ import json
 import random
 import threading
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -81,20 +82,38 @@ from p2pmicrogrid_tpu.serve.loadgen import (
     poisson_arrivals,
     synthetic_obs,
 )
+from p2pmicrogrid_tpu.serve.wire import (
+    FrameTooLarge,
+    MuxPool,
+    WireProtocolError,
+)
 
+# WireProtocolError covers a peer answering malformed frames (version
+# skew, corruption): act() must score it as one failed request, never let
+# it escape and crash the caller's gather.
 _TRANSPORT_ERRORS = (
     ConnectionError, OSError, EOFError, ValueError,
-    asyncio.TimeoutError, asyncio.IncompleteReadError,
+    asyncio.TimeoutError, asyncio.IncompleteReadError, WireProtocolError,
 )
+
+# Client errors that re-routing or retrying cannot fix: the REQUEST (or its
+# credential) is wrong, not the replica. 401/403 matter here: a rejected
+# bearer must be terminal — it never consumes the retry budget, so garbage
+# credentials cannot starve the budget honest retries depend on.
+_TERMINAL_CLIENT_STATUSES = (400, 401, 403, 404, 405, 413)
 
 
 @dataclass(frozen=True)
 class Replica:
-    """One addressable gateway replica."""
+    """One addressable gateway replica. ``mux_port`` is the persistent
+    multiplexed listener (serve/wire.py) when the replica exposes one —
+    the router prefers it; ``port`` stays the HTTP/1.1 compatibility
+    endpoint (probes, swaps, stats)."""
 
     replica_id: str
     host: str
     port: int
+    mux_port: Optional[int] = None
 
 
 class NoHealthyReplicas(RuntimeError):
@@ -231,9 +250,18 @@ class FleetRouter:
         shed_retry_after_s: float = 1.0,
         telemetry=None,
         jitter_seed: int = 0,
+        ssl_context=None,
+        token: Optional[str] = None,
+        transport: str = "auto",
+        mux_pool_size: int = 2,
+        mux_max_frame_bytes: Optional[int] = None,
     ):
         if not replicas:
             raise ValueError("pass at least one replica")
+        if transport not in ("auto", "http", "mux"):
+            raise ValueError(
+                f"transport must be 'auto', 'http' or 'mux', got {transport!r}"
+            )
         self.retry = retry or RetryPolicy()
         self.budget = budget or RetryBudget()
         self.fail_threshold = fail_threshold
@@ -242,10 +270,43 @@ class FleetRouter:
         self.request_timeout_s = request_timeout_s
         self.shed_retry_after_s = shed_retry_after_s
         self.telemetry = telemetry
+        # Trust termination toward the replicas: a client SSLContext when
+        # the fleet serves TLS, and the router's own bearer (normally the
+        # operator wildcard — it must probe /stats and push /admin/swap).
+        self.ssl_context = ssl_context
+        self.token = token
+        # 'auto' uses a replica's mux listener when it advertises one and
+        # falls back to per-request HTTP; 'http'/'mux' force a wire.
+        self.transport = transport
+        self.mux_pool_size = mux_pool_size
+        # MUST match the replicas' admission.max_body_bytes when that is
+        # configured below the 1 MiB wire default: the client-side cap is
+        # what turns an over-cap request into a terminal 413 here — with
+        # a larger client cap the server drains + answers an id-less 413
+        # the pool cannot attribute, and the request dies as a timeout
+        # that (wrongly) penalizes replica health.
+        self.mux_max_frame_bytes = mux_max_frame_bytes
+        # Mux pools are event-loop-bound (asyncio futures); tests drive
+        # act() through many short-lived loops, so pools key on the loop
+        # weakly — a dead loop's pools (and their sockets) fall away with
+        # it instead of poisoning the next loop's requests.
+        self._mux_pools: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
         self._lock = threading.RLock()
         self._ring = ConsistentHashRing(vnodes=vnodes)
         self._state: Dict[str, _ReplicaState] = {}
         self._order: List[str] = []
+        if transport == "mux":
+            # Fail at construction, not as per-request "transport errors"
+            # that would eject every (healthy) replica and read as a
+            # fleet-wide outage instead of a configuration mistake.
+            missing = [r.replica_id for r in replicas if r.mux_port is None]
+            if missing:
+                raise ValueError(
+                    "transport='mux' but replica(s) advertise no "
+                    f"mux_port: {', '.join(missing)}"
+                )
         for r in replicas:
             self._state[r.replica_id] = _ReplicaState(replica=r)
             self._order.append(r.replica_id)
@@ -261,6 +322,7 @@ class FleetRouter:
             "ejections": 0, "readmissions": 0, "shed": 0,
             "budget_denied": 0, "corrupt_detected": 0, "swaps": 0,
             "swap_aligns": 0, "probes": 0, "backoff_ms": 0.0,
+            "reconnects": 0, "auth_denied": 0,
         }
 
     # -- counters / telemetry ------------------------------------------------
@@ -273,6 +335,73 @@ class FleetRouter:
             self.counters[name] += inc
             if self.telemetry is not None:
                 self.telemetry.counter(f"router.{name}", inc)
+
+    # -- wire ----------------------------------------------------------------
+
+    def _http_conn(self, rep: Replica, timeout_s: float):
+        """A synchronous probe/stats connection honoring the fleet TLS."""
+        if self.ssl_context is not None:
+            return http.client.HTTPSConnection(
+                rep.host, rep.port, timeout=timeout_s,
+                context=self.ssl_context,
+            )
+        return http.client.HTTPConnection(rep.host, rep.port, timeout=timeout_s)
+
+    def _auth_headers(self) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self.token is not None:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
+
+    def _pool_for(self, rep: Replica) -> Optional[MuxPool]:
+        """The replica's persistent mux pool on the RUNNING loop, or None
+        when this replica (or the configured transport) is HTTP-only.
+        (transport='mux' against mux-less replicas is rejected at
+        construction, so the fall-through here is always intentional.)"""
+        if self.transport == "http" or rep.mux_port is None:
+            return None
+        loop = asyncio.get_running_loop()
+        pools = self._mux_pools.get(loop)
+        if pools is None:
+            pools = {}
+            self._mux_pools[loop] = pools
+        pool = pools.get(rep.replica_id)
+        if pool is None:
+            kw = {}
+            if self.mux_max_frame_bytes is not None:
+                kw["max_frame_bytes"] = self.mux_max_frame_bytes
+            pool = MuxPool(
+                rep.host, rep.mux_port, size=self.mux_pool_size,
+                ssl=self.ssl_context,
+                on_reconnect=lambda: self._bump("reconnects"),
+                **kw,
+            )
+            pools[rep.replica_id] = pool
+        return pool
+
+    async def _post_act(self, rep: Replica, payload: dict, timeout_s: float):
+        """(status, doc, headers) over the replica's preferred wire. Pool
+        replay is OFF here: the router's own retry/failover loop is the
+        retry authority — the pool reconnects, the router re-sends."""
+        pool = self._pool_for(rep)
+        if pool is not None:
+            return await pool.request(
+                "/v1/act", payload, timeout_s, token=self.token,
+                replay=False,
+            )
+        return await _http_post_json(
+            rep.host, rep.port, "/v1/act", payload, timeout_s,
+            ssl=self.ssl_context, token=self.token,
+        )
+
+    async def close_pools(self) -> None:
+        """Close the RUNNING loop's mux pools (bench teardown). Pools on
+        already-dead loops were dropped with their loops."""
+        pools = self._mux_pools.get(asyncio.get_running_loop())
+        if pools:
+            for pool in list(pools.values()):
+                await pool.close()
+            pools.clear()
 
     # -- membership / health -------------------------------------------------
 
@@ -349,9 +478,7 @@ class FleetRouter:
         return results
 
     def _probe(self, rep: Replica) -> Tuple[bool, str]:
-        conn = http.client.HTTPConnection(
-            rep.host, rep.port, timeout=self.probe_timeout_s
-        )
+        conn = self._http_conn(rep, self.probe_timeout_s)
         try:
             conn.request("GET", "/readyz")
             resp = conn.getresponse()
@@ -386,13 +513,11 @@ class FleetRouter:
     def _push_swap(self, rep: Replica, config_hash: str) -> None:
         """Best-effort synchronous ``/admin/swap`` push (probe thread)."""
         body = json.dumps({"config_hash": config_hash})
-        conn = http.client.HTTPConnection(
-            rep.host, rep.port, timeout=self.probe_timeout_s
-        )
+        conn = self._http_conn(rep, self.probe_timeout_s)
         try:
             conn.request(
                 "POST", "/admin/swap", body=body,
-                headers={"Content-Type": "application/json"},
+                headers=self._auth_headers(),
             )
             conn.getresponse().read()
         except (OSError, http.client.HTTPException):
@@ -533,8 +658,17 @@ class FleetRouter:
                 self.request_timeout_s, deadline - time.monotonic()
             ))
             try:
-                status, doc, headers = await _http_post_json(
-                    rep.host, rep.port, "/v1/act", payload, timeout
+                status, doc, headers = await self._post_act(
+                    rep, payload, timeout
+                )
+            except FrameTooLarge as err:
+                # The REQUEST is over the wire cap — the mux mirror of an
+                # HTTP 413: terminal client error, no health penalty, no
+                # failover (the same payload would "fail" every replica
+                # in turn and read as a fleet outage).
+                return RouterResult(
+                    status=413, replica_id=rid, error=str(err),
+                    retries=tries, failovers=failovers,
                 )
             except _TRANSPORT_ERRORS as err:
                 status, doc, headers = -1, None, {}
@@ -557,9 +691,12 @@ class FleetRouter:
                     retries=tries - 1,
                     failovers=failovers,
                 )
-            if status in (400, 404, 405, 413):
-                # The REQUEST is bad, not the replica — retrying the same
-                # payload elsewhere cannot help.
+            if status in _TERMINAL_CLIENT_STATUSES:
+                # The REQUEST (or its credential) is bad, not the replica
+                # — retrying the same payload elsewhere cannot help, and
+                # auth rejections must never charge the retry budget.
+                if status in (401, 403):
+                    self._bump("auth_denied")
                 return RouterResult(
                     status=status, replica_id=rid,
                     error=(doc or {}).get("error"),
@@ -617,7 +754,8 @@ class FleetRouter:
         """Async GET over a fresh connection (swap verify) — delegates
         the wire framing to loadgen's one shared HTTP client."""
         status, doc, _ = await _http_request_json(
-            rep.host, rep.port, "GET", path, None, timeout_s
+            rep.host, rep.port, "GET", path, None, timeout_s,
+            ssl=self.ssl_context, token=self.token,
         )
         return status, doc
 
@@ -656,6 +794,7 @@ class FleetRouter:
                     status, doc, _ = await _http_post_json(
                         rep.host, rep.port, "/admin/swap",
                         {"config_hash": config_hash}, timeout_s,
+                        ssl=self.ssl_context, token=self.token,
                     )
                 except _TRANSPORT_ERRORS as err:
                     raise FleetSwapError(
@@ -698,6 +837,7 @@ class FleetRouter:
                         await _http_post_json(
                             rep.host, rep.port, "/admin/swap",
                             {"config_hash": prev}, timeout_s,
+                            ssl=self.ssl_context, token=self.token,
                         )
                     except _TRANSPORT_ERRORS:
                         pass
@@ -728,18 +868,19 @@ class FleetRouter:
         totals sum whatever answered. Emitted as a ``fleet_stats`` event
         through the router telemetry (-> warehouse) when attached."""
         per_replica: Dict[str, dict] = {}
+        processes: Dict[str, dict] = {}
         totals = {
             "requests": 0, "act_requests": 0, "act_ok": 0, "act_rows": 0,
             "shed": 0, "http_errors": 0, "swaps": 0, "faults_injected": 0,
+            "auth_401": 0, "auth_403": 0, "mux_requests": 0,
+            "mux_connections": 0,
         }
         engine_totals = {"requests": 0, "batches": 0, "padded_rows": 0}
         for rid in self.replica_ids:
             rep = self.replica(rid)
-            conn = http.client.HTTPConnection(
-                rep.host, rep.port, timeout=timeout_s
-            )
+            conn = self._http_conn(rep, timeout_s)
             try:
-                conn.request("GET", "/stats")
+                conn.request("GET", "/stats", headers=self._auth_headers())
                 resp = conn.getresponse()
                 doc = json.loads(resp.read())
                 per_replica[rid] = doc
@@ -753,6 +894,16 @@ class FleetRouter:
                         v = b.get(key)
                         if isinstance(v, (int, float)):
                             engine_totals[key] += v
+                # Per-replica process attribution (pid, RSS, relaunch
+                # count) — in process mode each replica is its own pid,
+                # so memory and churn are attributable per replica.
+                proc = doc.get("process")
+                if isinstance(proc, dict):
+                    processes[rid] = {
+                        "pid": proc.get("pid"),
+                        "rss_bytes": proc.get("rss_bytes"),
+                        "restarts": proc.get("restarts"),
+                    }
             except (OSError, ValueError, http.client.HTTPException) as err:
                 per_replica[rid] = {
                     "error": f"{type(err).__name__}: {err}"
@@ -776,6 +927,8 @@ class FleetRouter:
             "n_replicas": len(per_replica),
             "n_healthy": sum(1 for h in health.values() if h["healthy"]),
             "fleet_config_hash": self.fleet_config_hash,
+            "transport": self.transport,
+            "tls": self.ssl_context is not None,
             "router": counters,
             "retry_budget": {
                 "tokens": self.budget.tokens,
@@ -785,6 +938,7 @@ class FleetRouter:
             "pinned_households": pinned,
             "gateway_totals": totals,
             "engine_totals": engine_totals,
+            "processes": processes,
             "health": health,
             "replicas": per_replica,
         }
@@ -796,6 +950,7 @@ class FleetRouter:
                 pinned_households=pinned,
                 gateway_totals=totals,
                 router=counters,
+                processes=processes,
             )
         return snapshot
 
@@ -829,6 +984,9 @@ class LocalFleet:
         fault_plan: Optional[FaultPlan] = None,
         host: str = "127.0.0.1",
         run_name: str = "fleet",
+        mux: bool = False,
+        tls=None,
+        authenticator=None,
     ):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -843,6 +1001,13 @@ class LocalFleet:
         self.fault_plan = fault_plan
         self.host = host
         self.run_name = run_name
+        # Wire/trust knobs mirrored from the gateway: each replica serves
+        # the mux listener / TLS / token auth the process fleet does, so
+        # the in-process harness exercises the same surfaces the real
+        # fleet deploys.
+        self.mux = mux
+        self.tls = tls
+        self.authenticator = authenticator
         self._lock = threading.Lock()
         self._entries: Dict[str, dict] = {}
         self.kills: List[str] = []
@@ -877,6 +1042,8 @@ class LocalFleet:
                     registry, admission=self.admission, host=self.host,
                     port=0, own_bundles=False, fault_injector=injector,
                     replica_id=rid,
+                    mux_port=0 if self.mux else None,
+                    tls=self.tls, authenticator=self.authenticator,
                 )
                 server = GatewayServer(gateway)
                 try:
@@ -892,6 +1059,7 @@ class LocalFleet:
                         "injector": injector,
                         "host": host,
                         "port": port,
+                        "mux_port": gateway.mux_port,
                         "alive": True,
                     }
         except BaseException:
@@ -903,7 +1071,10 @@ class LocalFleet:
     def replicas(self) -> List[Replica]:
         with self._lock:
             return [
-                Replica(replica_id=rid, host=e["host"], port=e["port"])
+                Replica(
+                    replica_id=rid, host=e["host"], port=e["port"],
+                    mux_port=e.get("mux_port"),
+                )
                 for rid, e in self._entries.items()
             ]
 
@@ -960,6 +1131,8 @@ class LocalFleet:
                 e["registry"], admission=self.admission, host=e["host"],
                 port=e["port"], own_bundles=False,
                 fault_injector=e["injector"], replica_id=replica_id,
+                mux_port=e.get("mux_port"),
+                tls=self.tls, authenticator=self.authenticator,
             )
             server = GatewayServer(gateway)
         server.start()
@@ -1106,7 +1279,12 @@ def run_fleet_loadgen(
 
     async def run() -> float:
         t0 = time.perf_counter()
-        await asyncio.gather(*(one(i, t0) for i in range(n)))
+        try:
+            await asyncio.gather(*(one(i, t0) for i in range(n)))
+        finally:
+            # The mux pools are bound to THIS loop: close them before it
+            # dies so their sockets FIN now, not at garbage collection.
+            await router.close_pools()
         return time.perf_counter() - t0
 
     makespan = asyncio.run(run())
@@ -1138,6 +1316,11 @@ def serve_bench_fleet(
     probe_interval_s: float = 0.1,
     emit: Optional[Callable[[dict], None]] = None,
     extra_headline: Optional[dict] = None,
+    unauth_router: Optional["FleetRouter"] = None,
+    unauth_probe_requests: int = 32,
+    chaos_join_grace_s: float = 10.0,
+    recover_wait_s: float = 0.0,
+    gateway_baseline: Optional[dict] = None,
 ) -> List[dict]:
     """Fleet-level SLO benchmark: the serve-bench open-loop schedule
     through the router over a live fleet, optionally with a fault plan
@@ -1149,6 +1332,19 @@ def serve_bench_fleet(
     ``reference_engine`` is given — a bit-exactness verdict comparing
     every served action against a direct ``PolicyEngine.act`` on the same
     observations.
+
+    ``unauth_router`` (a second router over the same fleet holding NO
+    bearer token) runs the auth acceptance check after the main schedule:
+    ``unauth_probe_requests`` credential-less requests must come back 401
+    with ZERO retries and ZERO retry-budget spend — the headline's
+    ``auth_probe`` block records it, and ``auth_shed_rate`` reports the
+    gateways' 401/403 fraction of all act requests.
+
+    ``gateway_baseline`` (a prior ``fleet_stats()['gateway_totals']``):
+    gateway stats are cumulative per process, so pre-run traffic (the
+    ``--wire-compare`` pass) would dilute the headline's auth-shed rate
+    and request attribution — the baseline is subtracted from the totals
+    this run reports.
     """
     arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
     obs = synthetic_obs(n_requests, n_agents, seed=seed)
@@ -1173,15 +1369,27 @@ def serve_bench_fleet(
                 (e.at_s for e in fault_plan.lifecycle_events()),
                 default=0.0,
             )
-            grace_s = 10.0
+            # Process-mode relaunches pay a child's full startup (JAX
+            # import + engine warmup), so the harness passes a larger
+            # grace there; in-process restarts finish in milliseconds.
             schedule.join(timeout_s=min(
-                max(0.0, last - result.makespan_s) + 5.0, grace_s
+                max(0.0, last - result.makespan_s) + 5.0,
+                chaos_join_grace_s,
             ))
             schedule.stop()
     finally:
         router.stop_probing()
     # One post-chaos sweep so health/pins reflect the recovered fleet.
     router.probe_once()
+    if recover_wait_s > 0:
+        # Wait (bounded) for the whole fleet to report healthy — process
+        # mode's supervisor relaunch must be VISIBLE in the headline's
+        # fleet stats (restart counts, fresh pid), not racing past it.
+        end = time.monotonic() + recover_wait_s
+        while time.monotonic() < end:
+            if all(router.probe_once().values()):
+                break
+            time.sleep(0.5)
 
     bit_exact = None
     mismatches = 0
@@ -1198,7 +1406,40 @@ def serve_bench_fleet(
             mismatches = int((got != want).any(axis=-1).sum())
             bit_exact = mismatches == 0
 
+    auth_probe = None
+    if unauth_router is not None and unauth_probe_requests > 0:
+        # Fire credential-less requests through a token-less router over
+        # the SAME fleet: every one must terminate 401 on its FIRST
+        # attempt. Any retry or budget spend here means auth failures
+        # leak into the retry machinery — the regression this guards.
+        probe_obs = synthetic_obs(
+            unauth_probe_requests, n_agents, seed=seed + 1
+        )
+        spent_before = unauth_router.budget.spent
+
+        async def _probe_unauth():
+            try:
+                return await asyncio.gather(*(
+                    unauth_router.act(f"intruder-{i:03d}", probe_obs[i])
+                    for i in range(unauth_probe_requests)
+                ))
+            finally:
+                await unauth_router.close_pools()
+
+        probe_results = asyncio.run(_probe_unauth())
+        auth_probe = {
+            "requests": unauth_probe_requests,
+            "n_401": sum(1 for r in probe_results if r.status == 401),
+            "retries": sum(r.retries for r in probe_results),
+            "budget_spent": unauth_router.budget.spent - spent_before,
+        }
+
     stats = router.fleet_stats()
+    base = gateway_baseline or {}
+
+    def _net_total(key: str) -> float:
+        return max(0, stats["gateway_totals"].get(key, 0) - base.get(key, 0))
+
     p50, p95, p99 = (result.latency_ms(q) for q in (50, 95, 99))
     rows = [
         {
@@ -1270,6 +1511,18 @@ def serve_bench_fleet(
             "pinned_households": stats["pinned_households"],
             "budget_denied": int(counters["budget_denied"]),
             "backoff_ms_total": round(counters["backoff_ms"], 3),
+            "reconnects": int(counters["reconnects"]),
+            "transport": router.transport,
+            "tls": router.ssl_context is not None,
+            "auth_shed_rate": round(
+                (_net_total("auth_401") + _net_total("auth_403"))
+                / max(1, _net_total("act_requests")),
+                6,
+            ),
+            "auth_401": int(_net_total("auth_401")),
+            "auth_403": int(_net_total("auth_403")),
+            "auth_probe": auth_probe,
+            "processes": stats["processes"],
             "bit_exact": bit_exact,
             "bit_exact_mismatches": mismatches,
             "served_replicas": sorted(

@@ -372,6 +372,163 @@ def train_community_with_rollback(
                 on_rollback(record)
 
 
+def train_chunked_with_rollback(
+    cfg,
+    pol_state,
+    ratings,
+    key,
+    ckpt_dir: str,
+    n_episodes: int,
+    n_chunks: int,
+    eval_every: int = 10,
+    episode0: int = 0,
+    guard_policy: GuardPolicy = GuardPolicy(),
+    telemetry=None,
+    policy_factory: Optional[Callable] = None,
+    on_rollback: Optional[Callable[[RollbackRecord], None]] = None,
+    save_every: Optional[int] = None,
+    keep_last: int = 2,
+    health_cb: Optional[Callable] = None,
+    episode_cb: Optional[Callable] = None,
+    carry_sync: Optional[Callable] = None,
+    monitor=None,
+    pipeline: bool = True,
+    chunk_parallel: int = 1,
+    mitigate: str = "warn",
+    s_eval: int = 8,
+) -> Tuple[tuple, List[RollbackRecord]]:
+    """``train_chunked_with_health`` under the divergence guard, with the
+    same restore/perturb/re-enter discipline as
+    ``train_community_with_rollback`` (the chunked half of the ROADMAP
+    training-resilience follow-on — the guard hooks existed, this is the
+    driver that acts on them).
+
+    Each attempt runs the chunked trainer with a fresh ``DivergenceGuard``
+    fed by the block-boundary evals. On a trip: restore the newest
+    VERIFIED checkpoint under ``ckpt_dir`` (falling back to the caller's
+    initial state before the first save), scale the effective lrs by
+    ``lr_drop**attempt``, and re-enter from the restored episode on a
+    ``fold_in(base_key, SALT + attempt)`` branch. Chunked runs key every
+    chunk by ABSOLUTE episode off the base key (scenarios.py
+    ``chunk_key_fn``), so branching the base key re-keys the surviving
+    episodes onto a fresh deterministic stream — replaying the exact
+    stream that diverged would diverge again.
+
+    Without a caller ``episode_cb``, the driver checkpoints the carry on
+    the ``save_every`` cadence itself (and installs the matching
+    ``carry_sync`` so pipelined runs drain the carry on save episodes).
+    Returns ``((pol_state, rewards, losses, seconds, monitor),
+    rollback_records)`` — the trainer outputs are the FINAL attempt's.
+    """
+    import jax
+
+    from p2pmicrogrid_tpu.train import make_policy
+    from p2pmicrogrid_tpu.train.checkpoint import (
+        restore_resume_state,
+        save_checkpoint,
+    )
+    from p2pmicrogrid_tpu.train.health import train_chunked_with_health
+
+    if policy_factory is None:
+        policy_factory = make_policy
+    save_every = save_every or cfg.train.save_episodes
+    base_cfg, base_key = cfg, key
+    cur_cfg, cur_ps, cur_key = cfg, pol_state, key
+    base_episode0 = episode0
+    end_episode = episode0 + n_episodes
+    rollbacks: List[RollbackRecord] = []
+    attempt = 0
+    while True:
+        guard = DivergenceGuard(guard_policy, telemetry=telemetry)
+        policy = policy_factory(cur_cfg)
+        if episode_cb is None:
+            ckpt_cfg = cur_cfg
+
+            def _cb(ep, r, l, carry, _cfg=ckpt_cfg):
+                if (ep + 1) % save_every == 0:
+                    save_checkpoint(
+                        ckpt_dir, carry, ep, cfg=_cfg, keep_last=keep_last
+                    )
+
+            cb = _cb
+            sync = carry_sync or (lambda ep: (ep + 1) % save_every == 0)
+        else:
+            cb, sync = episode_cb, carry_sync
+        try:
+            result = train_chunked_with_health(
+                cur_cfg, policy, cur_ps, ratings, cur_key,
+                n_episodes=end_episode - episode0,
+                n_chunks=n_chunks,
+                eval_every=eval_every,
+                episode0=episode0,
+                episode_cb=cb,
+                chunk_parallel=chunk_parallel,
+                mitigate=mitigate,
+                health_cb=health_cb,
+                # The caller's monitor (checkpoint-restored basin state on
+                # --resume) rides the FIRST attempt only: after a trip its
+                # history reflects the diverged trajectory, so rollback
+                # attempts recalibrate fresh (episode0 > 0 triggers the
+                # untrained-reference recalibration in the health driver).
+                monitor=monitor if attempt == 0 else None,
+                s_eval=s_eval,
+                telemetry=telemetry,
+                pipeline=pipeline,
+                carry_sync=sync,
+                guard=guard,
+            )
+            return result, rollbacks
+        except DivergenceTripped as trip:
+            attempt += 1
+            if attempt > guard_policy.max_rollbacks:
+                raise RollbackExhausted(
+                    f"divergence persisted through "
+                    f"{guard_policy.max_rollbacks} rollback(s); "
+                    f"last trip: {trip}"
+                ) from trip
+            span = (
+                telemetry.span("rollback", attempt=attempt,
+                               episode=trip.episode)
+                if telemetry is not None
+                else contextlib.nullcontext()
+            )
+            with span:
+                try:
+                    st = restore_resume_state(ckpt_dir, pol_state)
+                    restored_ep, cur_ps = st.episode, st.pol_state
+                    episode0 = st.episode + 1
+                except FileNotFoundError:
+                    # Tripped before the first save: the initial state is
+                    # the last good one.
+                    restored_ep, cur_ps = -1, pol_state
+                    episode0 = base_episode0
+            lr_scale = guard_policy.lr_drop ** attempt
+            cur_cfg = scaled_lr_cfg(base_cfg, lr_scale)
+            cur_key = jax.random.fold_in(
+                base_key, ROLLBACK_KEY_SALT + attempt
+            )
+            record = RollbackRecord(
+                index=attempt,
+                tripped_episode=trip.episode,
+                reason=trip.reason,
+                restored_episode=restored_ep,
+                lr_scale=lr_scale,
+            )
+            rollbacks.append(record)
+            if telemetry is not None:
+                telemetry.counter("train.rollback")
+                telemetry.event(
+                    "rollback",
+                    attempt=attempt,
+                    episode=trip.episode,
+                    restored_episode=restored_ep,
+                    lr_scale=lr_scale,
+                    reason=trip.reason,
+                )
+            if on_rollback is not None:
+                on_rollback(record)
+
+
 # --- crash supervisor ---------------------------------------------------------
 
 

@@ -1,0 +1,305 @@
+"""Process-isolated fleet: real subprocess replicas, SIGKILL chaos (ISSUE 9).
+
+Acceptance for the process tier: a ``ProcessFleet`` spawns real
+``serve-gateway`` subprocesses (ephemeral ports read from their
+``gateway_listening`` lines), ``kill`` delivers a REAL SIGKILL that the
+supervisor recovers from with capped deterministic backoff on the
+original ports, per-replica pid/RSS/restart columns land in fleet stats
+and the warehouse fleet view, and the end-to-end chaos bench (slow,
+TLS + auth + persistent wire) holds availability and bit-exactness
+through an OS-delivered process death.
+"""
+
+import asyncio
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.serve import (
+    FleetRouter,
+    ProcessFleet,
+    RetryPolicy,
+    export_policy_bundle,
+)
+from p2pmicrogrid_tpu.train import init_policy_state
+
+A = 3
+
+
+def _make_bundle(tmp_path, seed, name):
+    cfg = default_config(
+        sim=SimConfig(n_agents=A),
+        train=TrainConfig(implementation="tabular", seed=seed),
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
+    ps = ps._replace(
+        q_table=jax.random.normal(
+            jax.random.PRNGKey(seed + 1), ps.q_table.shape
+        )
+    )
+    return export_policy_bundle(cfg, ps, str(tmp_path / name))
+
+
+def _obs(n, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = np.empty((n, A, 4), dtype=np.float32)
+    obs[..., 0] = rng.uniform(0, 1, (n, A))
+    obs[..., 1:] = rng.uniform(-1, 1, (n, A, 3))
+    return obs
+
+
+class TestProcessFleetUnits:
+    def test_tls_pair_validated(self):
+        with pytest.raises(ValueError):
+            ProcessFleet(["b"], tls_cert="cert.pem")  # key missing
+
+    def test_replica_floor(self):
+        with pytest.raises(ValueError):
+            ProcessFleet(["b"], n_replicas=0)
+
+    def test_child_argv_shape(self):
+        fleet = ProcessFleet(
+            ["/bundles/b1"], mux=True, auth_secret_file="/s",
+            tls_cert="/c.pem", tls_key="/k.pem",
+            fault_plan_file="/plan.json",
+        )
+        argv = fleet._child_argv("replica-3", 8441, 8442, restarts=2)
+        joined = " ".join(argv)
+        assert "serve-gateway" in joined
+        assert "--bundle /bundles/b1" in joined
+        assert "--port 8441" in joined
+        assert "--mux-port 8442" in joined
+        assert "--replica-id replica-3" in joined
+        assert "--restarts 2" in joined
+        assert "--tls-cert /c.pem" in joined
+        assert "--auth-secret-file /s" in joined
+        assert "--chaos-plan /plan.json" in joined
+
+
+class TestWireCompareGuards:
+    def test_wire_compare_refuses_request_fault_plan_any_mode(
+        self, tmp_path
+    ):
+        """--wire-compare + a request-fault chaos plan is refused in BOTH
+        fleet modes (the pre-pass would anchor replica-0's fault windows
+        and shift its coin indices), before any fleet spins up."""
+        from p2pmicrogrid_tpu import cli
+        from p2pmicrogrid_tpu.serve import FaultEvent, FaultPlan
+
+        plan = FaultPlan(
+            seed=0,
+            events=(FaultEvent(kind="error", rate=0.5),),
+        )
+        plan_path = tmp_path / "plan.json"
+        plan_path.write_text(plan.to_json())
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        for extra in ([], ["--process"]):
+            with pytest.raises(SystemExit) as exc:
+                cli.main([
+                    "serve-bench", "--fleet", "--wire-compare",
+                    "--chaos-plan", str(plan_path),
+                    "--bundle", bundle, "--agents", str(A),
+                ] + extra)
+            assert "fault windows" in str(exc.value)
+
+
+class TestProcessFleetLive:
+    """One real subprocess replica: spawn, SIGKILL, supervised relaunch.
+
+    Deliberately minimal (one replica, no TLS) to keep the child's
+    startup inside tier-1 budget; the full TLS+auth+chaos fleet runs in
+    the slow end-to-end test below.
+    """
+
+    def test_sigkill_and_supervised_relaunch(self, tmp_path):
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        fleet = ProcessFleet(
+            [bundle], n_replicas=1, backoff_s=0.1, backoff_cap_s=1.0,
+        )
+        fleet.start()
+        try:
+            rep = fleet.replicas[0]
+            assert rep.mux_port is not None
+            router = FleetRouter(
+                [rep], retry=RetryPolicy(max_attempts=4, deadline_s=20.0),
+                fail_threshold=2, ok_threshold=1,
+            )
+            obs = _obs(1)[0]
+
+            def act():
+                async def run():
+                    try:
+                        return await router.act("house-1", obs)
+                    finally:
+                        await router.close_pools()
+
+                return asyncio.run(run())
+
+            first = act()
+            assert first.status == 200
+            pid_before = fleet.pid("replica-0")
+            assert pid_before is not None
+
+            fleet.kill("replica-0")
+            assert fleet.pid("replica-0") is None  # REALLY dead
+            assert fleet.kills == ["replica-0"]
+
+            # The supervisor relaunches on the ORIGINAL ports; wait for
+            # the fleet to answer again (child startup pays jax import +
+            # engine warmup).
+            end = time.monotonic() + 120.0
+            recovered = False
+            while time.monotonic() < end:
+                if all(router.probe_once().values()):
+                    recovered = True
+                    break
+                time.sleep(0.5)
+            assert recovered, fleet.log_tail("replica-0")
+            assert fleet.restarts == ["replica-0"]
+            pid_after = fleet.pid("replica-0")
+            assert pid_after is not None and pid_after != pid_before
+            assert fleet.replicas[0].port == rep.port  # same address
+
+            second = act()
+            assert second.status == 200
+            # Bit-exactness across the process death: same obs, same
+            # bundle, identical actions from the relaunched process.
+            assert second.actions == first.actions
+
+            stats = router.fleet_stats()
+            proc = stats["processes"]["replica-0"]
+            assert proc["pid"] == pid_after
+            assert proc["restarts"] == 1
+            assert proc["rss_bytes"] > 0
+        finally:
+            fleet.stop_all()
+        assert fleet.pid("replica-0") is None  # stop_all reaped the child
+
+
+class TestFleetViewColumns:
+    def test_warehouse_fleet_view_gains_wire_auth_process_columns(
+        self, tmp_path
+    ):
+        from p2pmicrogrid_tpu.data import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import (
+            SqliteSink,
+            Telemetry,
+            run_manifest,
+        )
+
+        db = str(tmp_path / "results.db")
+        # An OLDER router run with a LONGER event stream: its final
+        # fleet_stats has a higher per-run seq than the newer run's, so
+        # ordering by seq across runs would wrongly pick it (review fix:
+        # last_processes orders by ts, seq only breaks within-run ties).
+        old = Telemetry(
+            run_id="fleet-router-old",
+            sinks=[SqliteSink(db)],
+            manifest=run_manifest(
+                extra={"config_hash": "cfg-abc", "serve_role": "router"}
+            ),
+        )
+        for _ in range(50):
+            old.event("noise")
+        old.event(
+            "fleet_stats",
+            processes={"replica-0": {"pid": 999, "rss_bytes": 1,
+                                     "restarts": 9}},
+        )
+        old.close()
+        time.sleep(0.02)  # strictly newer ts for the second run
+        tel = Telemetry(
+            run_id="fleet-router-test",
+            sinks=[SqliteSink(db)],
+            manifest=run_manifest(
+                extra={"config_hash": "cfg-abc", "serve_role": "router"}
+            ),
+        )
+        tel.counter("router.reconnects", 3)
+        tel.counter("router.auth_denied", 2)
+        tel.event(
+            "fleet_stats",
+            n_replicas=2,
+            n_healthy=2,
+            processes={
+                "replica-0": {"pid": 101, "rss_bytes": 1 << 20,
+                              "restarts": 1},
+                "replica-1": {"pid": 102, "rss_bytes": 1 << 20,
+                              "restarts": 0},
+            },
+        )
+        tel.close()
+        store = ResultsStore(db)
+        try:
+            rows = store.query_fleet_view()
+        finally:
+            store.close()
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["config_hash"] == "cfg-abc"
+        assert row["router_reconnects"] == 3
+        assert row["router_auth_denied"] == 2
+        # The NEWER run's processes win, not the older run's longer
+        # (higher-seq) stream.
+        assert row["last_processes"]["replica-0"]["pid"] == 101
+        assert row["last_processes"]["replica-0"]["restarts"] == 1
+        assert "replica-1" in row["last_processes"]
+
+
+@pytest.mark.slow
+class TestProcessChaosEndToEnd:
+    def test_serve_bench_process_chaos_tls_auth(self, tmp_path, capfd):
+        """The FLEET_PROC capture path end to end: real subprocess
+        replicas with TLS + per-household tokens on the persistent wire,
+        one replica SIGKILLed mid-run, supervisor relaunch — availability
+        and bit-exactness asserted on the headline, 401 probe charged
+        zero retry budget, and the persistent wire beats per-request
+        connections on p95."""
+        from p2pmicrogrid_tpu import cli
+
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        rc = cli.main([
+            "serve-bench", "--fleet", "--process", "--chaos",
+            "--tls", "--auth", "--wire-compare",
+            "--bundle", bundle,
+            "--replicas", "2",
+            "--requests", "192", "--rate", "64",
+            "--kill-at", "0.9", "--restart-at", "2.2",
+            "--agents", str(A),
+        ])
+        assert rc == 0
+        lines = [
+            json.loads(l)
+            for l in capfd.readouterr().out.splitlines()
+            if l.strip().startswith("{")
+        ]
+        headline = next(
+            r for r in lines if r.get("metric") == "serve_bench_fleet"
+        )
+        compare = next(
+            r for r in lines if r.get("metric") == "wire_comparison"
+        )
+        # The acceptance bars (ISSUE 9).
+        assert headline["process_mode"] is True
+        assert headline["tls"] is True
+        assert headline["availability"] >= 0.99
+        assert headline["bit_exact"] is True
+        assert headline["chaos"]["kills"] == ["replica-1"]
+        # The supervisor relaunch is visible per replica.
+        assert headline["processes"]["replica-1"]["restarts"] >= 1
+        assert headline["processes"]["replica-0"]["restarts"] == 0
+        pids = {p["pid"] for p in headline["processes"].values()}
+        assert len(pids) == 2  # real process isolation: distinct pids
+        # Auth: unauthenticated probe rejected 401, no retries, no budget.
+        probe = headline["auth_probe"]
+        assert probe["n_401"] == probe["requests"] > 0
+        assert probe["retries"] == 0
+        assert probe["budget_spent"] == 0
+        assert headline["auth_shed_rate"] > 0.0
+        # Persistent wire beats the per-request-connection client on p95.
+        assert compare["value"] > 1.0
+        assert compare["mux_p95_ms"] < compare["http_p95_ms"]

@@ -59,6 +59,7 @@ from p2pmicrogrid_tpu.train.resilience import (
     checkpoint_callback,
     prepare_resume,
     supervise,
+    train_chunked_with_rollback,
     train_community_with_rollback,
 )
 
@@ -662,3 +663,95 @@ def test_cli_supervised_sigkill_bit_exact(tmp_path):
     problems = []
     checker.check_resilience_jsonl(str(out_path), problems)
     assert problems == []
+
+
+# -- chunked rollback (ISSUE 9 satellite: the chunked half of the driver) ------
+
+
+def _chunked_cfg(max_episodes=12):
+    return default_config(
+        sim=SimConfig(n_agents=2, n_scenarios=4),
+        train=TrainConfig(
+            implementation="tabular", seed=0,
+            max_episodes=max_episodes, save_episodes=4,
+        ),
+    )
+
+
+def test_chunked_rollback_restores_and_reenters(tmp_path):
+    """A divergence trip at a block-boundary eval restores the newest
+    verified checkpoint, drops the lr, branches the chunk key stream and
+    re-enters — the chunked mirror of train_community_with_rollback."""
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+    from p2pmicrogrid_tpu.telemetry import MemorySink, Telemetry
+
+    cfg = _chunked_cfg()
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    key = jax.random.PRNGKey(0)
+    ps0 = init_shared_pol_state(cfg, key)
+    trips = {"n": 0}
+
+    def health_cb(point):
+        # One injected divergence at the episode-8 eval, first attempt
+        # only — the same exception path a guard trip takes (do_eval
+        # raises through train_chunked_with_health).
+        if point.episode >= 8 and trips["n"] == 0:
+            trips["n"] += 1
+            raise DivergenceTripped(point.episode, "injected test trip")
+
+    sink = MemorySink()
+    tel = Telemetry(run_id="chunked-rollback-test", sinks=[sink])
+    result, rollbacks = train_chunked_with_rollback(
+        cfg, ps0, ratings, key, str(tmp_path / "ckpt"),
+        n_episodes=12, n_chunks=2, eval_every=4,
+        guard_policy=GuardPolicy(max_rollbacks=2, lr_drop=0.5),
+        telemetry=tel, health_cb=health_cb,
+    )
+    tel.close()
+    pol_state, rewards, losses, seconds, monitor = result
+    assert len(rollbacks) == 1
+    # Saved at episodes 3 and 7 before the trip at 8: restore ep 7.
+    assert rollbacks[0].restored_episode == 7
+    assert rollbacks[0].tripped_episode == 8
+    assert rollbacks[0].lr_scale == 0.5
+    assert np.isfinite(rewards).all()
+    assert tel.counters["train.rollback"] == 1
+    assert "rollback" in [r.get("kind") for r in sink.records]
+
+
+def test_chunked_rollback_exhausts_budget(tmp_path):
+    """A trip that re-fires every attempt raises RollbackExhausted."""
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+
+    cfg = _chunked_cfg()
+    ratings = make_ratings(cfg, np.random.default_rng(0))
+    key = jax.random.PRNGKey(0)
+    ps0 = init_shared_pol_state(cfg, key)
+
+    def health_cb(point):
+        if point.episode >= 8:
+            raise DivergenceTripped(point.episode, "persistent trip")
+
+    with pytest.raises(RollbackExhausted):
+        train_chunked_with_rollback(
+            cfg, ps0, ratings, key, str(tmp_path / "ckpt"),
+            n_episodes=12, n_chunks=2, eval_every=4,
+            guard_policy=GuardPolicy(max_rollbacks=1),
+            health_cb=health_cb,
+        )
+
+
+def test_chunked_rollback_cli_requires_health(tmp_path):
+    """--max-rollbacks on the scenario path without the chunked health
+    surface is refused loudly, not silently ignored."""
+    from p2pmicrogrid_tpu import cli
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main([
+            "train", "--implementation", "tabular", "--agents", "2",
+            "--scenarios", "4", "--shared", "--chunks", "2",
+            "--episodes", "8", "--health-every", "0",
+            "--max-rollbacks", "2",
+            "--model-dir", str(tmp_path / "models"),
+        ])
+    assert "--health-every" in str(exc.value)

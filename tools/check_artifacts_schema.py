@@ -243,6 +243,94 @@ def check_fleet_jsonl(path: str, problems: list) -> None:
             )
 
 
+# Process-fleet captures (serve-bench --fleet --process, TLS + auth on)
+# additionally promise the wire/trust SLOs: reconnect counts on the
+# persistent mux wire, the auth-shed rate, and a bit-exactness verdict
+# measured THROUGH real process boundaries.
+FLEET_PROC_HEADLINE_KEYS = FLEET_HEADLINE_KEYS + (
+    "reconnects", "auth_shed_rate",
+)
+
+
+def check_fleet_proc_jsonl(path: str, problems: list) -> None:
+    """FLEET_PROC_*.jsonl: the fleet contract + wire/trust headline keys
+    + a boolean bit_exact verdict."""
+    where = os.path.relpath(path)
+    check_fleet_jsonl(path, problems)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return  # already reported
+    saw_headline = False
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # already reported
+        if not isinstance(row, dict) or row.get("metric") != "serve_bench_fleet":
+            continue
+        saw_headline = True
+        for key in ("reconnects", "auth_shed_rate"):
+            v = row.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(
+                    f"{where}:{i + 1}: serve_bench_fleet headline "
+                    f"missing numeric {key!r} (process-fleet contract)"
+                )
+        if not isinstance(row.get("bit_exact"), bool):
+            problems.append(
+                f"{where}:{i + 1}: serve_bench_fleet headline missing "
+                "boolean 'bit_exact' (process-fleet contract)"
+            )
+    if not saw_headline:
+        problems.append(
+            f"{where}: no serve_bench_fleet headline row"
+        )
+
+
+# Private-key refusal: committed captures may carry certs for provenance,
+# but key MATERIAL in the repo is a credential leak no matter how "test"
+# it looks. artifacts/tls/ is the designated LOCAL scratch
+# (serve/auth.py ensure_test_certs writes there; .gitignore'd) — keys are
+# tolerated there and NOWHERE else. Suffix-targeted so the sweep stays
+# cheap on large checkouts.
+_KEY_SUFFIXES = (".pem", ".key", ".crt", ".cer")
+_KEY_MARKER = "PRIVATE KEY"
+_KEY_SCRATCH_DIRS = (os.path.join("artifacts", "tls"),)
+
+
+def check_no_private_keys(repo_root: str, problems: list) -> None:
+    for dirpath, dirnames, filenames in os.walk(repo_root):
+        rel_dir = os.path.relpath(dirpath, repo_root)
+
+        def _keep(d: str) -> bool:
+            if d.startswith(".") or d == "__pycache__":
+                return False
+            rel = os.path.normpath(os.path.join(rel_dir, d))
+            return rel not in _KEY_SCRATCH_DIRS
+
+        dirnames[:] = [d for d in dirnames if _keep(d)]
+        for name in filenames:
+            if not name.lower().endswith(_KEY_SUFFIXES):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, errors="replace") as f:
+                    head = f.read(1 << 16)
+            except OSError:
+                continue
+            if _KEY_MARKER in head:
+                problems.append(
+                    f"{os.path.relpath(path, repo_root)}: contains "
+                    f"{_KEY_MARKER!r} material — private keys must never "
+                    "be committed (generate test certs into artifacts/tls/"
+                    ", which is gitignored)"
+                )
+
+
 # Numeric keys every train_supervised headline row must carry — the
 # crash-resume contract of train/resilience.py:supervise + `train
 # --supervise`. kill/resume/rollback counts plus the bit_exact boolean are
@@ -628,10 +716,20 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
             check_metric_jsonl(path, problems)
     for path in sorted(gateway_jsonl):
         check_gateway_jsonl(path, problems)
+    fleet_proc_jsonl = set(
+        glob.glob(os.path.join(repo_root, "artifacts", "FLEET_PROC_*.jsonl"))
+    )
     for path in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "FLEET_*.jsonl"))
     ):
+        if path in fleet_proc_jsonl:
+            # FLEET_PROC_* matches FLEET_* too; the process check below
+            # includes the fleet validation plus the wire/trust keys.
+            continue
         check_fleet_jsonl(path, problems)
+    for path in sorted(fleet_proc_jsonl):
+        check_fleet_proc_jsonl(path, problems)
+    check_no_private_keys(repo_root, problems)
     for path in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "RESILIENCE_*.jsonl"))
     ):

@@ -58,6 +58,14 @@ HOT_PATH_FILES = (
     # stalls the whole fleet's traffic, not one process.
     os.path.join("p2pmicrogrid_tpu", "serve", "router.py"),
     os.path.join("p2pmicrogrid_tpu", "serve", "faults.py"),
+    # The wire/trust tier (PR 9): the mux framing and token checks run
+    # per request on the gateway/proxy event loops, and the proxy fans
+    # every household through one process — the same worst-case blast
+    # radius as the gateway.
+    os.path.join("p2pmicrogrid_tpu", "serve", "wire.py"),
+    os.path.join("p2pmicrogrid_tpu", "serve", "auth.py"),
+    os.path.join("p2pmicrogrid_tpu", "serve", "proxy.py"),
+    os.path.join("p2pmicrogrid_tpu", "serve", "procfleet.py"),
     # The resilience layer wraps every training dispatch (guard observation
     # per block, checkpoint callbacks on the save cadence): a blocking
     # readback here would serialize the whole async pipeline it guards.
