@@ -2139,9 +2139,14 @@ def cmd_serve_gateway(args) -> int:
         tls = server_ssl_context(args.tls_cert, args.tls_key)
     authenticator = None
     if getattr(args, "auth_secret_file", None):
-        from p2pmicrogrid_tpu.serve import TokenAuthenticator, load_secret
+        from p2pmicrogrid_tpu.serve import TokenAuthenticator
 
-        authenticator = TokenAuthenticator(load_secret(args.auth_secret_file))
+        # from_secret_file honors a rotation's .prev grace window: a
+        # gateway (re)started mid-rotation verifies BOTH secrets until
+        # the grace expires (serve-token --rotate).
+        authenticator = TokenAuthenticator.from_secret_file(
+            args.auth_secret_file
+        )
     fault_injector = None
     if getattr(args, "chaos_plan", None):
         from p2pmicrogrid_tpu.serve import FaultInjector, FaultPlan
@@ -2216,10 +2221,15 @@ def cmd_serve_token(args) -> int:
     """Mint fleet secrets and per-household bearer tokens (serve/auth.py).
 
     ``--new-secret PATH`` writes a fresh 32-byte fleet secret (mode 0600)
-    — distribute it to every gateway/router process. With ``--secret-file``
-    plus ``--household`` (or ``--wildcard`` for the operator credential),
+    — distribute it to every gateway/router process. ``--rotate`` (with
+    ``--secret-file``) replaces the secret in place and parks the old one
+    in ``<path>.prev`` with a ``--grace-s`` expiry: verifiers built from
+    the file honor BOTH secrets until the grace passes, so the fleet
+    rotates without a synchronized restart. With ``--secret-file`` plus
+    ``--household`` (or ``--wildcard`` for the operator credential),
     prints one signed bearer token on stdout, optionally bounded by
-    ``--ttl-s``. Verification (`--verify TOKEN`) prints the claims.
+    ``--ttl-s``. Verification (`--verify TOKEN`) prints the claims,
+    checking the full dual-secret chain.
     """
     from p2pmicrogrid_tpu.serve import auth as serve_auth
 
@@ -2229,16 +2239,25 @@ def cmd_serve_token(args) -> int:
         return 0
     if not args.secret_file:
         raise SystemExit("pass --new-secret PATH, or --secret-file PATH")
-    secret = serve_auth.load_secret(args.secret_file)
+    if args.rotate:
+        serve_auth.rotate_secret(args.secret_file, grace_s=args.grace_s)
+        print(
+            f"serve-token: rotated {args.secret_file} (old secret honored "
+            f"for {args.grace_s:g}s via {args.secret_file}.prev)",
+            file=sys.stderr,
+        )
+        return 0
     if args.verify:
+        chain = serve_auth.load_secret_chain(args.secret_file)
         try:
-            claims = serve_auth.verify_token(secret, args.verify)
+            claims = serve_auth.TokenAuthenticator(chain).verify(args.verify)
         except serve_auth.AuthError as err:
             print(json.dumps({"valid": False, "error": str(err),
                               "status": err.status}))
             return 1
         print(json.dumps({"valid": True, **claims}))
         return 0
+    secret = serve_auth.load_secret(args.secret_file)
     household = (
         serve_auth.WILDCARD_HOUSEHOLD if args.wildcard else args.household
     )
@@ -2299,9 +2318,13 @@ def cmd_serve_router(args) -> int:
         tls = server_ssl_context(args.tls_cert, args.tls_key)
     authenticator = router_token = None
     if args.auth_secret_file:
-        from p2pmicrogrid_tpu.serve import TokenAuthenticator, load_secret
+        from p2pmicrogrid_tpu.serve import TokenAuthenticator
 
-        authenticator = TokenAuthenticator(load_secret(args.auth_secret_file))
+        # Rotation-aware (serve-token --rotate): verifies the dual-secret
+        # chain, mints with the primary.
+        authenticator = TokenAuthenticator.from_secret_file(
+            args.auth_secret_file
+        )
         # The router's own credential toward the replicas: the operator
         # wildcard (it probes /stats and pushes /admin/swap).
         router_token = authenticator.mint("*")
@@ -2361,6 +2384,228 @@ def cmd_serve_router(args) -> int:
             json.dump(router.fleet_stats(), f, indent=2)
         print(f"serve-router: stats -> {args.stats_out}", file=sys.stderr)
     return 0
+
+
+def cmd_continual(args) -> int:
+    """Continual training: warehouse serve traces -> candidate bundle.
+
+    Closes the train half of the flywheel (ROADMAP item 5): exports the
+    incumbent bundle's production decisions from the telemetry warehouse
+    (``data/trace_export.py`` — refusing compacted runs loudly), warm-
+    starts a learner from the incumbent's greedy parameters, fine-tunes
+    off-policy on the traces and then through the chunked pipeline under
+    the divergence guard with rollback (``train/continual.py``), and
+    exports the result as a CANDIDATE bundle with a fresh config_hash.
+    The candidate serves nothing until ``promote`` gates and ramps it.
+
+    stdout carries one ``continual_result`` JSON metric row; telemetry
+    (events + rollback counters) streams into ``--results-db``.
+    """
+    import os
+
+    from p2pmicrogrid_tpu.data.trace_export import export_serve_traces
+    from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry, run_manifest
+    from p2pmicrogrid_tpu.telemetry.registry import run_stamp, set_current
+    from p2pmicrogrid_tpu.train.continual import train_continual
+    from p2pmicrogrid_tpu.train.resilience import GuardPolicy
+
+    if not args.results_db:
+        raise SystemExit("continual needs --results-db (the trace source)")
+    if not args.bundle:
+        raise SystemExit("pass --bundle (the incumbent bundle directory)")
+    cfg = _build_cfg(args)
+    dataset = export_serve_traces(
+        args.results_db,
+        config_hash=args.config_hash,
+        cfg=cfg,
+        min_transitions=args.min_transitions,
+    )
+    print(
+        f"continual: exported {dataset.n_transitions} transition(s) from "
+        f"{dataset.n_decisions} decision(s) across "
+        f"{len(dataset.run_ids)} run(s)",
+        file=sys.stderr, flush=True,
+    )
+    tel = Telemetry(
+        run_id=f"continual-{run_stamp()}",
+        sinks=[SqliteSink(args.results_db)],
+        manifest=run_manifest(cfg, extra={"continual": True}),
+    )
+    set_current(tel)
+    out = args.out or os.path.join(
+        "bundles", f"{_persist_setting(args, cfg)}-"
+        f"{cfg.train.implementation}-continual"
+    )
+    ckpt_dir = os.path.join(
+        args.model_dir, "continual", cfg.train.implementation
+    )
+    try:
+        result = train_continual(
+            cfg, args.bundle, dataset, out, ckpt_dir,
+            n_episodes=args.episodes,
+            n_chunks=args.chunks,
+            eval_every=args.health_every,
+            trace_steps=args.trace_steps,
+            trace_batch=args.trace_batch,
+            guard_policy=GuardPolicy(
+                max_rollbacks=args.max_rollbacks, lr_drop=args.lr_drop
+            ),
+            telemetry=tel,
+            dtype=args.dtype,
+            pipeline=args.pipeline,
+        )
+    finally:
+        set_current(None)
+        tel.close()
+    row = {
+        "metric": "continual_result",
+        "value": float(result.trace_steps),
+        "unit": "trace_steps",
+        "vs_baseline": 1.0,
+        **result.summary(),
+    }
+    print(json.dumps(row), flush=True)
+    print(
+        f"continual: candidate -> {out} (config {result.candidate_hash}, "
+        f"incumbent {result.incumbent_hash}, {len(result.rollbacks)} "
+        "rollback(s))",
+        file=sys.stderr, flush=True,
+    )
+    return 0
+
+
+def cmd_promote(args) -> int:
+    """Gated promotion + canary for a candidate bundle (serve/promotion.py).
+
+    Default mode gates ``--candidate`` against ``--incumbent`` offline
+    (held-out eval cost + reward-collapse guard + serve-bench SLO), then
+    — unless ``--gate-only`` — ramps it through a live in-process gateway
+    with the canary controller: percentage splits, per-stage warehouse
+    cost/latency/error attribution, auto-rollback on regression. Every
+    verdict lands as ``promotion`` events in ``--results-db``
+    (``telemetry-query --promotions``).
+
+    ``--inject all`` runs the seeded bad-candidate harness instead
+    (crafted better / cost-regressed / NaN-poisoned / SLO-violating
+    candidates through the full pipeline) — the committed
+    ``artifacts/PROMOTION_*.jsonl`` captures. stdout carries one JSON
+    metric row per line; the LAST line is the headline.
+    """
+    import tempfile
+
+    from p2pmicrogrid_tpu.serve.promotion import (
+        CanaryBudgets,
+        GateBudgets,
+        promotion_bench,
+        run_promotion_gate,
+        run_promotion_pipeline,
+    )
+    from p2pmicrogrid_tpu.telemetry import (
+        SqliteSink,
+        Telemetry,
+        guarded_stdout_sink,
+        run_manifest,
+    )
+    from p2pmicrogrid_tpu.telemetry.registry import run_stamp
+
+    cfg = _build_cfg(args)
+    stages = tuple(float(s) for s in args.stages.split(","))
+    gate_budgets = GateBudgets(
+        cost_margin=args.cost_margin,
+        max_reward_drop=args.max_reward_drop,
+        slo_p95_ms=args.slo_p95_ms,
+        slo_p99_ms=args.slo_p99_ms,
+        max_shed_rate=args.max_shed_rate,
+    )
+    canary_budgets = CanaryBudgets(
+        max_cost_regression=args.max_cost_regression,
+        slo_p95_ms=args.canary_p95_ms,
+        min_requests=args.canary_min_requests,
+    )
+    out_f = open(args.out, "a") if args.out else None
+    tel = Telemetry(
+        run_id=f"promote-{run_stamp()}",
+        sinks=[SqliteSink(args.results_db)] if args.results_db else [],
+        manifest=run_manifest(cfg, extra={"serve_role": "promotion"}),
+    )
+    try:
+        with guarded_stdout_sink() as sink:
+            def emit(row: dict) -> None:
+                sink.emit(row)
+                tel.emit(row)
+                if out_f is not None:
+                    out_f.write(json.dumps(row) + "\n")
+                    out_f.flush()
+
+            if args.inject:
+                cases = (
+                    ("good", "cost_regressed", "nan_poisoned",
+                     "slo_violating")
+                    if args.inject == "all" else (args.inject,)
+                )
+                work = args.work_dir or tempfile.mkdtemp(
+                    prefix="p2p-promotion-"
+                )
+                promotion_bench(
+                    cfg, work,
+                    cases=cases,
+                    seed=args.seed,
+                    requests_per_stage=args.requests_per_stage,
+                    n_households=args.households,
+                    stages=stages,
+                    results_db=args.results_db,
+                    telemetry=tel,
+                    emit=emit,
+                    gate_budgets=gate_budgets,
+                    canary_budgets=canary_budgets,
+                )
+                return 0
+            if not args.candidate or not args.incumbent:
+                raise SystemExit(
+                    "pass --candidate and --incumbent bundle dirs "
+                    "(or --inject for the seeded harness)"
+                )
+            if args.gate_only:
+                verdict = run_promotion_gate(
+                    cfg, args.candidate, args.incumbent,
+                    budgets=gate_budgets, telemetry=tel,
+                    bench_seed=args.seed, max_batch=args.max_batch,
+                )
+                emit({
+                    "metric": "promotion_gate",
+                    "value": 1.0 if verdict.passed else 0.0,
+                    "unit": "pass",
+                    "vs_baseline": 1.0 if verdict.passed else 0.0,
+                    "gate_verdict": verdict.verdict,
+                    **verdict.to_fields(),
+                })
+                return 0 if verdict.passed else 1
+            fields = run_promotion_pipeline(
+                cfg, args.candidate, args.incumbent,
+                gate_budgets=gate_budgets,
+                canary_budgets=canary_budgets,
+                stages=stages,
+                results_db=args.results_db,
+                telemetry=tel,
+                seed=args.seed,
+                requests_per_stage=args.requests_per_stage,
+                n_households=args.households,
+                skip_gate=args.skip_gate,
+                max_batch=args.max_batch,
+            )
+            emit({
+                "metric": "promotion_case",
+                "value": float(fields.get("availability", 1.0)),
+                "unit": "availability",
+                "vs_baseline": 1.0 if fields.get("promoted") else 0.0,
+                "case": "operator",
+                **fields,
+            })
+            return 0 if fields.get("promoted") else 1
+    finally:
+        tel.close()
+        if out_f is not None:
+            out_f.close()
 
 
 def cmd_telemetry_report(args) -> int:
@@ -2530,10 +2775,19 @@ def cmd_telemetry_query(args) -> int:
         return [dict(zip(cols, r)) for r in cur.fetchall()]
 
     if getattr(args, "watch", False):
-        if getattr(args, "fleet", False) or getattr(args, "rollbacks", False):
+        if (
+            getattr(args, "fleet", False)
+            or getattr(args, "rollbacks", False)
+            or getattr(args, "promotions", False)
+        ):
             # Silently tailing the EVAL join when the user asked for the
-            # fleet/rollback view would stream unrelated rows; refuse loudly.
-            which = "--fleet" if getattr(args, "fleet", False) else "--rollbacks"
+            # fleet/rollback/promotion view would stream unrelated rows;
+            # refuse loudly.
+            which = (
+                "--fleet" if getattr(args, "fleet", False)
+                else "--rollbacks" if getattr(args, "rollbacks", False)
+                else "--promotions"
+            )
             print(
                 f"{which} and --watch cannot combine (the watch tails the "
                 "eval join); drop one",
@@ -2556,6 +2810,10 @@ def cmd_telemetry_query(args) -> int:
             from p2pmicrogrid_tpu.data.results import ROLLBACK_VIEW_SQL
 
             rows = select(ROLLBACK_VIEW_SQL)
+        elif getattr(args, "promotions", False):
+            from p2pmicrogrid_tpu.data.results import PROMOTION_VIEW_SQL
+
+            rows = select(PROMOTION_VIEW_SQL)
         else:
             rows = select(TELEMETRY_JOIN_SQL)
             if args.gauges:
@@ -3250,7 +3508,147 @@ def main(argv=None) -> int:
     p.add_argument("--verify",
                    help="verify this token against --secret-file and "
                         "print its claims instead of minting")
+    p.add_argument("--rotate", action="store_true",
+                   help="rotate --secret-file in place: a fresh secret "
+                        "replaces it, the old one is honored from "
+                        "<path>.prev until --grace-s expires (no "
+                        "synchronized fleet restart)")
+    p.add_argument("--grace-s", type=float, default=3600.0, dest="grace_s",
+                   help="--rotate: how long the rotated-out secret keeps "
+                        "verifying (default 3600)")
     p.set_defaults(fn=cmd_serve_token)
+
+    p = sub.add_parser(
+        "continual",
+        help="continual training: replay warehouse serve traces into "
+             "replay buffers, fine-tune the incumbent bundle off-policy "
+             "+ through the guarded chunked pipeline, export a candidate "
+             "bundle (data/trace_export.py + train/continual.py)",
+    )
+    _add_common(p)
+    p.set_defaults(episodes=20)
+    p.add_argument("--bundle",
+                   help="the INCUMBENT bundle directory to fine-tune")
+    p.add_argument("--config-hash", dest="config_hash",
+                   help="export only this config's serve traces "
+                        "(default: every serve-role run in the warehouse)")
+    p.add_argument("--out",
+                   help="candidate bundle output directory (default: "
+                        "bundles/<setting>-<impl>-continual)")
+    p.add_argument("--scenarios", type=int, default=1,
+                   help="scenario batch of the simulator fine-tune phase")
+    p.add_argument("--chunks", type=int, default=1,
+                   help="chunked aggregate scenarios per episode (the "
+                        "donated-carry pipeline; train --chunks semantics)")
+    p.add_argument("--health-every", type=int, default=10,
+                   dest="health_every",
+                   help="greedy held-out eval cadence during the simulator "
+                        "phase (feeds the divergence guard; default 10)")
+    p.add_argument("--trace-steps", type=int, default=200,
+                   dest="trace_steps",
+                   help="off-policy update steps on the exported traces "
+                        "before the simulator phase (default 200)")
+    p.add_argument("--trace-batch", type=int, default=None,
+                   dest="trace_batch",
+                   help="transitions per off-policy update (default: the "
+                        "implementation's batch size)")
+    p.add_argument("--min-transitions", type=int, default=1,
+                   dest="min_transitions",
+                   help="refuse to train on fewer exported transitions "
+                        "(loud failure beats silent fine-tuning on noise)")
+    p.add_argument("--max-rollbacks", type=_nonneg_int, default=3,
+                   dest="max_rollbacks",
+                   help="divergence rollback budget for the simulator "
+                        "phase (default 3; train/resilience.py)")
+    p.add_argument("--lr-drop", type=float, default=0.5, dest="lr_drop",
+                   help="rollback perturbation: effective lrs x this "
+                        "factor per rollback (default 0.5)")
+    p.add_argument("--dtype", choices=["float32", "float16"],
+                   default="float32",
+                   help="candidate bundle export dtype")
+    p.add_argument("--pipeline", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="async episode pipeline for the simulator phase")
+    p.set_defaults(fn=cmd_continual)
+
+    p = sub.add_parser(
+        "promote",
+        help="gated promotion + canary auto-rollback: candidate must "
+             "beat the incumbent on held-out eval cost and meet serve "
+             "SLOs before any traffic, then ramps 5%%->25%%->100%% with "
+             "live per-bundle attribution and rollback on regression "
+             "(serve/promotion.py)",
+    )
+    _add_common(p)
+    p.add_argument("--candidate", help="candidate bundle directory")
+    p.add_argument("--incumbent", help="incumbent bundle directory")
+    p.add_argument("--out",
+                   help="append the promotion metric rows to this JSONL "
+                        "capture (schema-checked as "
+                        "artifacts/PROMOTION_*.jsonl)")
+    p.add_argument("--gate-only", action="store_true", dest="gate_only",
+                   help="run the offline gate and exit (rc 0 = pass); "
+                        "no traffic")
+    p.add_argument("--skip-gate", action="store_true", dest="skip_gate",
+                   help="OPERATOR OVERRIDE: go straight to the canary — "
+                        "the ramp and auto-rollback still guard the fleet")
+    p.add_argument("--inject",
+                   choices=["all", "good", "cost_regressed",
+                            "nan_poisoned", "slo_violating"],
+                   help="seeded bad-candidate harness instead of a real "
+                        "candidate: crafted bundles through the full "
+                        "pipeline (the PROMOTION_*.jsonl capture driver)")
+    p.add_argument("--work-dir", dest="work_dir",
+                   help="--inject: where crafted bundles are written "
+                        "(default: a temp dir)")
+    p.add_argument("--stages", default="5,25,100",
+                   help="canary ramp percentages, comma-separated, ending "
+                        "at 100 (default 5,25,100)")
+    p.add_argument("--requests-per-stage", type=int, default=192,
+                   dest="requests_per_stage",
+                   help="live requests driven per canary stage "
+                        "(default 192)")
+    p.add_argument("--households", type=int, default=128,
+                   help="distinct household ids in the canary traffic "
+                        "(split arms are household-deterministic; "
+                        "default 128)")
+    p.add_argument("--max-batch", type=_pow2_int, default=16,
+                   dest="max_batch",
+                   help="engine padding-bucket cap for gate/canary "
+                        "serving (default 16)")
+    p.add_argument("--cost-margin", type=float, default=0.0,
+                   dest="cost_margin",
+                   help="gate: candidate eval cost must beat the "
+                        "incumbent's by at least this (default 0 — any "
+                        "strict improvement)")
+    p.add_argument("--max-reward-drop", type=float, default=0.5,
+                   dest="max_reward_drop",
+                   help="gate: don't-heat basin guard — candidate greedy "
+                        "reward may not fall more than this fraction of "
+                        "|incumbent reward| below it (default 0.5)")
+    p.add_argument("--slo-p95-ms", type=float, default=100.0,
+                   dest="slo_p95_ms",
+                   help="gate serve-bench p95 budget (default 100)")
+    p.add_argument("--slo-p99-ms", type=float, default=250.0,
+                   dest="slo_p99_ms",
+                   help="gate serve-bench p99 budget (default 250)")
+    p.add_argument("--max-shed-rate", type=float, default=0.05,
+                   dest="max_shed_rate",
+                   help="gate shed-rate budget (default 0.05)")
+    p.add_argument("--max-cost-regression", type=float, default=0.05,
+                   dest="max_cost_regression",
+                   help="canary: candidate arm's mean decision cost may "
+                        "exceed the incumbent arm's by at most this "
+                        "scale-free tolerance (default 0.05)")
+    p.add_argument("--canary-p95-ms", type=float, default=500.0,
+                   dest="canary_p95_ms",
+                   help="canary: absolute per-stage candidate p95 budget "
+                        "(default 500 — wire latency, not engine latency)")
+    p.add_argument("--canary-min-requests", type=int, default=8,
+                   dest="canary_min_requests",
+                   help="canary: candidate-arm decisions needed per stage "
+                        "for a cost verdict (default 8)")
+    p.set_defaults(fn=cmd_promote)
 
     p = sub.add_parser(
         "serve-router",
@@ -3326,6 +3724,11 @@ def main(argv=None) -> int:
                         "runs grouped by config_hash with their "
                         "train.rollback/train.divergence counter sums and "
                         "per-rollback event details (train/resilience.py)")
+    p.add_argument("--promotions", action="store_true",
+                   help="promotion view instead of the eval join: every "
+                        "candidate config's gate verdicts, promotions and "
+                        "canary rollbacks with the newest decision phase "
+                        "(serve/promotion.py)")
     p.add_argument("--watch", action="store_true",
                    help="tail mode: poll the warehouse join and stream "
                         "new/updated rows as JSON lines until interrupted "

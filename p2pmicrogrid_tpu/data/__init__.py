@@ -14,6 +14,14 @@ from p2pmicrogrid_tpu.data.traces import (
     agent_profiles,
 )
 from p2pmicrogrid_tpu.data.results import ResultsStore, save_eval_outputs
+from p2pmicrogrid_tpu.data.trace_export import (
+    TraceDataset,
+    TracesCompactedError,
+    decision_cost,
+    export_serve_traces,
+    to_replay_state,
+    trace_reward,
+)
 
 __all__ = [
     "TraceSet",
@@ -23,4 +31,10 @@ __all__ = [
     "agent_profiles",
     "ResultsStore",
     "save_eval_outputs",
+    "TraceDataset",
+    "TracesCompactedError",
+    "decision_cost",
+    "export_serve_traces",
+    "to_replay_state",
+    "trace_reward",
 ]

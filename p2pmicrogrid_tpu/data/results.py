@@ -144,7 +144,17 @@ def compact_serve_telemetry(
 
     Idempotent over already-compacted history (aggregates are a different
     ``kind`` and are never re-compacted). Returns
-    ``{"rows_compacted": n, "aggregates_written": m}``.
+    ``{"rows_compacted": n, "aggregates_written": m,
+    "decisions_compacted": d}``.
+
+    The same pass also deletes the gateway's per-request
+    ``serve_decision`` traces (household/obs/action — the continual-
+    training feed, data/trace_export.py) older than the cutoff: they are
+    the LARGEST rows in the warehouse (full observation payloads), and
+    per-request decisions have no per-bucket aggregate worth keeping.
+    ``data/trace_export.py`` refuses to export a run whose window was
+    compacted — the presence of ``serve_request_agg`` rows marks it — so
+    trimmed history can never silently train a partial buffer.
 
     Memory stays flat in the number of compacted rows — the whole point
     is warehouses too big to hold: the cursor streams, per-group stats
@@ -225,8 +235,17 @@ def compact_serve_telemetry(
         pr = attrs.get("padded_rows")
         if isinstance(pr, (int, float)):
             g["padded_rows"] += int(pr)
-    if not n_rows:
-        return {"rows_compacted": 0, "aggregates_written": 0}
+    (n_decisions,) = con.execute(
+        "SELECT COUNT(*) FROM telemetry_points "
+        "WHERE kind = 'serve_decision' AND ts IS NOT NULL AND ts < ?",
+        (cutoff,),
+    ).fetchone()
+    if not n_rows and not n_decisions:
+        return {
+            "rows_compacted": 0,
+            "aggregates_written": 0,
+            "decisions_compacted": 0,
+        }
 
     # Aggregate rows live in a disjoint seq namespace: a LIVE SqliteSink
     # for the same run keeps its own in-memory counter (starting at 0), so
@@ -273,9 +292,15 @@ def compact_serve_telemetry(
             "AND ts IS NOT NULL AND ts < ?",
             (cutoff,),
         ).rowcount
+        decisions_deleted = con.execute(
+            "DELETE FROM telemetry_points WHERE kind = 'serve_decision' "
+            "AND ts IS NOT NULL AND ts < ?",
+            (cutoff,),
+        ).rowcount
     return {
         "rows_compacted": int(deleted),
         "aggregates_written": len(agg_rows),
+        "decisions_compacted": int(decisions_deleted),
     }
 
 
@@ -377,6 +402,39 @@ WHERE t.config_hash IS NOT NULL
 GROUP BY t.config_hash
 HAVING rollbacks > 0 OR divergence_trips > 0 OR rollback_events > 0
 ORDER BY t.config_hash
+"""
+
+
+# The promotion view (serve/promotion.py): every candidate bundle that
+# ever faced the gate/canary, grouped by its config_hash, with verdict
+# counts and the newest decision's detail — the warehouse answer to "what
+# happened the last time this config tried to ship". ``promotion`` events
+# carry phase ('gate' | 'canary_stage' | 'canary_abort' | 'promoted' |
+# 'rolled_back'), the candidate/incumbent hashes and the verdict fields.
+PROMOTION_VIEW_SQL = """
+SELECT json_extract(p.attrs_json, '$.candidate') AS candidate,
+       COUNT(*) AS n_events,
+       COUNT(CASE WHEN json_extract(p.attrs_json, '$.phase') = 'gate'
+           THEN 1 END) AS gate_events,
+       COUNT(CASE WHEN json_extract(p.attrs_json, '$.phase') = 'gate'
+           AND json_extract(p.attrs_json, '$.passed') = 1
+           THEN 1 END) AS gate_passes,
+       COUNT(CASE WHEN json_extract(p.attrs_json, '$.phase') = 'promoted'
+           THEN 1 END) AS promotions,
+       COUNT(CASE WHEN json_extract(p.attrs_json, '$.phase') = 'rolled_back'
+           THEN 1 END) AS rollbacks,
+       MAX(p.ts) AS last_ts,
+       (SELECT json_extract(p2.attrs_json, '$.phase')
+          FROM telemetry_points p2
+         WHERE p2.kind = 'promotion'
+           AND json_extract(p2.attrs_json, '$.candidate') =
+               json_extract(p.attrs_json, '$.candidate')
+         ORDER BY p2.ts DESC, p2.seq DESC LIMIT 1) AS last_phase
+FROM telemetry_points p
+WHERE p.kind = 'promotion'
+  AND json_extract(p.attrs_json, '$.candidate') IS NOT NULL
+GROUP BY candidate
+ORDER BY candidate
 """
 
 
@@ -725,6 +783,14 @@ class ResultsStore:
                 except json.JSONDecodeError:
                     pass
         return rows
+
+    def query_promotion_view(self) -> list:
+        """Candidate bundles aggregated into one deployment-safety view
+        per config_hash (``PROMOTION_VIEW_SQL``): gate verdict counts,
+        promotions, rollbacks and the newest decision phase, as dicts."""
+        cur = self.con.execute(PROMOTION_VIEW_SQL)
+        cols = [d[0] for d in cur.description]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
 
     def query_rollback_view(self) -> list:
         """Training runs aggregated into one resilience view per

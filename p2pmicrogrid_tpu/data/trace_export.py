@@ -1,0 +1,375 @@
+"""Replay warehouse serve traces back into training buffers.
+
+The warehouse half of the continual-learning flywheel (ROADMAP item 5):
+the serve gateway already attributes every request to the bundle that
+answered it — per-request ``serve_decision`` events (household, the
+observation it sent, the action served) stream into the SQLite warehouse
+keyed by the serving bundle's ``config_hash`` (serve/gateway.py). Nothing
+read them back until now. This module is the reader:
+
+* ``export_serve_traces`` pulls a config's decisions out of a results DB,
+  pairs each household's consecutive decisions into off-policy
+  transitions ``(obs_t, action_t, reward_t, obs_{t+1})``, and returns a
+  ``TraceDataset`` whose arrays are shape/dtype-exact against the serving
+  contract (obs ``[N, A, 4]`` float32 — serve/export.py ``OBS_SPEC``).
+* ``trace_reward`` attributes a per-slot reward to each served decision
+  from the observation and action alone, using the environment's OWN cost
+  pieces (ops/tariff.grid_prices, ops/market.compute_costs,
+  ops/thermal.comfort_penalty) under the no-communication settlement rule
+  (envs/community.py's no-com branch): the gateway cannot see the
+  community's P2P clearing from one household's request, so matched P2P
+  power is attributed zero — a documented proxy. Production deployments
+  that meter real settlement join it in here (the ``reward_fn`` hook).
+* ``to_replay_state`` loads a dataset into the jit-safe per-agent ring
+  (``models/replay.ReplayState``) the off-policy learners sample from —
+  the seed buffer ``train/continual.py`` fine-tunes the incumbent on.
+
+**Compaction fails loud.** The warehouse retention pass
+(``telemetry-query --compact``, data/results.py) rolls old per-request
+rows into ``serve_request_agg`` aggregates and DELETES the decision
+traces with them. An export over a compacted run would silently train on
+an empty or truncated buffer — the worst possible failure mode for a
+continual loop, a candidate trained on nothing still looks like a
+candidate. ``export_serve_traces`` therefore refuses with
+``TracesCompactedError`` the moment any selected run carries aggregate
+rows, naming the fix (raise the ``--older-than-hours`` retention window
+so the training cadence outruns compaction).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class TracesCompactedError(RuntimeError):
+    """The selected runs' per-request traces were rolled into aggregates
+    — there is no raw decision stream left to train on."""
+
+
+@dataclass
+class TraceDataset:
+    """Off-policy transitions reconstructed from serve traces.
+
+    Arrays are shape/dtype-exact against the serving contract: obs /
+    next_obs ``[N, A, 4]`` float32 (OBS_SPEC feature order), action
+    ``[N, A]`` float32 heat-pump fractions, reward ``[N, A]`` float32.
+    """
+
+    obs: np.ndarray
+    action: np.ndarray
+    reward: np.ndarray
+    next_obs: np.ndarray
+    config_hash: Optional[str] = None
+    run_ids: List[str] = field(default_factory=list)
+    households: List[str] = field(default_factory=list)
+    n_decisions: int = 0          # pairable decisions read (>= transitions)
+    n_dropped: int = 0            # anonymous / non-leading batch rows
+
+    @property
+    def n_transitions(self) -> int:
+        return int(self.obs.shape[0])
+
+    @property
+    def n_agents(self) -> int:
+        return int(self.obs.shape[1])
+
+    def summary(self) -> dict:
+        return {
+            "config_hash": self.config_hash,
+            "n_decisions": self.n_decisions,
+            "n_transitions": self.n_transitions,
+            "n_agents": self.n_agents,
+            "n_households": len(self.households),
+            "n_runs": len(self.run_ids),
+            "n_dropped": self.n_dropped,
+            "reward_mean": (
+                round(float(self.reward.mean()), 6)
+                if self.n_transitions else None
+            ),
+        }
+
+
+def trace_reward(cfg, obs: np.ndarray, action: np.ndarray) -> np.ndarray:
+    """Per-agent reward attributed to one served decision.
+
+    Mirrors the environment's reward at the decision point — ``-(cost +
+    10 * comfort_penalty)`` (envs/community.py) — reconstructed from the
+    observation features alone: ``obs[..., 0]`` is the normalized slot
+    time (prices via ops/tariff.grid_prices), ``obs[..., 1]`` inverts to
+    the indoor temperature through ops/thermal's normalization, and
+    ``obs[..., 2]`` inverts to the household balance through the rating
+    normalizer (the population's nominal ``max_in`` — per-household
+    ratings are not on the wire, so the nominal rating attributes cost;
+    the relative candidate-vs-incumbent comparison the promotion gate and
+    canary make is unaffected by this common scale). Settlement follows
+    the no-communication rule: all power at grid prices, zero matched P2P
+    (one household's request cannot see the community clearing).
+    """
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.ops.market import compute_costs
+    from p2pmicrogrid_tpu.ops.tariff import grid_prices
+    from p2pmicrogrid_tpu.ops.thermal import comfort_penalty
+
+    obs = jnp.asarray(obs, dtype=jnp.float32)
+    action = jnp.asarray(action, dtype=jnp.float32)
+    th, pop = cfg.thermal, cfg.population
+    time_norm = obs[..., 0]
+    t_in = obs[..., 1] * th.margin + th.setpoint
+    # The wire's balance feature is balance_w / max_in (ops/obs.py);
+    # invert with the nominal community rating.
+    max_in_w = max(pop.load_rating_mean, pop.pv_rating_mean) * pop.safety * 1e3
+    balance_w = obs[..., 2] * max_in_w
+    buy, inj = grid_prices(cfg.tariff, time_norm)
+    p_grid = balance_w + action * th.hp_max_power
+    cost = compute_costs(
+        p_grid, jnp.zeros_like(p_grid), buy, inj,
+        jnp.zeros_like(buy), cfg.sim.slot_hours,
+    )
+    penalty = comfort_penalty(th, t_in)
+    # host-sync: trace export runs offline on host arrays — not a
+    # training-dispatch path.
+    return np.asarray(-(cost + 10.0 * penalty), dtype=np.float32)
+
+
+def decision_cost(
+    cfg, obs: np.ndarray, action: np.ndarray, t_out: float = 10.0
+) -> np.ndarray:
+    """Per-agent ATTRIBUTABLE cost of one served decision — the canary's
+    per-arm comparison metric (serve/promotion.py).
+
+    ``trace_reward`` mirrors the env exactly, but the env charges comfort
+    at the PRE-step temperature — a term the action cannot move within
+    its own slot (credit flows through the next observation). Two arms
+    serving the same obs stream would therefore tie on comfort no matter
+    what they served. This variant rolls the building one Euler step
+    forward under the SERVED action (ops/thermal.thermal_step with a
+    nominal outdoor temperature and the mass pinned to the air — neither
+    rides the wire) and charges comfort at the RESULTING temperature:
+    idling a cold house or overheating a warm one is visible in the slot
+    that decided it. Energy settles exactly as in ``trace_reward``.
+    """
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.ops.market import compute_costs
+    from p2pmicrogrid_tpu.ops.tariff import grid_prices
+    from p2pmicrogrid_tpu.ops.thermal import comfort_penalty, thermal_step
+
+    obs = jnp.asarray(obs, dtype=jnp.float32)
+    action = jnp.asarray(action, dtype=jnp.float32)
+    th, pop = cfg.thermal, cfg.population
+    time_norm = obs[..., 0]
+    t_in = obs[..., 1] * th.margin + th.setpoint
+    max_in_w = max(pop.load_rating_mean, pop.pv_rating_mean) * pop.safety * 1e3
+    balance_w = obs[..., 2] * max_in_w
+    buy, inj = grid_prices(cfg.tariff, time_norm)
+    hp_power = action * th.hp_max_power
+    p_grid = balance_w + hp_power
+    cost = compute_costs(
+        p_grid, jnp.zeros_like(p_grid), buy, inj,
+        jnp.zeros_like(buy), cfg.sim.slot_hours,
+    )
+    t_next, _ = thermal_step(
+        th, cfg.sim.dt_seconds, jnp.asarray(t_out, dtype=jnp.float32),
+        t_in, t_in, hp_power,
+    )
+    penalty = comfort_penalty(th, t_next)
+    # host-sync: offline attribution on host arrays — not a dispatch path.
+    return np.asarray(cost + 10.0 * penalty, dtype=np.float32)
+
+
+def _serve_run_ids(
+    con: sqlite3.Connection, config_hash: Optional[str]
+) -> Dict[str, str]:
+    """{run_id: config_hash} of serve-role telemetry runs (replica bundle
+    runs register ``serve_role`` in their manifests — serve/gateway.py
+    build_registry), filtered to ``config_hash`` when given."""
+    rows = con.execute(
+        "SELECT run_id, config_hash FROM telemetry_runs "
+        "WHERE json_extract(manifest_json, '$.serve_role') IS NOT NULL"
+    ).fetchall()
+    return {
+        run_id: ch
+        for run_id, ch in rows
+        if config_hash is None or ch == config_hash
+    }
+
+
+def _check_not_compacted(con: sqlite3.Connection, run_ids) -> None:
+    marks = ",".join("?" for _ in run_ids)
+    (n_agg,) = con.execute(
+        f"SELECT COUNT(*) FROM telemetry_points WHERE run_id IN ({marks}) "
+        "AND kind = 'serve_request_agg'",
+        list(run_ids),
+    ).fetchone()
+    if n_agg:
+        raise TracesCompactedError(
+            f"{n_agg} serve_request_agg row(s) found for the selected "
+            "runs: their per-request traces were compacted to aggregates "
+            "(telemetry-query --compact), so the decision stream is empty "
+            "or truncated and exporting it would train on a partial "
+            "buffer. Fix: raise the retention window (--older-than-hours) "
+            "above your continual-training cadence, or export before the "
+            "retention pass runs."
+        )
+
+
+def export_serve_traces(
+    results_db: str,
+    config_hash: Optional[str] = None,
+    cfg=None,
+    n_agents: Optional[int] = None,
+    reward_fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None,
+    min_transitions: int = 1,
+) -> TraceDataset:
+    """Replay a warehouse's gateway decisions into a ``TraceDataset``.
+
+    ``config_hash`` selects the bundle whose decisions to export (None =
+    every serve-role run — a fleet's replicas all serving one config).
+    ``cfg`` drives the default reward attribution (``trace_reward``);
+    pass ``reward_fn(obs [N, A, 4], action [N, A]) -> [N, A]`` to attribute
+    from metered outcomes instead. ``n_agents`` (default: inferred from
+    the first decision) validates every row against the serving contract.
+
+    Raises ``TracesCompactedError`` when any selected run was compacted
+    (see module docstring) and ``ValueError`` when fewer than
+    ``min_transitions`` transitions survive pairing — both LOUD, because
+    the downstream consumer is a training loop that would otherwise
+    silently fine-tune on nothing.
+    """
+    if cfg is None and reward_fn is None:
+        raise ValueError("pass cfg (for trace_reward) or an explicit reward_fn")
+    con = sqlite3.connect(f"file:{results_db}?mode=ro", uri=True)
+    try:
+        runs = _serve_run_ids(con, config_hash)
+        if not runs:
+            raise ValueError(
+                f"no serve-role telemetry runs in {results_db}"
+                + (f" for config_hash {config_hash}" if config_hash else "")
+            )
+        _check_not_compacted(con, list(runs))
+        marks = ",".join("?" for _ in runs)
+        cursor = con.execute(
+            "SELECT run_id, seq, attrs_json FROM telemetry_points "
+            f"WHERE run_id IN ({marks}) AND kind = 'serve_decision' "
+            "ORDER BY run_id, seq",
+            list(runs),
+        )
+        # Consecutive decisions of ONE household within ONE run pair into
+        # transitions: the gateway serves each household once per slot, so
+        # its next decision's observation IS the next-slot observation.
+        # Two decision classes CANNOT honor that invariant and are
+        # dropped (counted in ``n_dropped``) rather than stitched into
+        # fabricated transitions that would silently corrupt training:
+        # anonymous decisions (no household — unrelated clients would
+        # interleave under one pseudo-key) and the non-leading rows of a
+        # batched request (rows 1..B-1 share ONE instant with row 0 —
+        # they are parallel observations, not temporal successors).
+        per_household: Dict[tuple, list] = {}
+        n_decisions = 0
+        n_dropped = 0
+        for run_id, seq, attrs_json in cursor:
+            try:
+                attrs = json.loads(attrs_json) if attrs_json else {}
+            except ValueError:
+                continue
+            obs = attrs.get("obs")
+            action = attrs.get("action")
+            if obs is None or action is None:
+                continue
+            obs = np.asarray(obs, dtype=np.float32)
+            action = np.asarray(action, dtype=np.float32)
+            if obs.ndim != 2 or obs.shape[1] != 4:
+                continue
+            if n_agents is None:
+                n_agents = int(obs.shape[0])
+            if obs.shape[0] != n_agents or action.shape != (n_agents,):
+                continue
+            household = attrs.get("household")
+            if not household or attrs.get("row", 0) != 0:
+                n_dropped += 1
+                continue
+            n_decisions += 1
+            per_household.setdefault((run_id, household), []).append(
+                (obs, action)
+            )
+    finally:
+        con.close()
+
+    obs_rows: List[np.ndarray] = []
+    act_rows: List[np.ndarray] = []
+    next_rows: List[np.ndarray] = []
+    households: set = set()
+    for (run_id, household), decisions in sorted(per_household.items()):
+        for (o, a), (o_next, _) in zip(decisions, decisions[1:]):
+            obs_rows.append(o)
+            act_rows.append(a)
+            next_rows.append(o_next)
+            households.add(household)
+    if len(obs_rows) < max(min_transitions, 1):
+        raise ValueError(
+            f"only {len(obs_rows)} transition(s) reconstructed from "
+            f"{n_decisions} pairable decision(s) ({n_dropped} anonymous/"
+            f"batch-row decision(s) dropped; need >= {min_transitions}): "
+            "each household needs >= 2 consecutive decisions in one run "
+            "to form a transition"
+        )
+    obs = np.stack(obs_rows).astype(np.float32)
+    action = np.stack(act_rows).astype(np.float32)
+    next_obs = np.stack(next_rows).astype(np.float32)
+    if reward_fn is not None:
+        reward = np.asarray(reward_fn(obs, action), dtype=np.float32)
+    else:
+        reward = trace_reward(cfg, obs, action)
+    if reward.shape != action.shape:
+        raise ValueError(
+            f"reward_fn returned shape {reward.shape}, expected {action.shape}"
+        )
+    return TraceDataset(
+        obs=obs,
+        action=action,
+        reward=reward,
+        next_obs=next_obs,
+        config_hash=config_hash,
+        run_ids=sorted(runs),
+        households=sorted(households),
+        n_decisions=n_decisions,
+        n_dropped=n_dropped,
+    )
+
+
+def to_replay_state(dataset: TraceDataset, capacity: Optional[int] = None):
+    """Load a ``TraceDataset`` into the jit-safe per-agent replay ring
+    (``models/replay.ReplayState`` — leaves ``[A, cap, ...]``), newest
+    transitions kept when the dataset overflows ``capacity``. The ring
+    reports ``count = n`` and ``cursor = n % cap`` exactly as if the
+    transitions had been ``replay_add``-ed in order, so samplers see the
+    standard filled-region semantics.
+    """
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.models.replay import replay_init
+
+    n, a = dataset.n_transitions, dataset.n_agents
+    cap = capacity or max(n, 1)
+    keep = min(n, cap)
+    sl = slice(n - keep, n)  # newest transitions win on overflow
+    state = replay_init(a, cap, obs_dim=dataset.obs.shape[-1], act_dim=1)
+    # [N, A, ...] -> [A, N, ...] ring layout.
+    obs = np.swapaxes(dataset.obs[sl], 0, 1)
+    act = np.swapaxes(dataset.action[sl], 0, 1)[..., None]
+    rew = np.swapaxes(dataset.reward[sl], 0, 1)
+    nxt = np.swapaxes(dataset.next_obs[sl], 0, 1)
+    return state._replace(
+        obs=state.obs.at[:, :keep, :].set(jnp.asarray(obs)),
+        action=state.action.at[:, :keep, :].set(jnp.asarray(act)),
+        reward=state.reward.at[:, :keep].set(jnp.asarray(rew)),
+        next_obs=state.next_obs.at[:, :keep, :].set(jnp.asarray(nxt)),
+        cursor=jnp.asarray(keep % cap, dtype=jnp.int32),
+        count=jnp.asarray(keep, dtype=jnp.int32),
+    )

@@ -82,17 +82,33 @@ from p2pmicrogrid_tpu.serve.auth import (
     client_ssl_context,
     generate_secret,
     load_secret,
+    load_secret_chain,
     mint_token,
+    rotate_secret,
     server_ssl_context,
     verify_token,
 )
 from p2pmicrogrid_tpu.serve.loadgen import serve_bench_wire_compare
 from p2pmicrogrid_tpu.serve.procfleet import ProcessFleet
+from p2pmicrogrid_tpu.serve.promotion import (
+    CanaryBudgets,
+    CanaryController,
+    CanaryResult,
+    GateBudgets,
+    GateVerdict,
+    StageTraffic,
+    evaluate_bundle_cost,
+    make_crafted_bundle,
+    promotion_bench,
+    run_promotion_gate,
+    run_promotion_pipeline,
+)
 from p2pmicrogrid_tpu.serve.proxy import ProxyServer, RouterProxy
 from p2pmicrogrid_tpu.serve.registry import BundleRegistry, ServingBundle
 from p2pmicrogrid_tpu.serve.wire import (
     MuxConnection,
     MuxPool,
+    SyncMuxProbe,
     WireProtocolError,
     encode_frame,
     read_frame,
@@ -114,7 +130,13 @@ __all__ = [
     "AuthError",
     "BUNDLE_FORMAT_VERSION",
     "BundleRegistry",
+    "CanaryBudgets",
+    "CanaryController",
+    "CanaryResult",
     "ConsistentHashRing",
+    "GateBudgets",
+    "GateVerdict",
+    "StageTraffic",
     "FaultEvent",
     "FaultInjector",
     "FaultPlan",
@@ -138,6 +160,7 @@ __all__ = [
     "ServeGateway",
     "ServingBundle",
     "Sessions",
+    "SyncMuxProbe",
     "TokenAuthenticator",
     "WireProtocolError",
     "build_gateway",
@@ -145,13 +168,20 @@ __all__ = [
     "client_ssl_context",
     "encode_frame",
     "ensure_test_certs",
+    "evaluate_bundle_cost",
     "export_bundle_from_checkpoint",
     "export_policy_bundle",
     "generate_secret",
     "kill_restart_plan",
+    "make_crafted_bundle",
+    "promotion_bench",
+    "run_promotion_gate",
+    "run_promotion_pipeline",
     "load_policy_bundle",
     "load_secret",
+    "load_secret_chain",
     "mint_token",
+    "rotate_secret",
     "plan_open_loop",
     "poisson_arrivals",
     "read_frame",

@@ -17,6 +17,17 @@ stdlib-only primitives terminate trust there:
   client errors on the wire: router and loadgen never retry them and
   they never consume the retry budget — an attacker hammering /v1/act
   with garbage tokens must not eat the budget honest retries depend on.
+* **Rotation without a synchronized restart.** ``rotate_secret`` writes a
+  fresh secret in place and parks the previous one next to it
+  (``<path>.prev``, JSON with an expiry ``grace_s`` seconds out);
+  ``load_secret_chain`` returns the primary plus the still-graced old
+  secret, and a ``TokenAuthenticator`` built on a chain verifies against
+  BOTH until the grace expires (checked at verification time, so a
+  long-lived gateway honors the expiry without reloading). Tokens are
+  always MINTED with the primary — the old secret only verifies. Fleets
+  rotate by running ``serve-token --rotate`` and restarting/reloading
+  processes at leisure inside the grace window; requests signed with
+  either secret pass mid-rotation, and post-grace old-secret tokens 401.
 * **TLS.** ``server_ssl_context``/``client_ssl_context`` wrap stdlib
   ``ssl``; ``ensure_test_certs`` shells out to the system ``openssl`` to
   mint a short-lived self-signed cert (SAN ``IP:127.0.0.1,DNS:localhost``)
@@ -83,6 +94,53 @@ def load_secret(path: str) -> str:
     return secret
 
 
+def _prev_secret_path(path: str) -> str:
+    return path + ".prev"
+
+
+def rotate_secret(
+    path: str, grace_s: float = 3600.0, now: Optional[float] = None
+) -> str:
+    """Rotate the fleet secret at ``path`` in place.
+
+    Writes a fresh secret to ``path`` (0600) and parks the PREVIOUS one in
+    ``<path>.prev`` as JSON ``{"secret": ..., "expires": unix}`` with the
+    expiry ``grace_s`` seconds from ``now``. Verifiers built from
+    ``load_secret_chain`` honor both until the grace passes, so the fleet
+    needs no synchronized restart; minting always uses the new primary.
+    Returns the new secret.
+    """
+    now = time.time() if now is None else now
+    old = load_secret(path)
+    fd = os.open(
+        _prev_secret_path(path), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600
+    )
+    with os.fdopen(fd, "w") as f:
+        json.dump({"secret": old, "expires": now + max(grace_s, 0.0)}, f)
+    return generate_secret(path)
+
+
+def load_secret_chain(
+    path: str, now: Optional[float] = None
+) -> list:
+    """``[(secret, expires_or_None), ...]`` — the primary secret first
+    (never expiring), then the rotated-out previous secret while its grace
+    window holds. An expired/missing/corrupt ``.prev`` contributes
+    nothing: the chain degrades to exactly ``load_secret``'s behavior."""
+    now = time.time() if now is None else now
+    chain = [(load_secret(path), None)]
+    try:
+        with open(_prev_secret_path(path)) as f:
+            prev = json.load(f)
+        secret = prev.get("secret")
+        expires = float(prev.get("expires", 0.0))
+        if secret and expires > now:
+            chain.append((secret, expires))
+    except (OSError, ValueError, TypeError):
+        pass
+    return chain
+
+
 def _sign(secret: str, claims_raw: bytes) -> bytes:
     return hmac.new(secret.encode(), claims_raw, hashlib.sha256).digest()
 
@@ -137,21 +195,54 @@ def verify_token(secret: str, token: str, now: Optional[float] = None) -> dict:
 
 
 class TokenAuthenticator:
-    """The gateway/router-side verifier bound to one fleet secret."""
+    """The gateway/router-side verifier bound to one fleet secret — or,
+    across a rotation, to a dual-secret chain: ``secret`` may be a plain
+    string or a ``load_secret_chain`` list of ``(secret, expires)`` pairs.
+    Minting always signs with the PRIMARY (first) secret; verification
+    accepts any chain member whose expiry has not passed — expiry is
+    checked per verification, so the grace window closes on schedule in a
+    long-lived process without reloading the chain."""
 
-    def __init__(self, secret: str):
-        if not secret:
+    def __init__(self, secret):
+        if isinstance(secret, str):
+            chain = [(secret, None)]
+        else:
+            chain = [(s, e) for s, e in secret]
+        if not chain or not all(s for s, _ in chain):
             raise ValueError("secret must be non-empty")
-        self.secret = secret
+        self.chain = chain
+        self.secret = chain[0][0]  # the minting (primary) secret
+
+    @classmethod
+    def from_secret_file(cls, path: str) -> "TokenAuthenticator":
+        """Build from a secret file, honoring a rotation's ``.prev``
+        grace window (``load_secret_chain``)."""
+        return cls(load_secret_chain(path))
 
     def mint(self, household: str, ttl_s: Optional[float] = None) -> str:
         return mint_token(self.secret, household, ttl_s=ttl_s)
+
+    def verify(self, token: Optional[str]) -> dict:
+        """Verify against every live chain member; the PRIMARY's failure
+        is what surfaces (the old secret is a compatibility window, not
+        an identity of its own)."""
+        now = time.time()
+        primary_error: Optional[AuthError] = None
+        for i, (secret, expires) in enumerate(self.chain):
+            if expires is not None and now >= expires:
+                continue
+            try:
+                return verify_token(secret, token, now=now)
+            except AuthError as err:
+                if i == 0:
+                    primary_error = err
+        raise primary_error or AuthError("missing bearer token", status=401)
 
     def check(self, token: Optional[str], household: Optional[str]) -> dict:
         """Authorize an act request for ``household``. 401 on a token
         that authenticates nobody; 403 on a real token for the wrong
         household (wildcard tokens pass any)."""
-        claims = verify_token(self.secret, token)
+        claims = self.verify(token)
         claimed = claims["household"]
         if claimed == WILDCARD_HOUSEHOLD:
             return claims
@@ -164,7 +255,7 @@ class TokenAuthenticator:
 
     def check_admin(self, token: Optional[str]) -> dict:
         """Authorize the admin surface (stats/swap/drain): wildcard only."""
-        claims = verify_token(self.secret, token)
+        claims = self.verify(token)
         if claims["household"] != WILDCARD_HOUSEHOLD:
             raise AuthError(
                 "admin surface requires the operator wildcard token",

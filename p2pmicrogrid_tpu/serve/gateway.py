@@ -279,6 +279,7 @@ class ServeGateway:
         tls=None,
         authenticator=None,
         restarts: int = 0,
+        trace_decisions: bool = True,
     ):
         self.registry = registry
         self.admission = admission or AdmissionConfig()
@@ -305,6 +306,10 @@ class ServeGateway:
         # Relaunch count (set by the process-fleet supervisor via
         # --restarts) so fleet stats attribute churn per replica.
         self.restarts = restarts
+        # Per-request (household, obs, action) decision traces into each
+        # bundle's telemetry — what data/trace_export.py replays back into
+        # continual-training buffers. Costless without a warehouse sink.
+        self.trace_decisions = trace_decisions
         self.created = time.strftime("%Y-%m-%dT%H:%M:%S%z")
         self._t0 = time.monotonic()
         self._server: Optional[asyncio.AbstractServer] = None
@@ -732,6 +737,24 @@ class ServeGateway:
         # float32 -> Python float (binary64) is exact, and json round-trips
         # binary64 — network actions are bit-identical to engine.act's.
         actions: List = [[float(a) for a in row] for row in rows]
+        if self.trace_decisions and bundle.telemetry is not None:
+            # The continual-learning flywheel's data source
+            # (data/trace_export.py): one ``serve_decision`` event per obs
+            # row — the household, the observation it sent and the action
+            # the SERVING bundle answered, keyed by that bundle's
+            # config_hash through its telemetry run. Fenced: a sink
+            # hiccup must not fail a request whose inference succeeded.
+            try:
+                for b in range(obs.shape[0]):
+                    bundle.telemetry.event(
+                        "serve_decision",
+                        household=household,
+                        row=b,
+                        obs=obs[b].tolist(),
+                        action=actions[b],
+                    )
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
         return 200, {
             "actions": actions if batched else actions[0],
             "config_hash": bundle.config_hash,

@@ -181,6 +181,19 @@ class BundleRegistry:
         with self._lock:
             self._split = None
 
+    def clear_pins(self) -> None:
+        """Drop every household's bundle affinity so the NEXT request
+        re-routes against the current default/split. The canary ramp
+        (serve/promotion.py) calls this when WIDENING a split: pins
+        recorded at 5% would otherwise freeze the arm's membership —
+        set_split only assigns unpinned households, so the 25% stage
+        would keep serving the 5% population. Re-rolling is monotone for
+        the households already in the arm (the split hash is per-
+        household deterministic: slot < 5 implies slot < 25), so their
+        sessions survive the widening."""
+        with self._lock:
+            self._pins.clear()
+
     # -- routing hot path ----------------------------------------------------
 
     def route(self, household_id: Optional[str] = None) -> ServingBundle:

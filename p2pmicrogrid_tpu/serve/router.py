@@ -85,6 +85,7 @@ from p2pmicrogrid_tpu.serve.loadgen import (
 from p2pmicrogrid_tpu.serve.wire import (
     FrameTooLarge,
     MuxPool,
+    SyncMuxProbe,
     WireProtocolError,
 )
 
@@ -255,12 +256,18 @@ class FleetRouter:
         transport: str = "auto",
         mux_pool_size: int = 2,
         mux_max_frame_bytes: Optional[int] = None,
+        probe_transport: str = "auto",
     ):
         if not replicas:
             raise ValueError("pass at least one replica")
         if transport not in ("auto", "http", "mux"):
             raise ValueError(
                 f"transport must be 'auto', 'http' or 'mux', got {transport!r}"
+            )
+        if probe_transport not in ("auto", "http", "mux"):
+            raise ValueError(
+                "probe_transport must be 'auto', 'http' or 'mux', got "
+                f"{probe_transport!r}"
             )
         self.retry = retry or RetryPolicy()
         self.budget = budget or RetryBudget()
@@ -293,20 +300,35 @@ class FleetRouter:
         self._mux_pools: "weakref.WeakKeyDictionary" = (
             weakref.WeakKeyDictionary()
         )
+        # Probe sweeps reuse ONE persistent framed connection per replica
+        # (wire.SyncMuxProbe) instead of a fresh (TLS) handshake per
+        # replica per sweep — the per-sweep cost that dominated at fleet
+        # scale. 'auto' probes over mux when the replica advertises a
+        # listener, HTTP otherwise; half-open connections fail the probe
+        # via timeout/reset exactly like a dead HTTP endpoint would.
+        self.probe_transport = probe_transport
+        self._probe_conns: Dict[str, SyncMuxProbe] = {}
         self._lock = threading.RLock()
         self._ring = ConsistentHashRing(vnodes=vnodes)
         self._state: Dict[str, _ReplicaState] = {}
         self._order: List[str] = []
-        if transport == "mux":
+        missing = [r.replica_id for r in replicas if r.mux_port is None]
+        if transport == "mux" and missing:
             # Fail at construction, not as per-request "transport errors"
             # that would eject every (healthy) replica and read as a
             # fleet-wide outage instead of a configuration mistake.
-            missing = [r.replica_id for r in replicas if r.mux_port is None]
-            if missing:
-                raise ValueError(
-                    "transport='mux' but replica(s) advertise no "
-                    f"mux_port: {', '.join(missing)}"
-                )
+            raise ValueError(
+                "transport='mux' but replica(s) advertise no "
+                f"mux_port: {', '.join(missing)}"
+            )
+        if probe_transport == "mux" and missing:
+            # Same construction-time refusal: a forced mux probe against a
+            # mux-less replica would read as that replica being down
+            # forever.
+            raise ValueError(
+                "probe_transport='mux' but replica(s) advertise no "
+                f"mux_port: {', '.join(missing)}"
+            )
         for r in replicas:
             self._state[r.replica_id] = _ReplicaState(replica=r)
             self._order.append(r.replica_id)
@@ -477,21 +499,53 @@ class FleetRouter:
             self.mark_result(rid, ok, error=error)
         return results
 
-    def _probe(self, rep: Replica) -> Tuple[bool, str]:
+    def _probe_conn_for(self, rep: Replica) -> SyncMuxProbe:
+        """The replica's persistent probe connection (created lazily; it
+        survives across sweeps — that persistence IS the point)."""
+        with self._lock:
+            conn = self._probe_conns.get(rep.replica_id)
+            if conn is None:
+                conn = SyncMuxProbe(
+                    rep.host, rep.mux_port,
+                    ssl_context=self.ssl_context,
+                    timeout_s=self.probe_timeout_s,
+                )
+                self._probe_conns[rep.replica_id] = conn
+        return conn
+
+    def _probe_readyz(self, rep: Replica) -> Tuple[int, Optional[dict]]:
+        """One ``GET /readyz`` over the probe transport: the replica's
+        persistent mux connection when it advertises one (no fresh TLS
+        handshake per sweep), a fresh HTTP connection otherwise. Raises
+        OSError-family on transport failure — a half-open mux connection
+        (SIGKILLed peer, stalled stream) surfaces as a timeout/reset here
+        exactly like a dead HTTP endpoint."""
+        use_mux = self.probe_transport == "mux" or (
+            self.probe_transport == "auto" and rep.mux_port is not None
+        )
+        if use_mux:
+            return self._probe_conn_for(rep).request("/readyz")
         conn = self._http_conn(rep, self.probe_timeout_s)
         try:
             conn.request("GET", "/readyz")
             resp = conn.getresponse()
             raw = resp.read()
-            if resp.status != 200:
-                return False, f"/readyz answered {resp.status}"
             try:
                 doc = json.loads(raw) if raw else {}
             except (UnicodeDecodeError, json.JSONDecodeError):
                 doc = {}
+            return resp.status, doc if isinstance(doc, dict) else None
+        finally:
+            conn.close()
+
+    def _probe(self, rep: Replica) -> Tuple[bool, str]:
+        try:
+            status, doc = self._probe_readyz(rep)
+            if status != 200:
+                return False, f"/readyz answered {status}"
             with self._lock:
                 fleet_hash = self.fleet_config_hash
-            served = doc.get("config_hash") if isinstance(doc, dict) else None
+            served = (doc or {}).get("config_hash")
             if fleet_hash and served and served != fleet_hash:
                 # A replica that missed a fleet swap (killed/restarted
                 # around it) must NOT be re-admitted on its stale default —
@@ -505,10 +559,10 @@ class FleetRouter:
                     f"{fleet_hash} (swap re-pushed)"
                 )
             return True, ""
-        except (OSError, http.client.HTTPException) as err:
+        except (
+            OSError, http.client.HTTPException, WireProtocolError,
+        ) as err:
             return False, f"{type(err).__name__}: {err}"
-        finally:
-            conn.close()
 
     def _push_swap(self, rep: Replica, config_hash: str) -> None:
         """Best-effort synchronous ``/admin/swap`` push (probe thread)."""
@@ -543,6 +597,16 @@ class FleetRouter:
         if self._prober is not None:
             self._prober.join(timeout=10.0)
             self._prober = None
+        self.close_probe_conns()
+
+    def close_probe_conns(self) -> None:
+        """Close the persistent per-replica probe connections (teardown;
+        the next probe_once reconnects on demand)."""
+        with self._lock:
+            conns = list(self._probe_conns.values())
+            self._probe_conns.clear()
+        for conn in conns:
+            conn.close()
 
     # -- routing -------------------------------------------------------------
 

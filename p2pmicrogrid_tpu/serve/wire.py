@@ -391,6 +391,127 @@ class MuxPool:
                 self._conns[i] = None
 
 
+# -- client: synchronous probe connection --------------------------------------
+
+
+class SyncMuxProbe:
+    """One persistent framed connection for SYNCHRONOUS health probing.
+
+    The router's prober runs on a plain thread (serve/router.py), so it
+    cannot ride the asyncio ``MuxPool``; before this class each ``/readyz``
+    sweep opened a fresh HTTP connection per replica — with TLS, a full
+    handshake per replica per sweep (ROADMAP item-1 follow-on: fine at
+    N=3, ruinous at N=100). This is the sync counterpart: one blocking
+    socket per (prober, replica) that stays up ACROSS sweeps and carries
+    one ``GET /readyz`` frame per probe over the replica's mux listener.
+
+    Failure semantics match what a probe must detect: connect failure,
+    reset, EOF, a protocol error, or a response that never arrives within
+    ``timeout_s`` (the half-open case — a SIGKILLed peer never FINs) all
+    raise ``OSError``-family errors; the caller scores the probe failed
+    and ``close()``s, and the next sweep reconnects. The probe path is
+    sequential (one frame in flight), so ids only guard against a stale
+    late answer after a timeout: mismatched ids are drained, never
+    returned.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        ssl_context=None,
+        timeout_s: float = 2.0,
+        max_frame_bytes: int = MAX_FRAME_BYTES,
+    ):
+        import socket
+
+        self.host = host
+        self.port = port
+        self.ssl_context = ssl_context
+        self.timeout_s = timeout_s
+        self.max_frame_bytes = max_frame_bytes
+        self._sock = None
+        self._next_id = 0
+        self.connects = 0
+        self._socket_mod = socket
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _connect(self):
+        sock = self._socket_mod.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        )
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(
+                sock, server_hostname=self.host
+            )
+        sock.settimeout(self.timeout_s)
+        self._sock = sock
+        self.connects += 1
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionResetError("mux probe connection closed")
+            buf += chunk
+        return buf
+
+    def request(self, path: str, method: str = "GET", token=None):
+        """(status, body doc | None) for one frame; raises OSError-family
+        on any transport/protocol/timeout failure (the connection is
+        closed by then — the next call reconnects)."""
+        try:
+            if self._sock is None:
+                self._connect()
+            rid = self._next_id
+            self._next_id += 1
+            frame: dict = {"id": rid, "method": method, "path": path}
+            if token is not None:
+                frame["token"] = token
+            self._sock.sendall(encode_frame(frame))
+            while True:
+                prefix = self._recv_exact(_LEN_BYTES)
+                length = int.from_bytes(prefix, "big")
+                if length > self.max_frame_bytes:
+                    raise WireProtocolError(
+                        f"frame of {length} bytes exceeds the "
+                        f"{self.max_frame_bytes}-byte cap"
+                    )
+                raw = self._recv_exact(length) if length else b""
+                try:
+                    doc = json.loads(raw.decode())
+                except (UnicodeDecodeError, json.JSONDecodeError) as err:
+                    raise WireProtocolError(
+                        f"frame is not valid JSON: {err}"
+                    ) from None
+                if not isinstance(doc, dict):
+                    raise WireProtocolError("frame must be a JSON object")
+                if doc.get("id") != rid:
+                    continue  # stale answer from a timed-out earlier probe
+                status = doc.get("status")
+                if not isinstance(status, int):
+                    raise WireProtocolError("response frame carries no status")
+                body = doc.get("body")
+                return status, body if isinstance(body, dict) else None
+        except (OSError, WireProtocolError):
+            # One failure poisons the stream position — close so the next
+            # probe reconnects instead of parsing mid-frame garbage.
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
 # -- server: shared mux accept-loop body --------------------------------------
 
 

@@ -312,6 +312,24 @@ class SqliteSink:
                     file=sys.stderr,
                 )
 
+    def flush(self) -> None:
+        """Push buffered points to the warehouse NOW (the canary
+        controller reads per-bundle attribution between live stages —
+        serve/promotion.py — and must not race the 64-record batch
+        buffer). Failures fall back to emit()'s drop-and-warn policy."""
+        with self._lock:
+            try:
+                self._flush_locked()
+            except Exception as err:  # noqa: BLE001 — mirror emit()
+                self._points = []
+                if not getattr(self, "_flush_warned", False):
+                    self._flush_warned = True
+                    print(
+                        f"SqliteSink: dropping telemetry points "
+                        f"({type(err).__name__}: {err})",
+                        file=sys.stderr,
+                    )
+
     def close(self) -> None:
         with self._lock:
             # Re-upsert the run row so late manifest annotations (mesh
@@ -621,6 +639,15 @@ class Telemetry:
             "histograms": {k: self._hist_stats(v) for k, v in self._hists.items()},
             "spans": self.spans.totals(),
         }
+
+    def flush(self) -> None:
+        """Push buffered records through every flushable sink (SqliteSink
+        batches inserts; a mid-run warehouse reader — the canary
+        controller's per-stage attribution — calls this at its read
+        boundaries). Sinks without a flush are already unbuffered."""
+        for sink in self.sinks:
+            if hasattr(sink, "flush"):
+                sink.flush()
 
     def close(self) -> None:
         """Flush the summary + Chrome trace to the run dir and close sinks.

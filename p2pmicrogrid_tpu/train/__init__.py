@@ -26,8 +26,18 @@ from p2pmicrogrid_tpu.train.checkpoint import (
     latest_checkpoint,
     verify_checkpoint,
 )
+from p2pmicrogrid_tpu.train.continual import (
+    ContinualResult,
+    offpolicy_pretrain,
+    state_from_bundle,
+    train_continual,
+)
 
 __all__ = [
+    "ContinualResult",
+    "offpolicy_pretrain",
+    "state_from_bundle",
+    "train_continual",
     "checkpoint_dir",
     "save_checkpoint",
     "restore_checkpoint",

@@ -1,0 +1,446 @@
+"""Continual training: fine-tune the live bundle on fresh serve traces.
+
+The training half of the flywheel (ROADMAP item 5). Production policies
+go stale as load/PV/price regimes drift; the warehouse records every
+decision the live bundle made (data/trace_export.py); this module turns
+those decisions back into a CANDIDATE bundle:
+
+1. **Warm start from the incumbent.** A policy bundle freezes only the
+   greedy subtree (serve/export.py), so ``state_from_bundle`` rebuilds a
+   full learner state around it: fresh optimizer/replay/exploration
+   scaffolding, the bundle's greedy parameters grafted in (DQN/DDPG
+   targets hard-copied from the grafted online/actor — fine-tuning must
+   not bootstrap against random targets).
+2. **Off-policy pretraining on the traces.** ``offpolicy_pretrain`` runs
+   jitted TD/Bellman/actor-critic steps on minibatches sampled from the
+   exported transitions — the SAME update rules the per-slot learners use
+   (models/tabular.tabular_update, models/dqn.apply_td_update,
+   models/ddpg.ddpg_learn_batch), so trace training cannot drift from
+   episode-training semantics.
+3. **Chunked simulator fine-tune under the guard.** ``train_continual``
+   then runs the donated-carry chunked pipeline (PR 4) through
+   ``train_chunked_with_rollback`` (PRs 7/9): the divergence guard trips
+   on non-finite counters or basin verdicts, rollback restores the last
+   verified checkpoint with dropped lrs on a fresh key branch — a
+   continually-retrained candidate can never emerge from a diverged run.
+4. **Candidate export.** The result freezes into a bundle whose config
+   carries a bumped ``train.starting_episodes`` (continual generations
+   CONTINUE the episode count), giving the candidate a config_hash
+   distinct from the incumbent's — the registry/canary routing key — with
+   full provenance (incumbent hash, trace window, rollbacks) in the
+   manifest ``source``.
+
+Nothing here pushes traffic: the candidate must pass the promotion gate
+and canary (serve/promotion.py) before a household ever sees it.
+
+Host-sync note: this module is on the training dispatch path
+(tools/check_host_sync.py); the pretrain loop is one jitted scan and the
+chunked phase inherits the async pipeline's discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from p2pmicrogrid_tpu.train.resilience import GuardPolicy, RollbackRecord
+
+
+@dataclass
+class ContinualResult:
+    """What one continual-training run produced."""
+
+    candidate_dir: str
+    candidate_hash: str
+    incumbent_hash: Optional[str]
+    episode0: int
+    episodes: int
+    trace_steps: int
+    trace_loss_final: Optional[float]
+    trace_summary: dict = field(default_factory=dict)
+    rollbacks: List[RollbackRecord] = field(default_factory=list)
+
+    def summary(self) -> dict:
+        return {
+            "candidate_dir": self.candidate_dir,
+            "candidate_hash": self.candidate_hash,
+            "incumbent_hash": self.incumbent_hash,
+            "episode0": self.episode0,
+            "episodes": self.episodes,
+            "trace_steps": self.trace_steps,
+            "trace_loss_final": self.trace_loss_final,
+            "rollbacks": len(self.rollbacks),
+            **{f"trace_{k}": v for k, v in self.trace_summary.items()},
+        }
+
+
+def _check_bundle_matches(cfg, manifest: dict) -> None:
+    impl = manifest.get("implementation")
+    if impl != cfg.train.implementation:
+        raise ValueError(
+            f"bundle implements {impl!r} but the config trains "
+            f"{cfg.train.implementation!r} — continual training must "
+            "fine-tune the SAME policy class it serves"
+        )
+    n_agents = manifest.get("n_agents")
+    if n_agents != cfg.sim.n_agents:
+        raise ValueError(
+            f"bundle serves {n_agents} agents but the config simulates "
+            f"{cfg.sim.n_agents}"
+        )
+
+
+def state_from_bundle(cfg, manifest: dict, params: dict, key):
+    """Full shared learner state (what the chunked trainer carries —
+    parallel/scenarios.init_shared_pol_state) warm-started from a
+    bundle's greedy subtree. Fresh optimizer/exploration scaffolding;
+    bootstrap targets hard-copied from the grafted parameters."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.parallel.scenarios import init_shared_pol_state
+
+    _check_bundle_matches(cfg, manifest)
+    impl = cfg.train.implementation
+    as_f32 = lambda tree: jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, dtype=jnp.float32), tree
+    )
+    state = init_shared_pol_state(cfg, key)
+    if impl == "tabular":
+        q = as_f32(params["q_table"])
+        if q.shape != state.q_table.shape:
+            raise ValueError(
+                f"bundle q_table {q.shape} != config table "
+                f"{state.q_table.shape}"
+            )
+        return state._replace(q_table=q)
+    if impl == "dqn":
+        online = as_f32(params)
+        target = jax.tree_util.tree_map(lambda x: x, online)
+        return state._replace(online=online, target=target)
+    # ddpg: the bundle is the actor; the critic trains fresh from init
+    # (it was never exported), targets copy their live twins.
+    share = bool(manifest.get("model", {}).get("share_across_agents"))
+    if share != bool(cfg.ddpg.share_across_agents):
+        raise ValueError(
+            f"bundle share_across_agents={share} but config says "
+            f"{cfg.ddpg.share_across_agents}"
+        )
+    actor = as_f32(params)
+    return state._replace(
+        actor=actor,
+        actor_target=jax.tree_util.tree_map(lambda x: x, actor),
+        critic_target=jax.tree_util.tree_map(lambda x: x, state.critic),
+    )
+
+
+def _frac_to_action_index(frac):
+    """Served hp fractions {0.0, 0.5, 1.0} back to the discrete action
+    index (models/dqn.ACTION_VALUES); nearest bin, so a float16 bundle's
+    quantized fractions still map correctly."""
+    import jax.numpy as jnp
+
+    return jnp.clip(jnp.round(frac * 2.0), 0, 2).astype(jnp.int32)
+
+
+def make_trace_update_fn(cfg, dataset, batch_size: Optional[int] = None):
+    """Jitted one-step off-policy update over the trace transitions.
+
+    Returns ``update(pol_state, key) -> (pol_state, loss)`` closed over
+    the dataset as device constants. Each step draws ``batch_size``
+    transition slots uniformly and applies the implementation's OWN
+    learn rule — there is exactly one copy of the update semantics in the
+    repo and this reuses it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    impl = cfg.train.implementation
+    n = dataset.n_transitions
+    obs = jnp.asarray(dataset.obs)          # [N, A, 4]
+    action = jnp.asarray(dataset.action)    # [N, A]
+    reward = jnp.asarray(dataset.reward)    # [N, A]
+    next_obs = jnp.asarray(dataset.next_obs)
+
+    if impl == "tabular":
+        from p2pmicrogrid_tpu.models.tabular import tabular_update
+
+        b = min(batch_size or 32, n)
+        act_idx = _frac_to_action_index(action)
+
+        def update(state, key):
+            idx = jax.random.randint(key, (b,), 0, n)
+
+            def one(st, i):
+                return tabular_update(
+                    cfg.qlearning, st, obs[i], act_idx[i], reward[i],
+                    next_obs[i],
+                ), 0.0
+
+            state, _ = jax.lax.scan(one, state, idx)
+            return state, jnp.zeros(())
+
+        return jax.jit(update)
+
+    if impl == "dqn":
+        from p2pmicrogrid_tpu.models.dqn import (
+            ACTION_VALUES,
+            _td_loss,
+            apply_td_update,
+        )
+        from p2pmicrogrid_tpu.models.networks import QNetwork
+
+        b = min(batch_size or cfg.dqn.batch_size, n)
+        net = QNetwork(hidden=cfg.dqn.hidden)
+        act_frac = ACTION_VALUES[_frac_to_action_index(action)][..., None]
+
+        def update(state, key):
+            idx = jax.random.randint(key, (b,), 0, n)
+            # [B, A, ...] -> per-agent batches [A, B, ...].
+            s = jnp.swapaxes(obs[idx], 0, 1)
+            a = jnp.swapaxes(act_frac[idx], 0, 1)
+            r = jnp.swapaxes(reward[idx], 0, 1)
+            ns = jnp.swapaxes(next_obs[idx], 0, 1)
+
+            def learn_one(params, target_params, opt_state, s, a, r, ns):
+                return apply_td_update(
+                    cfg.dqn,
+                    lambda p: _td_loss(
+                        cfg.dqn, net, p, target_params, s, a, r, ns
+                    ),
+                    params, target_params, opt_state,
+                )
+
+            online, target, opt_state, loss, _ = jax.vmap(learn_one)(
+                state.online, state.target, state.opt_state, s, a, r, ns
+            )
+            return state._replace(
+                online=online, target=target, opt_state=opt_state
+            ), jnp.mean(loss)
+
+        return jax.jit(update)
+
+    if impl == "ddpg":
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_learn_batch
+
+        b = min(batch_size or cfg.ddpg.batch_size, n)
+        act_col = action[..., None]  # [N, A, 1]
+
+        def update(params, key):
+            idx = jax.random.randint(key, (b,), 0, n)
+            s, a = obs[idx], act_col[idx]          # [B, A, ...]
+            r, ns = reward[idx], next_obs[idx]
+            if cfg.ddpg.share_across_agents:
+                flat = lambda x: x.reshape((-1,) + x.shape[2:])
+                pa, pc, pat, pct, oa, oc, _, sq = ddpg_learn_batch(
+                    cfg.ddpg,
+                    params.actor, params.critic,
+                    params.actor_target, params.critic_target,
+                    params.actor_opt, params.critic_opt,
+                    flat(s), flat(a), flat(r), flat(ns),
+                )
+            else:
+                pool = lambda x: jnp.moveaxis(x, 1, 0)  # [A, B, ...]
+                pa, pc, pat, pct, oa, oc, _, sq = jax.vmap(
+                    lambda *args: ddpg_learn_batch(cfg.ddpg, *args)
+                )(
+                    params.actor, params.critic,
+                    params.actor_target, params.critic_target,
+                    params.actor_opt, params.critic_opt,
+                    pool(s), pool(a), pool(r), pool(ns),
+                )
+            return params._replace(
+                actor=pa, critic=pc, actor_target=pat, critic_target=pct,
+                actor_opt=oa, critic_opt=oc,
+            ), jnp.mean(sq)
+
+        return jax.jit(update)
+
+    raise ValueError(f"unknown implementation {impl!r}")
+
+
+def offpolicy_pretrain(
+    cfg,
+    pol_state,
+    dataset,
+    key,
+    steps: int,
+    batch_size: Optional[int] = None,
+) -> Tuple[object, np.ndarray]:
+    """``steps`` off-policy updates on the trace transitions; returns
+    ``(pol_state, losses [steps])``. One jitted scan — the whole pretrain
+    is a single device dispatch regardless of step count."""
+    import jax
+
+    if steps <= 0:
+        return pol_state, np.zeros((0,), dtype=np.float32)
+    update = make_trace_update_fn(cfg, dataset, batch_size=batch_size)
+
+    def body(state, k):
+        return update(state, k)
+
+    keys = jax.random.split(key, steps)
+    pol_state, losses = jax.lax.scan(body, pol_state, keys)
+    # host-sync: pretrain result readback at the phase boundary — the
+    # chunked fine-tune (and its guard) consumes the finished state.
+    return pol_state, np.asarray(losses, dtype=np.float32)
+
+
+def continual_cfg(cfg, episode0: int, incumbent_hash: Optional[str]):
+    """The candidate's config: the incumbent's experiment with
+    ``train.starting_episodes`` advanced to ``episode0``. Continual
+    generations CONTINUE the episode count, which (a) keys the chunked
+    trainer's episode streams off fresh absolute episodes and (b) gives
+    the candidate a distinct ``config_hash`` — the identity every
+    routing/attribution layer keys on. If the hash still collides with
+    the incumbent's (an episode0 that matches the incumbent's own
+    export), the episode origin is advanced deterministically until it
+    does not."""
+    from p2pmicrogrid_tpu.telemetry import config_hash
+
+    for bump in range(64):
+        candidate = cfg.replace(
+            train=dataclasses.replace(
+                cfg.train, starting_episodes=episode0 + bump
+            )
+        )
+        if incumbent_hash is None or config_hash(candidate) != incumbent_hash:
+            return candidate
+    raise RuntimeError("could not derive a distinct candidate config_hash")
+
+
+def train_continual(
+    cfg,
+    incumbent_dir: str,
+    dataset,
+    out_dir: str,
+    ckpt_dir: str,
+    n_episodes: int = 20,
+    n_chunks: int = 1,
+    eval_every: int = 10,
+    trace_steps: int = 200,
+    trace_batch: Optional[int] = None,
+    episode0: Optional[int] = None,
+    guard_policy: GuardPolicy = GuardPolicy(),
+    telemetry=None,
+    dtype: str = "float32",
+    s_eval: int = 8,
+    pipeline: bool = True,
+) -> ContinualResult:
+    """The continual-training driver: incumbent bundle + fresh traces ->
+    candidate bundle.
+
+    Phases (module docstring): warm start, ``trace_steps`` off-policy
+    updates on ``dataset``, then ``n_episodes`` of the chunked pipeline
+    under the divergence guard with rollback, then export to ``out_dir``.
+    ``n_episodes=0`` skips the simulator phase (pure trace fine-tune —
+    the fast path for tests and tight retraining cadences).
+
+    The returned ``ContinualResult`` carries the candidate's
+    ``config_hash`` — the id the promotion pipeline (serve/promotion.py)
+    gates and ramps.
+    """
+    import jax
+
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.serve.export import (
+        export_policy_bundle,
+        load_policy_bundle,
+    )
+    from p2pmicrogrid_tpu.telemetry import config_hash
+    from p2pmicrogrid_tpu.train.resilience import train_chunked_with_rollback
+
+    manifest, params = load_policy_bundle(incumbent_dir)
+    incumbent_hash = manifest.get("config_hash")
+    if episode0 is None:
+        source = manifest.get("source") or {}
+        src_ep = source.get("episode")
+        episode0 = (
+            src_ep + 1 if isinstance(src_ep, int) and src_ep >= 0
+            else cfg.train.starting_episodes
+        )
+    cand_cfg = continual_cfg(cfg, episode0, incumbent_hash)
+    episode0 = cand_cfg.train.starting_episodes
+    key = jax.random.PRNGKey(cand_cfg.train.seed)
+    key, k_warm, k_trace, k_train = jax.random.split(key, 4)
+    pol_state = state_from_bundle(cand_cfg, manifest, params, k_warm)
+
+    if telemetry is not None:
+        telemetry.event(
+            "continual",
+            phase="start",
+            incumbent=incumbent_hash,
+            episode0=episode0,
+            trace_transitions=dataset.n_transitions,
+            trace_steps=trace_steps,
+            n_episodes=n_episodes,
+        )
+    pol_state, trace_losses = offpolicy_pretrain(
+        cand_cfg, pol_state, dataset, k_trace,
+        steps=trace_steps, batch_size=trace_batch,
+    )
+    trace_loss_final = (
+        float(trace_losses[-1]) if trace_losses.size else None
+    )
+    if telemetry is not None:
+        telemetry.event(
+            "continual",
+            phase="trace_pretrain",
+            steps=int(trace_losses.size),
+            loss_final=trace_loss_final,
+        )
+        telemetry.counter("continual.trace_steps", int(trace_losses.size))
+
+    rollbacks: List[RollbackRecord] = []
+    if n_episodes > 0:
+        rng = np.random.default_rng(cand_cfg.train.seed)
+        ratings = make_ratings(cand_cfg, rng)
+        (pol_state, _, _, _, _), rollbacks = train_chunked_with_rollback(
+            cand_cfg, pol_state, ratings, k_train, ckpt_dir,
+            n_episodes=n_episodes, n_chunks=n_chunks,
+            eval_every=eval_every, episode0=episode0,
+            guard_policy=guard_policy,
+            telemetry=telemetry,
+            s_eval=s_eval, pipeline=pipeline,
+        )
+
+    export_policy_bundle(
+        cand_cfg, pol_state, out_dir,
+        source={
+            "kind": "continual",
+            "incumbent": incumbent_hash,
+            "incumbent_dir": os.path.abspath(incumbent_dir),
+            "episode": episode0 + n_episodes - 1,
+            "trace_transitions": dataset.n_transitions,
+            "trace_runs": list(dataset.run_ids),
+            "trace_steps": int(trace_losses.size),
+            "sim_episodes": n_episodes,
+            "rollbacks": len(rollbacks),
+        },
+        dtype=dtype,
+    )
+    cand_hash = config_hash(cand_cfg)
+    if telemetry is not None:
+        telemetry.event(
+            "continual",
+            phase="exported",
+            candidate=cand_hash,
+            incumbent=incumbent_hash,
+            out_dir=os.path.abspath(out_dir),
+            rollbacks=len(rollbacks),
+        )
+    return ContinualResult(
+        candidate_dir=out_dir,
+        candidate_hash=cand_hash,
+        incumbent_hash=incumbent_hash,
+        episode0=episode0,
+        episodes=n_episodes,
+        trace_steps=int(trace_losses.size),
+        trace_loss_final=trace_loss_final,
+        trace_summary=dataset.summary(),
+        rollbacks=rollbacks,
+    )

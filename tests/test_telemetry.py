@@ -972,6 +972,7 @@ class TestServeCompaction:
             summary = store.compact_serve_telemetry(older_than_hours=1.0)
             assert summary == {
                 "rows_compacted": 12, "aggregates_written": 2,
+                "decisions_compacted": 0,
             }
             # The recent row survives raw; the old tail is aggregates now.
             (raw,) = store.con.execute(
@@ -998,6 +999,7 @@ class TestServeCompaction:
             # Idempotent: a second pass finds nothing left to compact.
             assert store.compact_serve_telemetry(older_than_hours=1.0) == {
                 "rows_compacted": 0, "aggregates_written": 0,
+                "decisions_compacted": 0,
             }
             # The warehouse stays orphan-free (seq continuity preserved).
             (orphans,) = store.con.execute(
@@ -1028,6 +1030,7 @@ class TestServeCompaction:
         with ResultsStore(db) as store:
             assert store.compact_serve_telemetry(older_than_hours=1.0) == {
                 "rows_compacted": 4, "aggregates_written": 1,
+                "decisions_compacted": 0,
             }
         for i in range(4):  # the live sink keeps streaming afterwards
             sink.emit({"ts": _time.time(), "kind": "serve_request",

@@ -380,6 +380,64 @@ def check_resilience_jsonl(path: str, problems: list) -> None:
                 )
 
 
+# Promotion captures (`promote --inject`, serve/promotion.py): every
+# promotion_case row is a gate/canary decision and must carry the safety
+# contract — the gate verdict string, the canary stage list, availability
+# in [0, 1] and the rolled_back/promoted booleans. A case row without
+# them proved nothing about deployment safety.
+def check_promotion_jsonl(path: str, problems: list) -> None:
+    """PROMOTION_*.jsonl: metric rows + the promotion-case contract."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return  # already reported by check_metric_jsonl
+    saw_case = False
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # already reported
+        if not isinstance(row, dict) or row.get("metric") != "promotion_case":
+            continue
+        saw_case = True
+        if not isinstance(row.get("gate_verdict"), str):
+            problems.append(
+                f"{where}:{i + 1}: promotion_case missing string "
+                "'gate_verdict'"
+            )
+        if not isinstance(row.get("canary_stages"), list):
+            problems.append(
+                f"{where}:{i + 1}: promotion_case missing list "
+                "'canary_stages'"
+            )
+        availability = row.get("availability")
+        if not isinstance(availability, (int, float)) or isinstance(
+            availability, bool
+        ):
+            problems.append(
+                f"{where}:{i + 1}: promotion_case missing numeric "
+                "'availability'"
+            )
+        elif not 0.0 <= availability <= 1.0:
+            problems.append(
+                f"{where}:{i + 1}: availability {availability} outside "
+                "[0, 1]"
+            )
+        for key in ("rolled_back", "promoted"):
+            if not isinstance(row.get(key), bool):
+                problems.append(
+                    f"{where}:{i + 1}: promotion_case missing boolean "
+                    f"{key!r}"
+                )
+    if not saw_case:
+        problems.append(f"{where}: no promotion_case row")
+
+
 # Checkpoint integrity manifests (train/checkpoint.py save layout):
 # models_<impl>/<setting>/ep_<episode>/p2p_manifest.json.
 CHECKPOINT_MANIFEST_GLOBS = (
@@ -734,6 +792,10 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
         glob.glob(os.path.join(repo_root, "artifacts", "RESILIENCE_*.jsonl"))
     ):
         check_resilience_jsonl(path, problems)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "PROMOTION_*.jsonl"))
+    ):
+        check_promotion_jsonl(path, problems)
     for pattern in CHECKPOINT_MANIFEST_GLOBS:
         for path in sorted(glob.glob(os.path.join(repo_root, pattern))):
             check_checkpoint_manifest(path, problems)

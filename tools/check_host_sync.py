@@ -70,6 +70,12 @@ HOT_PATH_FILES = (
     # per block, checkpoint callbacks on the save cadence): a blocking
     # readback here would serialize the whole async pipeline it guards.
     os.path.join("p2pmicrogrid_tpu", "train", "resilience.py"),
+    # The continual loop (PR 10): the trace-pretrain scan and the chunked
+    # fine-tune it enters share the training dispatch path, and the
+    # promotion gate/canary run next to live serving — stray readbacks in
+    # either stall training or the canary's stage cadence.
+    os.path.join("p2pmicrogrid_tpu", "train", "continual.py"),
+    os.path.join("p2pmicrogrid_tpu", "serve", "promotion.py"),
     os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
 )
 
