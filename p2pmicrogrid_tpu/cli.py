@@ -2414,12 +2414,53 @@ def cmd_continual(args) -> int:
     if not args.bundle:
         raise SystemExit("pass --bundle (the incumbent bundle directory)")
     cfg = _build_cfg(args)
-    dataset = export_serve_traces(
-        args.results_db,
-        config_hash=args.config_hash,
-        cfg=cfg,
-        min_transitions=args.min_transitions,
-    )
+    # The operator-driven command speaks the same export/retention
+    # handshake the autopilot does: --windowed exports from the last
+    # released watermark under a lease (compaction cannot race it), and
+    # --settlement attributes reward from billed warehouse rows with the
+    # loud env-model fallback.
+    import contextlib
+    import time as _time2
+
+    reward_fn = None
+    if args.settlement:
+        from p2pmicrogrid_tpu.data.trace_export import settlement_reward_fn
+
+        reward_fn = settlement_reward_fn(args.results_db, cfg)
+    since_ts = None
+    scope = contextlib.nullcontext()
+    if args.windowed:
+        import sqlite3 as _sqlite3
+
+        from p2pmicrogrid_tpu.data.results import (
+            ExportLeaseScope,
+            last_export_watermark,
+        )
+
+        con = _sqlite3.connect(args.results_db)
+        try:
+            since_ts = last_export_watermark(con, args.config_hash)
+        finally:
+            con.close()
+        # Shared choreography with the autopilot (ExportLeaseScope): a
+        # failed export cancels the lease on exit instead of gating
+        # retention for the TTL.
+        scope = ExportLeaseScope(
+            args.results_db, holder="continual-cli",
+            window_start_ts=since_ts or 0.0,
+            config_hash=args.config_hash,
+        )
+    with scope as lease_scope:
+        dataset = export_serve_traces(
+            args.results_db,
+            config_hash=args.config_hash,
+            cfg=cfg,
+            reward_fn=reward_fn,
+            min_transitions=args.min_transitions,
+            since_ts=since_ts,
+        )
+        if args.windowed:
+            lease_scope.release(dataset.window_end_ts or _time2.time())
     print(
         f"continual: exported {dataset.n_transitions} transition(s) from "
         f"{dataset.n_decisions} decision(s) across "
@@ -2604,6 +2645,204 @@ def cmd_promote(args) -> int:
             return 0 if fields.get("promoted") else 1
     finally:
         tel.close()
+        if out_f is not None:
+            out_f.close()
+
+
+def cmd_autopilot(args) -> int:
+    """The operator-less continual-deployment supervisor (serve/autopilot.py).
+
+    Daemon mode (``--replica`` ...): run retrain->gate->canary cycles on a
+    cadence against a live fleet through the router, journaling every
+    phase crash-safely under ``--state-dir`` — SIGKILL it at any instant
+    and the same command line recovers (resume or abort-to-incumbent).
+    ``--bench`` runs the committed-capture harness instead: a real
+    3-replica ``ProcessFleet``, chaos replica kill, injected bad
+    candidates and a mid-cycle SIGKILL of the autopilot itself
+    (``artifacts/AUTOPILOT_*.jsonl``).
+    """
+    import os
+    import tempfile
+
+    from p2pmicrogrid_tpu.serve.autopilot import (
+        Autopilot,
+        autopilot_bench,
+        parse_inject_plan,
+    )
+    from p2pmicrogrid_tpu.telemetry import guarded_stdout_sink
+
+    cfg = _build_cfg(args)
+    out_f = open(args.out, "a") if args.out else None
+    try:
+        with guarded_stdout_sink() as sink:
+            def emit(row: dict) -> None:
+                sink.emit(row)
+                if out_f is not None:
+                    out_f.write(json.dumps(row) + "\n")
+                    out_f.flush()
+
+            if args.bench:
+                work = args.work_dir or tempfile.mkdtemp(
+                    prefix="p2p-autopilot-"
+                )
+                # The child autopilot must build the SAME experiment
+                # config this process did — forward the cfg flags.
+                extra = [
+                    "--agents", str(args.agents),
+                    "--implementation", args.implementation,
+                    "--episodes", str(args.episodes),
+                    "--rounds", str(args.rounds),
+                ]
+                if args.homogeneous:
+                    extra.append("--homogeneous")
+                if args.no_trading:
+                    extra.append("--no-trading")
+                rows = autopilot_bench(
+                    cfg, work,
+                    n_replicas=args.replicas,
+                    n_cycles=args.cycles,
+                    inject=args.inject or
+                    "0:good,1:cost_regressed,2:nan_poisoned",
+                    seed=args.seed,
+                    chaos=args.chaos,
+                    sigkill_phase=args.sigkill_phase or None,
+                    sigkill_cycle=args.sigkill_cycle,
+                    requests_per_cycle=args.requests_per_cycle,
+                    canary_requests=args.canary_requests,
+                    n_households=args.households,
+                    stages=args.stages,
+                    emit=emit,
+                    extra_cfg_args=extra,
+                )
+                headline = rows[-1]
+                return 0 if headline.get("all_safe") else 1
+
+            # Daemon mode: a live fleet on the other side of --replica.
+            from p2pmicrogrid_tpu.serve import (
+                FleetRouter,
+                Replica,
+                RetryPolicy,
+            )
+            from p2pmicrogrid_tpu.serve.promotion import (
+                CanaryBudgets,
+                GateBudgets,
+            )
+            from p2pmicrogrid_tpu.telemetry import (
+                SqliteSink,
+                Telemetry,
+                run_manifest,
+            )
+            from p2pmicrogrid_tpu.telemetry.registry import run_stamp
+
+            if not args.results_db:
+                raise SystemExit(
+                    "autopilot needs --results-db (traces + attribution)"
+                )
+            if not args.state_dir:
+                raise SystemExit("autopilot needs --state-dir (the journal)")
+            replicas = []
+            for i, spec in enumerate(args.replica or []):
+                # host:port[/muxport] (serve-router style) or
+                # host:port[:muxport].
+                parts = spec.replace("/", ":").split(":")
+                if len(parts) < 2 or not parts[1].isdigit():
+                    raise SystemExit(
+                        f"--replica must be host:port[/muxport], got {spec!r}"
+                    )
+                replicas.append(Replica(
+                    replica_id=f"replica-{i}", host=parts[0],
+                    port=int(parts[1]),
+                    mux_port=(
+                        int(parts[2])
+                        if len(parts) > 2 and parts[2].isdigit() else None
+                    ),
+                ))
+            if not replicas:
+                raise SystemExit(
+                    "pass at least one --replica host:port[/muxport] "
+                    "(or --bench)"
+                )
+            router_token = None
+            if args.auth_secret_file:
+                from p2pmicrogrid_tpu.serve import TokenAuthenticator
+
+                router_token = TokenAuthenticator.from_secret_file(
+                    args.auth_secret_file
+                ).mint("*")
+            router = FleetRouter(
+                replicas,
+                retry=RetryPolicy(
+                    max_attempts=args.retry_attempts,
+                    deadline_s=args.retry_deadline_s,
+                ),
+                token=router_token,
+            )
+            hold_s = {}
+            hold_env = os.environ.get("P2P_AUTOPILOT_HOLD")
+            if hold_env:
+                # The crash harness's deterministic kill window: sleep
+                # this long right after journaling the named phase.
+                hold_s = {
+                    str(k): float(v)
+                    for k, v in json.loads(hold_env).items()
+                }
+            tel = Telemetry(
+                run_id=f"autopilot-{run_stamp()}",
+                sinks=[SqliteSink(args.results_db)],
+                manifest=run_manifest(
+                    cfg, extra={"autopilot_role": "supervisor"}
+                ),
+            )
+            router.telemetry = tel
+            stages = tuple(float(s) for s in args.stages.split(","))
+            pilot = Autopilot(
+                cfg,
+                router,
+                incumbent_dir=args.incumbent,
+                state_dir=args.state_dir,
+                results_db=args.results_db,
+                telemetry=tel,
+                gate_budgets=GateBudgets(
+                    cost_margin=args.cost_margin,
+                    max_reward_drop=args.max_reward_drop,
+                    slo_p95_ms=args.slo_p95_ms,
+                    slo_p99_ms=args.slo_p99_ms,
+                ),
+                canary_budgets=CanaryBudgets(
+                    max_cost_regression=args.max_cost_regression,
+                    slo_p95_ms=args.canary_p95_ms,
+                    min_requests=args.canary_min_requests,
+                ),
+                stages=stages,
+                requests_per_cycle=args.requests_per_cycle,
+                canary_requests=args.canary_requests,
+                n_households=args.households,
+                rate_hz=args.rate_hz,
+                seed=args.seed,
+                trace_steps=args.trace_steps,
+                sim_episodes=args.sim_episodes,
+                settlement=args.settlement,
+                min_transitions=args.min_transitions,
+                max_batch=args.max_batch,
+                emit=emit,
+                hold_s=hold_s,
+                verify_serving=args.verify_serving,
+                serve_device=args.serve_device,
+            )
+            router.start_probing(args.probe_interval_s)
+            try:
+                state = pilot.run(
+                    args.cycles,
+                    cadence_s=args.cadence_s,
+                    inject_plan=parse_inject_plan(args.inject),
+                )
+            finally:
+                router.stop_probing()
+                tel.close()
+            summary = pilot.summary_row()
+            emit(summary)
+            return 0 if state.bad_promotions == 0 else 1
+    finally:
         if out_f is not None:
             out_f.close()
 
@@ -2811,9 +3050,27 @@ def cmd_telemetry_query(args) -> int:
 
             rows = select(ROLLBACK_VIEW_SQL)
         elif getattr(args, "promotions", False):
-            from p2pmicrogrid_tpu.data.results import PROMOTION_VIEW_SQL
+            from p2pmicrogrid_tpu.data.results import (
+                PROMOTION_VIEW_SQL,
+                promotion_lineage,
+            )
 
             rows = select(PROMOTION_VIEW_SQL)
+            # The ancestry chain a run of unattended autopilot cycles
+            # produced (incumbent -> candidate -> candidate²): one extra
+            # row AFTER the per-candidate verdicts, with the rendered
+            # chain (None marks a segment break between parallel
+            # histories).
+            lineage = promotion_lineage(con)
+            if lineage["links"]:
+                chain = lineage["chain"]
+                rows.append({
+                    "lineage": chain,
+                    "rendered": " -> ".join(
+                        h if h is not None else "|" for h in chain
+                    ),
+                    "links": lineage["links"],
+                })
         else:
             rows = select(TELEMETRY_JOIN_SQL)
             if args.gauges:
@@ -3556,6 +3813,15 @@ def main(argv=None) -> int:
                    dest="min_transitions",
                    help="refuse to train on fewer exported transitions "
                         "(loud failure beats silent fine-tuning on noise)")
+    p.add_argument("--windowed", action="store_true",
+                   help="export from the last released export watermark "
+                        "under a warehouse LEASE (the autopilot's "
+                        "export/retention handshake — compaction cannot "
+                        "race the window)")
+    p.add_argument("--settlement", action="store_true",
+                   help="attribute training reward from billed "
+                        "'settlement' warehouse rows (loud fallback to "
+                        "the env tariff model for unbilled transitions)")
     p.add_argument("--max-rollbacks", type=_nonneg_int, default=3,
                    dest="max_rollbacks",
                    help="divergence rollback budget for the simulator "
@@ -3649,6 +3915,126 @@ def main(argv=None) -> int:
                    help="canary: candidate-arm decisions needed per stage "
                         "for a cost verdict (default 8)")
     p.set_defaults(fn=cmd_promote)
+
+    p = sub.add_parser(
+        "autopilot",
+        help="operator-less continual deployment: retrain->gate->canary "
+             "cycles on a cadence over a live fleet, crash-safe cycle "
+             "journal, zero-bad-promotion rails (serve/autopilot.py); "
+             "--bench runs the ProcessFleet + chaos + SIGKILL capture "
+             "harness",
+    )
+    _add_common(p)
+    p.add_argument("--replica", action="append",
+                   help="replica address host:port[/muxport]; repeat per "
+                        "replica (daemon mode)")
+    p.add_argument("--incumbent",
+                   help="incumbent bundle directory (seeds a FRESH "
+                        "journal; an existing journal's incumbent wins)")
+    p.add_argument("--state-dir", dest="state_dir",
+                   help="cycle journal + per-cycle candidates live here "
+                        "(the crash-recovery state)")
+    p.add_argument("--cycles", type=int, default=3,
+                   help="total cycles to complete (journal-counted across "
+                        "restarts; default 3)")
+    p.add_argument("--cadence-s", type=float, default=0.0, dest="cadence_s",
+                   help="sleep between cycles, seconds (default 0 — "
+                        "back-to-back; production runs hours)")
+    p.add_argument("--inject",
+                   help="cycle:kind[,cycle:kind...] injection plan (kinds: "
+                        "good | cost_regressed | nan_poisoned | continual); "
+                        "un-named cycles retrain for real")
+    p.add_argument("--out",
+                   help="append metric rows to this JSONL capture "
+                        "(AUTOPILOT_*.jsonl schema)")
+    p.add_argument("--bench", action="store_true",
+                   help="run the committed-capture harness (ProcessFleet "
+                        "+ chaos + autopilot SIGKILL) instead of daemon "
+                        "mode")
+    p.add_argument("--work-dir", dest="work_dir",
+                   help="--bench: working directory (default: temp dir)")
+    p.add_argument("--replicas", type=int, default=3,
+                   help="--bench: fleet size (default 3)")
+    p.add_argument("--chaos", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="--bench: SIGKILL a replica mid-run (supervisor "
+                        "relaunches it)")
+    p.add_argument("--sigkill-phase", default="retraining",
+                   dest="sigkill_phase",
+                   help="--bench: SIGKILL the autopilot in this phase "
+                        "(empty = no autopilot kill; default retraining)")
+    p.add_argument("--sigkill-cycle", type=int, default=1,
+                   dest="sigkill_cycle",
+                   help="--bench: ...of this cycle (default 1)")
+    p.add_argument("--requests-per-cycle", type=int, default=96,
+                   dest="requests_per_cycle",
+                   help="baseline traffic per cycle (the decisions the "
+                        "next retrain exports; default 96)")
+    p.add_argument("--canary-requests", type=int, default=64,
+                   dest="canary_requests",
+                   help="live requests per canary stage (default 64)")
+    p.add_argument("--households", type=int, default=16,
+                   help="distinct household ids in the traffic (default 16)")
+    p.add_argument("--rate-hz", type=float, default=64.0, dest="rate_hz",
+                   help="open-loop traffic rate (default 64)")
+    p.add_argument("--stages", default="25,100",
+                   help="canary ramp percentages ending at 100 "
+                        "(default 25,100)")
+    p.add_argument("--trace-steps", type=int, default=50,
+                   dest="trace_steps",
+                   help="off-policy pretrain steps on the exported traces "
+                        "(default 50)")
+    p.add_argument("--sim-episodes", type=int, default=0,
+                   dest="sim_episodes",
+                   help="chunked simulator fine-tune episodes per cycle "
+                        "(default 0 — pure trace fine-tune)")
+    p.add_argument("--settlement", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="bill decisions + attribute training reward from "
+                        "settlement rows (loud fallback to the env model "
+                        "when rows are missing)")
+    p.add_argument("--min-transitions", type=int, default=8,
+                   dest="min_transitions",
+                   help="refuse a cycle with fewer exported transitions "
+                        "(default 8)")
+    p.add_argument("--max-batch", type=_pow2_int, default=16,
+                   dest="max_batch",
+                   help="engine padding-bucket cap (default 16)")
+    p.add_argument("--serve-device", default="cpu", dest="serve_device",
+                   choices=["cpu", "default", "auto"],
+                   help="backend for the gate/verify reference engines — "
+                        "must match the FLEET's serve device for the "
+                        "bit-exact serving check (default cpu)")
+    p.add_argument("--verify-serving",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   dest="verify_serving",
+                   help="post-cycle bit-exact check of the fleet default "
+                        "vs the journal's incumbent (disable on "
+                        "mixed-backend fleets)")
+    p.add_argument("--auth-secret-file", dest="auth_secret_file",
+                   help="fleet secret: mint the operator wildcard toward "
+                        "the replicas")
+    p.add_argument("--retry-attempts", type=int, default=5,
+                   dest="retry_attempts")
+    p.add_argument("--retry-deadline-s", type=float, default=15.0,
+                   dest="retry_deadline_s")
+    p.add_argument("--probe-interval-s", type=float, default=0.5,
+                   dest="probe_interval_s")
+    p.add_argument("--cost-margin", type=float, default=0.0,
+                   dest="cost_margin")
+    p.add_argument("--max-reward-drop", type=float, default=0.5,
+                   dest="max_reward_drop")
+    p.add_argument("--slo-p95-ms", type=float, default=250.0,
+                   dest="slo_p95_ms")
+    p.add_argument("--slo-p99-ms", type=float, default=500.0,
+                   dest="slo_p99_ms")
+    p.add_argument("--max-cost-regression", type=float, default=0.05,
+                   dest="max_cost_regression")
+    p.add_argument("--canary-p95-ms", type=float, default=2000.0,
+                   dest="canary_p95_ms")
+    p.add_argument("--canary-min-requests", type=int, default=8,
+                   dest="canary_min_requests")
+    p.set_defaults(fn=cmd_autopilot)
 
     p = sub.add_parser(
         "serve-router",

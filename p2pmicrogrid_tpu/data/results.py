@@ -83,7 +83,7 @@ _DDL = [
 # is additive only; bumping TELEMETRY_SCHEMA_VERSION must come with a
 # migration branch in ``ensure_telemetry_schema``).
 
-TELEMETRY_SCHEMA_VERSION = 1
+TELEMETRY_SCHEMA_VERSION = 2
 
 _TELEMETRY_DDL = [
     # One row per telemetry run: the manifest identity columns are promoted
@@ -121,13 +121,207 @@ _TELEMETRY_DDL = [
        ON telemetry_points(kind, name)""",
     """CREATE INDEX IF NOT EXISTS idx_telemetry_runs_config
        ON telemetry_runs(config_hash)""",
+    # v2: the export/retention handshake (ISSUE 11). A trace exporter
+    # (data/trace_export.py, serve/autopilot.py) takes a LEASE naming the
+    # window start it is about to read; ``compact_serve_telemetry`` caps
+    # its retention cutoff at the oldest active lease's window start, so
+    # compaction and export coordinate by schedule instead of racing by
+    # convention. A released lease records how far the export actually got
+    # (``exported_through_ts``) — the next cycle's window start, and the
+    # durable watermark retention can safely advance past. ``expires_ts``
+    # bounds a crashed exporter: a SIGKILLed autopilot's lease stops
+    # gating retention once the TTL passes (and the export, if it somehow
+    # resumes after that, still fails loud on the compaction marker).
+    """CREATE TABLE IF NOT EXISTS export_leases
+       (lease_id text PRIMARY KEY, holder text, config_hash text,
+        window_start_ts real NOT NULL, created_ts real NOT NULL,
+        expires_ts real NOT NULL, released integer NOT NULL DEFAULT 0,
+        exported_through_ts real)""",
 ]
+
+
+# --- export/retention handshake (schema v2) ----------------------------------
+
+
+def acquire_export_lease(
+    con: sqlite3.Connection,
+    holder: str,
+    window_start_ts: float,
+    ttl_s: float = 600.0,
+    config_hash: Optional[str] = None,
+    now: Optional[float] = None,
+) -> str:
+    """Take an export lease: "I am about to read decision traces with
+    ``ts >= window_start_ts`` — retention must not delete them." Returns
+    the lease id (pass to ``release_export_lease`` when the export lands).
+    The TTL bounds a crashed holder: an expired lease stops gating
+    compaction, it is never a permanent lock."""
+    import uuid
+
+    now = _time.time() if now is None else now
+    lease_id = f"lease-{uuid.uuid4().hex[:12]}"
+    ensure_telemetry_schema(con)
+    with con:
+        con.execute(
+            "INSERT INTO export_leases "
+            "(lease_id, holder, config_hash, window_start_ts, created_ts, "
+            " expires_ts, released) VALUES (?,?,?,?,?,?,0)",
+            (
+                lease_id, holder, config_hash, float(window_start_ts),
+                now, now + max(float(ttl_s), 0.0),
+            ),
+        )
+    return lease_id
+
+
+def release_export_lease(
+    con: sqlite3.Connection, lease_id: str, exported_through_ts: float
+) -> None:
+    """Release a lease, recording how far the export read
+    (``exported_through_ts`` — the durable watermark the NEXT export
+    window starts from and retention can advance past)."""
+    with con:
+        cur = con.execute(
+            "UPDATE export_leases SET released = 1, exported_through_ts = ? "
+            "WHERE lease_id = ?",
+            (float(exported_through_ts), lease_id),
+        )
+        if cur.rowcount == 0:
+            raise KeyError(f"no export lease {lease_id}")
+
+
+def cancel_export_lease(con: sqlite3.Connection, lease_id: str) -> None:
+    """Drop a lease whose export FAILED: the row is deleted outright —
+    releasing it with a fake watermark would poison
+    ``last_export_watermark`` (and pin the retention floor) with a window
+    that never actually exported. Idempotent."""
+    with con:
+        con.execute(
+            "DELETE FROM export_leases WHERE lease_id = ?", (lease_id,)
+        )
+
+
+class ExportLeaseScope:
+    """The one copy of the lease choreography both exporters use
+    (``continual --windowed`` and ``serve/autopilot.py``): acquire on
+    enter; the caller calls ``release(exported_through_ts)`` when the
+    export LANDED; leaving the scope without a release CANCELS the lease
+    (a cleanly-failed export must not gate retention for the TTL — a
+    SIGKILL still does, which is what the TTL is for)."""
+
+    def __init__(
+        self,
+        db_path: str,
+        holder: str,
+        window_start_ts: float,
+        ttl_s: float = 600.0,
+        config_hash: Optional[str] = None,
+    ):
+        self.db_path = db_path
+        self.holder = holder
+        self.window_start_ts = float(window_start_ts)
+        self.ttl_s = ttl_s
+        self.config_hash = config_hash
+        self.lease_id: Optional[str] = None
+        self._released = False
+
+    def __enter__(self) -> "ExportLeaseScope":
+        con = sqlite3.connect(self.db_path)
+        try:
+            self.lease_id = acquire_export_lease(
+                con, self.holder, self.window_start_ts,
+                ttl_s=self.ttl_s, config_hash=self.config_hash,
+            )
+        finally:
+            con.close()
+        return self
+
+    def release(self, exported_through_ts: float) -> None:
+        con = sqlite3.connect(self.db_path)
+        try:
+            release_export_lease(con, self.lease_id, exported_through_ts)
+        finally:
+            con.close()
+        self._released = True
+
+    def __exit__(self, *exc) -> None:
+        if not self._released and self.lease_id is not None:
+            con = sqlite3.connect(self.db_path)
+            try:
+                cancel_export_lease(con, self.lease_id)
+            finally:
+                con.close()
+
+
+def active_lease_floor(
+    con: sqlite3.Connection, now: Optional[float] = None
+) -> Optional[float]:
+    """The oldest window start of any unreleased, unexpired lease — the
+    timestamp retention must not cross — or None with no active lease.
+    Reads as None on a pre-v2 warehouse (no lease table yet)."""
+    now = _time.time() if now is None else now
+    try:
+        (floor,) = con.execute(
+            "SELECT MIN(window_start_ts) FROM export_leases "
+            "WHERE released = 0 AND expires_ts > ?",
+            (now,),
+        ).fetchone()
+    except sqlite3.OperationalError:
+        return None  # pre-v2 DB: no leases ever taken
+    return float(floor) if floor is not None else None
+
+
+def released_watermark_floor(
+    con: sqlite3.Connection, now: Optional[float] = None
+) -> Optional[float]:
+    """The oldest export frontier still under LEASED protection: for each
+    config whose most recent lease has not yet passed its TTL, the
+    newest ``exported_through_ts``. Between one cycle's release and the
+    next cycle's acquire, the frontier keeps retention from overtaking
+    the export — and the protection EXPIRES with the lease TTL exactly
+    like an active lease's does, so a retired config (promoted away,
+    never exporting again) stops gating one TTL after its last release
+    instead of pinning the retention cutoff forever. The operational
+    contract is the same one the active-lease TTL already sets: keep the
+    export cadence under the lease TTL, or raise the TTL."""
+    now = _time.time() if now is None else now
+    try:
+        (floor,) = con.execute(
+            "SELECT MIN(m) FROM ("
+            " SELECT MAX(exported_through_ts) AS m"
+            " FROM export_leases"
+            " WHERE released = 1 AND exported_through_ts IS NOT NULL"
+            " GROUP BY config_hash"
+            " HAVING MAX(expires_ts) > ?)",
+            (now,),
+        ).fetchone()
+    except sqlite3.OperationalError:
+        return None
+    return float(floor) if floor is not None else None
+
+
+def last_export_watermark(
+    con: sqlite3.Connection, config_hash: Optional[str] = None
+) -> Optional[float]:
+    """The newest ``exported_through_ts`` of a released lease (filtered to
+    ``config_hash`` when given, falling back to config-less leases) — where
+    the next export window starts. None when nothing was ever exported."""
+    try:
+        rows = con.execute(
+            "SELECT MAX(exported_through_ts) FROM export_leases "
+            "WHERE released = 1 AND (config_hash = ? OR config_hash IS NULL)",
+            (config_hash,),
+        ).fetchone()
+    except sqlite3.OperationalError:
+        return None
+    return float(rows[0]) if rows and rows[0] is not None else None
 
 
 def compact_serve_telemetry(
     con: sqlite3.Connection,
     older_than_s: float,
     now: Optional[float] = None,
+    respect_leases: bool = True,
 ) -> dict:
     """Roll per-request ``serve_request`` telemetry_points older than
     ``older_than_s`` seconds into per-(run, bucket) aggregate points.
@@ -164,12 +358,40 @@ def compact_serve_telemetry(
     keys. One assumption: the retention window must exceed the sinks'
     flush latency (seconds), or rows flushed between the scan and the
     delete could be dropped un-aggregated.
+
+    ``respect_leases`` (default) is retention's half of the scheduled
+    export handshake: the cutoff is capped at the oldest ACTIVE export
+    lease's window start (``acquire_export_lease``), so a continual-
+    training export in flight can never lose the decision rows it is
+    reading — the coordination that used to exist only as the
+    ``TracesCompactedError`` convention. The returned dict reports the
+    effective ``cutoff_ts`` and whether a lease capped it.
     """
     import json as _json
     import random as _random
 
     now = _time.time() if now is None else now
     cutoff = now - max(float(older_than_s), 0.0)
+    lease_capped = False
+    if respect_leases:
+        # The export/retention handshake (``acquire_export_lease``): an
+        # active lease names the window start a live exporter is reading
+        # from — the cutoff never crosses it, so a scheduled retention
+        # pass and a scheduled export cannot race. Between cycles (no
+        # lease held) the RELEASED watermark frontier gates instead:
+        # retention follows export, never overtakes it, so decisions
+        # served after the last export survive until the next one lands.
+        # ``respect_leases=False`` is the forced-race escape hatch
+        # (tests; an operator reclaiming a warehouse NOW) — the export
+        # side still fails loud on the aggregate markers it leaves
+        # behind.
+        for floor in (
+            active_lease_floor(con, now=now),
+            released_watermark_floor(con, now=now),
+        ):
+            if floor is not None and floor < cutoff:
+                cutoff = floor
+                lease_capped = True
 
     reservoir_k = 4096
     rng = _random.Random(0)
@@ -240,11 +462,19 @@ def compact_serve_telemetry(
         "WHERE kind = 'serve_decision' AND ts IS NOT NULL AND ts < ?",
         (cutoff,),
     ).fetchone()
-    if not n_rows and not n_decisions:
+    (n_settlements,) = con.execute(
+        "SELECT COUNT(*) FROM telemetry_points "
+        "WHERE kind = 'settlement' AND ts IS NOT NULL AND ts < ?",
+        (cutoff,),
+    ).fetchone()
+    if not n_rows and not n_decisions and not n_settlements:
         return {
             "rows_compacted": 0,
             "aggregates_written": 0,
             "decisions_compacted": 0,
+            "settlements_compacted": 0,
+            "cutoff_ts": round(cutoff, 3),
+            "lease_capped": lease_capped,
         }
 
     # Aggregate rows live in a disjoint seq namespace: a LIVE SqliteSink
@@ -297,10 +527,22 @@ def compact_serve_telemetry(
             "AND ts IS NOT NULL AND ts < ?",
             (cutoff,),
         ).rowcount
+        # Settlement rows are derived from (and only joinable to) the
+        # decisions above — once a window's decisions are exported and
+        # retired, the bills for them are too, or the settlement table
+        # would be the one warehouse surface that grows forever.
+        settlements_deleted = con.execute(
+            "DELETE FROM telemetry_points WHERE kind = 'settlement' "
+            "AND ts IS NOT NULL AND ts < ?",
+            (cutoff,),
+        ).rowcount
     return {
         "rows_compacted": int(deleted),
         "aggregates_written": len(agg_rows),
         "decisions_compacted": int(decisions_deleted),
+        "settlements_compacted": int(settlements_deleted),
+        "cutoff_ts": round(cutoff, 3),
+        "lease_capped": lease_capped,
     }
 
 
@@ -314,8 +556,9 @@ def ensure_telemetry_schema(con: sqlite3.Connection) -> int:
     for ddl in _TELEMETRY_DDL:
         con.execute(ddl)
     if version < TELEMETRY_SCHEMA_VERSION:
-        # v0 -> v1 is pure table creation; future bumps branch on `version`
-        # here with ALTER TABLE migrations.
+        # v0 -> v1 (warehouse tables) and v1 -> v2 (export_leases) are both
+        # pure table creation — the DDL loop above is the whole migration;
+        # future bumps branch on `version` here with ALTER TABLE migrations.
         con.execute(f"PRAGMA user_version = {TELEMETRY_SCHEMA_VERSION}")
     con.commit()
     return TELEMETRY_SCHEMA_VERSION
@@ -436,6 +679,50 @@ WHERE p.kind = 'promotion'
 GROUP BY candidate
 ORDER BY candidate
 """
+
+
+# The promotion lineage (ISSUE 11): every PROMOTED event in warehouse
+# time order. Each promotion records (incumbent -> candidate); chaining
+# them renders the deployment ancestry a week of unattended autopilot
+# cycles produced — incumbent -> candidate -> candidate² — which
+# ``telemetry-query --promotions`` prints next to the per-candidate
+# verdict counts.
+PROMOTION_LINEAGE_SQL = """
+SELECT p.ts,
+       json_extract(p.attrs_json, '$.incumbent') AS incumbent,
+       json_extract(p.attrs_json, '$.candidate') AS candidate,
+       t.config_hash AS recorded_by
+FROM telemetry_points p
+JOIN telemetry_runs t ON t.run_id = p.run_id
+WHERE p.kind = 'promotion'
+  AND json_extract(p.attrs_json, '$.phase') = 'promoted'
+  AND json_extract(p.attrs_json, '$.candidate') IS NOT NULL
+ORDER BY p.ts, p.seq
+"""
+
+
+def promotion_lineage(con: sqlite3.Connection) -> dict:
+    """The promotion ancestry chain out of ``PROMOTION_LINEAGE_SQL``:
+    ``{"links": [{ts, incumbent, candidate}...], "chain": [hash...]}``.
+    The chain follows each promotion's incumbent pointer in time order,
+    starting a fresh segment whenever a promotion's incumbent is not the
+    current chain head (parallel histories stay readable instead of being
+    silently merged)."""
+    rows = con.execute(PROMOTION_LINEAGE_SQL).fetchall()
+    links = [
+        {"ts": ts, "incumbent": inc, "candidate": cand}
+        for ts, inc, cand, _ in rows
+    ]
+    chain: list = []
+    for link in links:
+        if not chain:
+            chain = [link["incumbent"], link["candidate"]]
+        elif link["incumbent"] == chain[-1]:
+            chain.append(link["candidate"])
+        else:
+            # A promotion whose incumbent is off-chain: new segment marker.
+            chain.extend([None, link["incumbent"], link["candidate"]])
+    return {"links": links, "chain": chain}
 
 
 # The default telemetry-query join (cli.py `telemetry-query`): one row per
@@ -791,6 +1078,11 @@ class ResultsStore:
         cur = self.con.execute(PROMOTION_VIEW_SQL)
         cols = [d[0] for d in cur.description]
         return [dict(zip(cols, row)) for row in cur.fetchall()]
+
+    def query_promotion_lineage(self) -> dict:
+        """The promotion ancestry (``promotion_lineage``): time-ordered
+        (incumbent -> candidate) links plus the rendered chain."""
+        return promotion_lineage(self.con)
 
     def query_rollback_view(self) -> list:
         """Training runs aggregated into one resilience view per

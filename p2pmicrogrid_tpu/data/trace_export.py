@@ -69,6 +69,12 @@ class TraceDataset:
     households: List[str] = field(default_factory=list)
     n_decisions: int = 0          # pairable decisions read (>= transitions)
     n_dropped: int = 0            # anonymous / non-leading batch rows
+    # Per-transition provenance of the LEADING decision ({run_id,
+    # household, ts}) — what a 3-arg ``reward_fn`` joins settlement rows
+    # on (``settlement_reward_fn``), aligned with the array rows.
+    meta: List[dict] = field(default_factory=list)
+    window_start_ts: Optional[float] = None
+    window_end_ts: Optional[float] = None
 
     @property
     def n_transitions(self) -> int:
@@ -90,6 +96,14 @@ class TraceDataset:
             "reward_mean": (
                 round(float(self.reward.mean()), 6)
                 if self.n_transitions else None
+            ),
+            "window_start_ts": (
+                round(self.window_start_ts, 3)
+                if self.window_start_ts is not None else None
+            ),
+            "window_end_ts": (
+                round(self.window_end_ts, 3)
+                if self.window_end_ts is not None else None
             ),
         }
 
@@ -200,22 +214,44 @@ def _serve_run_ids(
     }
 
 
-def _check_not_compacted(con: sqlite3.Connection, run_ids) -> None:
+def _check_not_compacted(
+    con: sqlite3.Connection, run_ids, since_ts: Optional[float] = None
+) -> None:
+    """Refuse an export whose window overlaps compacted history.
+
+    Without ``since_ts`` any aggregate row on a selected run condemns the
+    export (the pre-handshake contract: the window is unbounded, so any
+    compaction truncated it). With ``since_ts`` — the scheduled-handshake
+    path, where the window starts at the last export watermark — only
+    aggregates whose compacted window reaches INTO the export window
+    (``ts_max >= since_ts``) do: retention rolling up history the
+    previous cycle already exported is exactly what the lease/watermark
+    handshake (data/results.py) schedules, not a race."""
     marks = ",".join("?" for _ in run_ids)
+    where = (
+        f"run_id IN ({marks}) AND kind = 'serve_request_agg'"
+    )
+    params: list = list(run_ids)
+    if since_ts is not None:
+        where += (
+            " AND COALESCE(json_extract(attrs_json, '$.ts_max'), 1e30)"
+            " >= ?"
+        )
+        params.append(float(since_ts))
     (n_agg,) = con.execute(
-        f"SELECT COUNT(*) FROM telemetry_points WHERE run_id IN ({marks}) "
-        "AND kind = 'serve_request_agg'",
-        list(run_ids),
+        f"SELECT COUNT(*) FROM telemetry_points WHERE {where}", params
     ).fetchone()
     if n_agg:
         raise TracesCompactedError(
-            f"{n_agg} serve_request_agg row(s) found for the selected "
-            "runs: their per-request traces were compacted to aggregates "
-            "(telemetry-query --compact), so the decision stream is empty "
-            "or truncated and exporting it would train on a partial "
-            "buffer. Fix: raise the retention window (--older-than-hours) "
-            "above your continual-training cadence, or export before the "
-            "retention pass runs."
+            f"{n_agg} serve_request_agg row(s) overlap the export window "
+            "for the selected runs: their per-request traces were "
+            "compacted to aggregates (telemetry-query --compact), so the "
+            "decision stream is empty or truncated and exporting it would "
+            "train on a partial buffer. Fix: raise the retention window "
+            "(--older-than-hours) above your continual-training cadence, "
+            "or coordinate the two with an export lease "
+            "(data/results.acquire_export_lease — serve/autopilot.py "
+            "does this per cycle)."
         )
 
 
@@ -224,8 +260,9 @@ def export_serve_traces(
     config_hash: Optional[str] = None,
     cfg=None,
     n_agents: Optional[int] = None,
-    reward_fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None,
+    reward_fn: Optional[Callable] = None,
     min_transitions: int = 1,
+    since_ts: Optional[float] = None,
 ) -> TraceDataset:
     """Replay a warehouse's gateway decisions into a ``TraceDataset``.
 
@@ -233,14 +270,21 @@ def export_serve_traces(
     every serve-role run — a fleet's replicas all serving one config).
     ``cfg`` drives the default reward attribution (``trace_reward``);
     pass ``reward_fn(obs [N, A, 4], action [N, A]) -> [N, A]`` to attribute
-    from metered outcomes instead. ``n_agents`` (default: inferred from
-    the first decision) validates every row against the serving contract.
+    from metered outcomes instead — a reward_fn accepting a THIRD
+    positional argument additionally receives the per-transition
+    provenance (``TraceDataset.meta``: run_id/household/ts of the leading
+    decision), which is how ``settlement_reward_fn`` joins billed
+    per-household cost rows onto the transitions. ``n_agents`` (default:
+    inferred from the first decision) validates every row against the
+    serving contract. ``since_ts`` bounds the window to decisions at/after
+    it — the scheduled-handshake path where each continual cycle exports
+    from the last released export watermark (data/results.py).
 
-    Raises ``TracesCompactedError`` when any selected run was compacted
-    (see module docstring) and ``ValueError`` when fewer than
-    ``min_transitions`` transitions survive pairing — both LOUD, because
-    the downstream consumer is a training loop that would otherwise
-    silently fine-tune on nothing.
+    Raises ``TracesCompactedError`` when compaction overlaps the export
+    window (see ``_check_not_compacted``) and ``ValueError`` when fewer
+    than ``min_transitions`` transitions survive pairing — both LOUD,
+    because the downstream consumer is a training loop that would
+    otherwise silently fine-tune on nothing.
     """
     if cfg is None and reward_fn is None:
         raise ValueError("pass cfg (for trace_reward) or an explicit reward_fn")
@@ -252,13 +296,18 @@ def export_serve_traces(
                 f"no serve-role telemetry runs in {results_db}"
                 + (f" for config_hash {config_hash}" if config_hash else "")
             )
-        _check_not_compacted(con, list(runs))
+        _check_not_compacted(con, list(runs), since_ts=since_ts)
         marks = ",".join("?" for _ in runs)
+        window_sql = ""
+        params: List = list(runs)
+        if since_ts is not None:
+            window_sql = " AND ts >= ?"
+            params.append(float(since_ts))
         cursor = con.execute(
-            "SELECT run_id, seq, attrs_json FROM telemetry_points "
-            f"WHERE run_id IN ({marks}) AND kind = 'serve_decision' "
-            "ORDER BY run_id, seq",
-            list(runs),
+            "SELECT run_id, seq, ts, attrs_json FROM telemetry_points "
+            f"WHERE run_id IN ({marks}) AND kind = 'serve_decision'"
+            f"{window_sql} ORDER BY run_id, seq",
+            params,
         )
         # Consecutive decisions of ONE household within ONE run pair into
         # transitions: the gateway serves each household once per slot, so
@@ -273,7 +322,8 @@ def export_serve_traces(
         per_household: Dict[tuple, list] = {}
         n_decisions = 0
         n_dropped = 0
-        for run_id, seq, attrs_json in cursor:
+        window_lo = window_hi = None
+        for run_id, seq, ts, attrs_json in cursor:
             try:
                 attrs = json.loads(attrs_json) if attrs_json else {}
             except ValueError:
@@ -295,8 +345,11 @@ def export_serve_traces(
                 n_dropped += 1
                 continue
             n_decisions += 1
+            if ts is not None:
+                window_lo = ts if window_lo is None else min(window_lo, ts)
+                window_hi = ts if window_hi is None else max(window_hi, ts)
             per_household.setdefault((run_id, household), []).append(
-                (obs, action)
+                (obs, action, ts)
             )
     finally:
         con.close()
@@ -304,12 +357,14 @@ def export_serve_traces(
     obs_rows: List[np.ndarray] = []
     act_rows: List[np.ndarray] = []
     next_rows: List[np.ndarray] = []
+    meta: List[dict] = []
     households: set = set()
     for (run_id, household), decisions in sorted(per_household.items()):
-        for (o, a), (o_next, _) in zip(decisions, decisions[1:]):
+        for (o, a, ts), (o_next, _, _) in zip(decisions, decisions[1:]):
             obs_rows.append(o)
             act_rows.append(a)
             next_rows.append(o_next)
+            meta.append({"run_id": run_id, "household": household, "ts": ts})
             households.add(household)
     if len(obs_rows) < max(min_transitions, 1):
         raise ValueError(
@@ -323,7 +378,12 @@ def export_serve_traces(
     action = np.stack(act_rows).astype(np.float32)
     next_obs = np.stack(next_rows).astype(np.float32)
     if reward_fn is not None:
-        reward = np.asarray(reward_fn(obs, action), dtype=np.float32)
+        if _reward_fn_takes_meta(reward_fn):
+            reward = np.asarray(
+                reward_fn(obs, action, meta), dtype=np.float32
+            )
+        else:
+            reward = np.asarray(reward_fn(obs, action), dtype=np.float32)
     else:
         reward = trace_reward(cfg, obs, action)
     if reward.shape != action.shape:
@@ -340,7 +400,260 @@ def export_serve_traces(
         households=sorted(households),
         n_decisions=n_decisions,
         n_dropped=n_dropped,
+        meta=meta,
+        window_start_ts=window_lo,
+        window_end_ts=window_hi,
     )
+
+
+def _reward_fn_takes_meta(reward_fn) -> bool:
+    """Does the hook accept the per-transition provenance third argument?
+    (Settlement joins need household/ts; the plain 2-arg contract stays
+    supported.)"""
+    import inspect
+
+    try:
+        params = [
+            p for p in inspect.signature(reward_fn).parameters.values()
+            if p.kind in (
+                inspect.Parameter.POSITIONAL_ONLY,
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                inspect.Parameter.VAR_POSITIONAL,
+            )
+        ]
+    except (TypeError, ValueError):
+        return False
+    return len(params) >= 3 or any(
+        p.kind is inspect.Parameter.VAR_POSITIONAL for p in params
+    )
+
+
+# -- metered settlement --------------------------------------------------------
+#
+# Production reward should come from what households were BILLED, not from
+# the environment's own tariff model re-run offline. The contract: a meter
+# (or here, ``bill_decisions`` simulating one) writes ``settlement`` points
+# into the warehouse — attrs ``{household, decision_ts (the decision's
+# timestamp), billed_eur [A]}`` under a run whose manifest carries
+# ``settlement_role``
+# (NOT ``serve_role``, so settlement runs never select as trace sources).
+# ``settlement_reward_fn`` then joins those rows onto exported transitions
+# by (household, decision ts) through the 3-arg ``reward_fn`` hook.
+
+
+def _settlement_key(household: str, ts: Optional[float]) -> tuple:
+    # ts rounds to ms: the decision ts is copied verbatim into the
+    # settlement row, so the match is exact up to JSON float round-trip
+    # (which is itself exact) — rounding only guards representation drift.
+    return (household, round(ts, 3) if ts is not None else None)
+
+
+def bill_decisions(
+    results_db: str,
+    cfg,
+    config_hash: Optional[str] = None,
+    since_ts: Optional[float] = None,
+    bill_fn: Optional[Callable[[np.ndarray, np.ndarray], np.ndarray]] = None,
+    run_name: str = "billing",
+) -> int:
+    """Simulate the settlement meter: read the window's ``serve_decision``
+    rows and write one ``settlement`` point per decision (billed energy
+    cost under the no-com tariff rule by default; ``bill_fn(obs [A,4],
+    action [A]) -> [A]`` overrides — a real deployment replaces this whole
+    function with its metering pipeline). Returns the number of decisions
+    billed. The autopilot runs this each cycle BEFORE trace export so
+    continual training optimizes billed outcomes."""
+    from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+    from p2pmicrogrid_tpu.telemetry.registry import run_stamp
+
+    con = sqlite3.connect(f"file:{results_db}?mode=ro", uri=True)
+    decisions: List[tuple] = []
+    try:
+        runs = _serve_run_ids(con, config_hash)
+        if not runs:
+            return 0
+        marks = ",".join("?" for _ in runs)
+        window_sql = ""
+        params: List = list(runs)
+        if since_ts is not None:
+            window_sql = " AND ts >= ?"
+            params.append(float(since_ts))
+        for _run_id, ts, attrs_json in con.execute(
+            "SELECT run_id, ts, attrs_json FROM telemetry_points "
+            f"WHERE run_id IN ({marks}) AND kind = 'serve_decision'"
+            f"{window_sql}",
+            params,
+        ):
+            try:
+                attrs = json.loads(attrs_json) if attrs_json else {}
+            except ValueError:
+                continue
+            household = attrs.get("household")
+            obs, action = attrs.get("obs"), attrs.get("action")
+            if not household or obs is None or action is None or ts is None:
+                continue
+            decisions.append((household, ts, obs, action))
+    finally:
+        con.close()
+    if not decisions:
+        return 0
+
+    if bill_fn is None:
+        def bill_fn(obs, action):  # default meter: the energy settlement
+            return _energy_settlement_eur(cfg, obs, action)
+
+    tel = Telemetry(
+        run_id=f"{run_name}-{run_stamp()}",
+        sinks=[SqliteSink(results_db)],
+        manifest={"settlement_role": "meter", "config_hash": config_hash},
+    )
+    try:
+        for household, ts, obs, action in decisions:
+            # host-sync: warehouse JSON payloads, host data throughout.
+            billed = np.asarray(
+                bill_fn(
+                    np.asarray(obs, dtype=np.float32),
+                    np.asarray(action, dtype=np.float32),
+                ),
+                dtype=np.float32,
+            )
+            tel.event(
+                "settlement",
+                household=household,
+                # NOT the reserved ``ts`` kwarg (that would become the
+                # point's own timestamp column and vanish from attrs):
+                # the join key is the DECISION's timestamp.
+                decision_ts=round(float(ts), 3),
+                billed_eur=[round(float(b), 8) for b in billed],
+            )
+    finally:
+        tel.close()
+    return len(decisions)
+
+
+def _energy_settlement_eur(cfg, obs: np.ndarray, action: np.ndarray):
+    """The energy half of ``trace_reward``'s attribution (no comfort term
+    — comfort is never billed): grid settlement of the household balance
+    plus the served heat-pump power under the no-com rule."""
+    import jax.numpy as jnp
+
+    from p2pmicrogrid_tpu.ops.market import compute_costs
+    from p2pmicrogrid_tpu.ops.tariff import grid_prices
+
+    obs = jnp.asarray(obs, dtype=jnp.float32)
+    action = jnp.asarray(action, dtype=jnp.float32)
+    th, pop = cfg.thermal, cfg.population
+    max_in_w = max(pop.load_rating_mean, pop.pv_rating_mean) * pop.safety * 1e3
+    balance_w = obs[..., 2] * max_in_w
+    buy, inj = grid_prices(cfg.tariff, obs[..., 0])
+    p_grid = balance_w + action * th.hp_max_power
+    cost = compute_costs(
+        p_grid, jnp.zeros_like(p_grid), buy, inj,
+        jnp.zeros_like(buy), cfg.sim.slot_hours,
+    )
+    # host-sync: offline settlement on host arrays — not a dispatch path.
+    return np.asarray(cost, dtype=np.float32)
+
+
+def settlement_reward_fn(
+    results_db: str,
+    cfg,
+    telemetry=None,
+    warn_stream=None,
+):
+    """A 3-arg ``reward_fn`` for ``export_serve_traces`` attributing reward
+    from BILLED settlement rows: ``reward = -(billed_eur + 10 x comfort
+    penalty at the observed temperature)`` for transitions whose leading
+    decision has a settlement row, with a LOUD (never silent) fallback to
+    the environment's tariff model (``trace_reward``) for transitions that
+    have none — a one-line warning per export naming the miss count, plus
+    a ``settlement_fallback`` telemetry event when a telemetry is given.
+    A warehouse with NO settlement rows at all falls back entirely (same
+    loud path): the flywheel keeps turning while the meter is down, and
+    the warning is the operator's cue that training reward is running on
+    the model, not the bill."""
+    import sys as _sys
+
+    from p2pmicrogrid_tpu.ops.thermal import comfort_penalty
+
+    warn_stream = warn_stream if warn_stream is not None else _sys.stderr
+
+    def reward_fn(obs, action, meta):
+        con = sqlite3.connect(f"file:{results_db}?mode=ro", uri=True)
+        billed: Dict[tuple, np.ndarray] = {}
+        # Scope the read to the transitions' own time window (plus slack
+        # for billing lag): the settlement table spans the warehouse's
+        # whole history, and a week of unattended cycles must not re-read
+        # and re-parse every bill ever written on each export.
+        ts_vals = [
+            m.get("ts") for m in meta if m.get("ts") is not None
+        ]
+        where = "kind = 'settlement'"
+        params: List = []
+        if ts_vals:
+            where += (
+                " AND json_extract(attrs_json, '$.decision_ts')"
+                " BETWEEN ? AND ?"
+            )
+            params += [min(ts_vals) - 1.0, max(ts_vals) + 1.0]
+        try:
+            try:
+                rows = con.execute(
+                    "SELECT attrs_json FROM telemetry_points "
+                    f"WHERE {where}",
+                    params,
+                ).fetchall()
+            except sqlite3.OperationalError:
+                rows = []  # pre-warehouse DB
+        finally:
+            con.close()
+        for (attrs_json,) in rows:
+            try:
+                attrs = json.loads(attrs_json) if attrs_json else {}
+            except ValueError:
+                continue
+            household = attrs.get("household")
+            values = attrs.get("billed_eur")
+            if not household or values is None:
+                continue
+            key = _settlement_key(household, attrs.get("decision_ts"))
+            # host-sync: warehouse JSON payloads, host data.
+            billed[key] = np.asarray(values, dtype=np.float32)
+        n = obs.shape[0]
+        reward = np.zeros(action.shape, dtype=np.float32)
+        th = cfg.thermal
+        missing: List[int] = []
+        for i in range(n):
+            m = meta[i] if i < len(meta) else {}
+            row = billed.get(
+                _settlement_key(m.get("household"), m.get("ts"))
+            )
+            if row is None or row.shape != action[i].shape:
+                missing.append(i)
+                continue
+            t_in = obs[i, :, 1] * th.margin + th.setpoint
+            # host-sync: offline attribution on host arrays.
+            penalty = np.asarray(comfort_penalty(th, t_in), dtype=np.float32)
+            reward[i] = -(row + 10.0 * penalty)
+        if missing:
+            fallback = trace_reward(cfg, obs[missing], action[missing])
+            reward[missing] = fallback
+            msg = (
+                f"settlement WARNING: {len(missing)}/{n} transition(s) "
+                "have no billed settlement row — falling back to the "
+                "env tariff model for those (training reward is partly "
+                "model-derived until the meter catches up)."
+            )
+            print(msg, file=warn_stream, flush=True)
+            if telemetry is not None:
+                telemetry.event(
+                    "settlement_fallback",
+                    missing=len(missing),
+                    total=n,
+                )
+        return reward
+
+    return reward_fn
 
 
 def to_replay_state(dataset: TraceDataset, capacity: Optional[int] = None):

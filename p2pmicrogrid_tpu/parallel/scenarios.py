@@ -1249,6 +1249,8 @@ def train_scenarios_chunked(
     pipeline: bool = True,
     donate: Optional[bool] = None,
     carry_sync: Optional[Callable[[int], bool]] = None,
+    drain=None,
+    finalize: bool = True,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
     Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
@@ -1313,6 +1315,15 @@ def train_scenarios_chunked(
     carry (checkpoint cadence): the loop drains synchronously there. A
     custom ``chunk_key_fn`` keeps the host-side key loop (tests collapse
     chunks onto one draw with it).
+
+    ``drain`` (an ``AsyncDrain``) shares a caller-owned pipeline across
+    MULTIPLE calls, and ``finalize=False`` skips the end-of-call flush +
+    device barrier: the caller chains further device work (the next
+    block, a health eval) onto the returned carry without stalling, and
+    MUST flush the shared drain before reading the returned reward/loss
+    containers — which are then plain LISTS still being filled by the
+    drain's lagged consumers, not stacked arrays
+    (``train_chunked_with_health`` is the caller this exists for).
     """
     S = cfg.sim.n_scenarios
     if scenario_sharding is not None and (
@@ -1388,7 +1399,8 @@ def train_scenarios_chunked(
 
     from p2pmicrogrid_tpu.telemetry.async_drain import AsyncDrain
 
-    drain = AsyncDrain(depth=2 if pipeline else 1, telemetry=telemetry)
+    if drain is None:
+        drain = AsyncDrain(depth=2 if pipeline else 1, telemetry=telemetry)
     decay_every = cfg.train.min_episodes_criterion
     rewards: list = [None] * n_episodes
     losses: list = [None] * n_episodes
@@ -1427,6 +1439,10 @@ def train_scenarios_chunked(
         )
         if carry_sync is not None and carry_sync(episode0 + e):
             drain.flush()
+    if not finalize:
+        # The caller owns the drain: rewards/losses are the still-filling
+        # lists, valid only after the caller's own flush.
+        return pol_state, rewards, losses, _time.time() - start
     drain.flush()
     # host-sync: end-of-loop barrier so the returned timing is honest.
     jax.block_until_ready(pol_state)

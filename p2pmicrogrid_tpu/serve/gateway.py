@@ -24,8 +24,17 @@ Endpoints:
                        ``tools/check_artifacts_schema.py`` validates for
                        committed ``GATEWAY_STATS_*.json`` captures).
 * ``POST /admin/swap`` atomic default hot-swap and/or percentage-split A/B
-                       (``registry.BundleRegistry`` semantics).
+                       (``registry.BundleRegistry`` semantics); a
+                       ``clear_pins`` flag re-rolls household affinity
+                       (the canary's stage-widening hook).
 * ``POST /admin/drain``stop admitting act requests; in-flight complete.
+* ``POST /admin/register``   load a NEW bundle dir into the live registry
+                       (``bundle_factory`` — how a continual candidate
+                       reaches replicas launched before it existed).
+* ``POST /admin/unregister`` remove + close a non-default bundle (the
+                       rolled-back candidate's exit).
+* ``POST /admin/flush``      push buffered per-bundle telemetry into the
+                       warehouse (mid-canary attribution reads).
 
 Design points:
 
@@ -83,8 +92,9 @@ from p2pmicrogrid_tpu.serve.wire import serve_mux_connection
 _JSON_HEADERS = (("Content-Type", "application/json"),)
 _REASONS = {
     200: "OK", 400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
-    404: "Not Found", 405: "Method Not Allowed", 413: "Payload Too Large",
-    429: "Too Many Requests", 500: "Internal Server Error",
+    404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
+    413: "Payload Too Large", 429: "Too Many Requests",
+    500: "Internal Server Error", 501: "Not Implemented",
     503: "Service Unavailable",
 }
 
@@ -280,8 +290,15 @@ class ServeGateway:
         authenticator=None,
         restarts: int = 0,
         trace_decisions: bool = True,
+        bundle_factory=None,
     ):
         self.registry = registry
+        # Callable(bundle_dir) -> (engine, queue, telemetry) building ONE
+        # serving bundle with this gateway's engine settings — what
+        # ``POST /admin/register`` loads a NEW candidate bundle through at
+        # runtime (the autopilot pushes continual candidates into a live
+        # fleet this way). None disables dynamic registration (501).
+        self.bundle_factory = bundle_factory
         self.admission = admission or AdmissionConfig()
         self.host = host
         self.port = port
@@ -622,6 +639,21 @@ class ServeGateway:
             self._check_admin_auth(token)
             self.begin_drain()
             return 200, {"draining": True, "inflight": self._inflight}, []
+        if path == "/admin/register":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            self._check_admin_auth(token)
+            return await self._register(body)
+        if path == "/admin/unregister":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            self._check_admin_auth(token)
+            return await self._unregister(body)
+        if path == "/admin/flush":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            self._check_admin_auth(token)
+            return await self._flush_telemetry()
         raise _HttpError(404, f"no route {path}")
 
     @staticmethod
@@ -760,12 +792,129 @@ class ServeGateway:
             "config_hash": bundle.config_hash,
         }, []
 
+    async def _register(self, body: bytes):
+        """``POST /admin/register {"bundle_dir": ...}``: load a bundle
+        into the LIVE registry — the runtime path a continual candidate
+        takes into an already-running fleet (the replicas were launched
+        before the candidate existed). The build (manifest load + engine
+        compile + warmup) runs on an executor thread so in-flight serving
+        never stalls behind an XLA compile; registration itself is the
+        registry's atomic insert. Idempotent: registering a config_hash
+        that is already serving answers 200 with ``already_registered`` —
+        fleet-wide pushes retry per replica and must converge, not 409."""
+        if self.bundle_factory is None:
+            raise _HttpError(
+                501,
+                "this gateway was built without a bundle_factory — "
+                "dynamic bundle registration is disabled",
+            )
+        doc = self._parse_json(body)
+        bundle_dir = doc.get("bundle_dir")
+        if not isinstance(bundle_dir, str) or not bundle_dir:
+            raise _HttpError(400, "pass 'bundle_dir' (a string path)")
+        loop = asyncio.get_running_loop()
+        try:
+            engine, queue, telemetry = await loop.run_in_executor(
+                None, self.bundle_factory, bundle_dir
+            )
+        except (OSError, ValueError, KeyError) as err:
+            raise _HttpError(
+                400, f"bundle {bundle_dir} failed to load: {err}"
+            ) from None
+        config_hash = engine.manifest.get("config_hash")
+        if not config_hash:
+            # registry.register would also raise ValueError here, but
+            # that must NOT read as the idempotent already-registered
+            # case: an unroutable bundle is a client error, loudly.
+            await loop.run_in_executor(None, queue.close)
+            if telemetry is not None:
+                await loop.run_in_executor(None, telemetry.close)
+            raise _HttpError(
+                400,
+                f"bundle {bundle_dir} carries no config_hash — "
+                "unregisterable",
+            )
+        try:
+            self.registry.register(engine, queue, telemetry)
+        except ValueError:
+            # Already registered (a fleet push retrying, or two pushes
+            # racing): close the duplicate we just built and converge.
+            await loop.run_in_executor(None, queue.close)
+            if telemetry is not None:
+                await loop.run_in_executor(None, telemetry.close)
+            return 200, {
+                "config_hash": config_hash,
+                "already_registered": True,
+                "bundles": self.registry.hashes,
+            }, []
+        self.stats["registers"] = self.stats.get("registers", 0) + 1
+        return 200, {
+            "config_hash": config_hash,
+            "already_registered": False,
+            "bundles": self.registry.hashes,
+        }, []
+
+    async def _unregister(self, body: bytes):
+        """``POST /admin/unregister {"config_hash": ...}``: remove a
+        non-default, non-split bundle and close its queue/telemetry (on an
+        executor thread — the queue join and warehouse flush must not
+        stall the loop). The abort path for an orphaned candidate: a
+        rolled-back cycle must not leave the loser registered forever.
+        Idempotent: an unknown hash answers 200 ``was_registered: false``."""
+        doc = self._parse_json(body)
+        config_hash = doc.get("config_hash")
+        if not isinstance(config_hash, str) or not config_hash:
+            raise _HttpError(400, "pass 'config_hash' (a string)")
+        try:
+            bundle = self.registry.remove(config_hash)
+        except KeyError:
+            return 200, {
+                "config_hash": config_hash,
+                "was_registered": False,
+                "bundles": self.registry.hashes,
+            }, []
+        except ValueError as err:
+            # Removing the default or the live split arm is an operator
+            # sequencing error (swap/clear first), not a missing resource.
+            raise _HttpError(409, str(err)) from None
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, bundle.queue.close)
+        if bundle.telemetry is not None:
+            await loop.run_in_executor(None, bundle.telemetry.close)
+        return 200, {
+            "config_hash": config_hash,
+            "was_registered": True,
+            "bundles": self.registry.hashes,
+        }, []
+
+    async def _flush_telemetry(self):
+        """``POST /admin/flush``: push every bundle's buffered telemetry
+        rows into the warehouse NOW. The canary controller reads per-arm
+        ``serve_decision``/``serve_request`` attribution mid-stage; in
+        process-fleet mode those rows buffer inside the replica processes
+        (SqliteSink batches), so the controller flushes the fleet before
+        each warehouse read."""
+        loop = asyncio.get_running_loop()
+        flushed = 0
+        for config_hash in self.registry.hashes:
+            try:
+                bundle = self.registry.get(config_hash)
+            except KeyError:
+                continue  # removed between listing and get
+            if bundle.telemetry is not None:
+                await loop.run_in_executor(None, bundle.telemetry.flush)
+                flushed += 1
+        return 200, {"flushed": flushed}, []
+
     def _swap(self, body: bytes):
         doc = self._parse_json(body)
         new_default = doc.get("config_hash")
         split = doc.get("split", "__absent__")
-        if new_default is None and split == "__absent__":
-            raise _HttpError(400, "pass 'config_hash' and/or 'split'")
+        clear_pins = bool(doc.get("clear_pins", False))
+        if new_default is None and split == "__absent__" and not clear_pins:
+            raise _HttpError(
+                400, "pass 'config_hash', 'split' and/or 'clear_pins'"
+            )
         # Validate the WHOLE request before mutating anything: a combined
         # swap+split must not retarget the default (and clear every
         # household pin) and then 404 on the split half — the operator
@@ -816,6 +965,11 @@ class ServeGateway:
                     self.registry.clear_split()
                 else:
                     self.registry.set_split(arm, percent)
+            if clear_pins:
+                # The canary's stage-widening hook (registry.clear_pins
+                # semantics): every household re-routes against the
+                # current default/split on its next request.
+                self.registry.clear_pins()
         except KeyError as err:  # backstop — pre-validated above
             raise _HttpError(
                 404, f"unknown config_hash: {err.args[0]}"
@@ -879,6 +1033,88 @@ class ServeGateway:
 # -- construction -------------------------------------------------------------
 
 
+def build_bundle(
+    bundle_dir: str,
+    max_batch: int = 64,
+    max_wait_s: float = 0.002,
+    results_db: Optional[str] = None,
+    device: str = "auto",
+    warmup: bool = True,
+    run_name: str = "gateway",
+    serve_role: str = "candidate",
+):
+    """Load ONE bundle dir into ``(engine, queue, telemetry)`` — the unit
+    ``build_registry`` loops over at startup and ``/admin/register`` runs
+    at runtime (``make_bundle_factory``). The telemetry run is keyed by
+    THIS bundle's config_hash so warehouse rows attribute to the config
+    that answered, exactly like startup-registered bundles."""
+    from p2pmicrogrid_tpu.serve.engine import MicroBatchQueue, PolicyEngine
+    from p2pmicrogrid_tpu.serve.export import load_policy_bundle
+    from p2pmicrogrid_tpu.telemetry import (
+        SqliteSink,
+        Telemetry,
+        run_manifest,
+    )
+    from p2pmicrogrid_tpu.telemetry.registry import run_stamp
+
+    import uuid
+
+    manifest, params = load_policy_bundle(bundle_dir)
+    config_hash = manifest.get("config_hash")
+    telemetry = Telemetry(
+        # run_stamp is second+pid resolution — the hex suffix keeps two
+        # bundles built back-to-back (registry startup loop, racing
+        # /admin/register pushes) from colliding on one warehouse run row.
+        run_id=f"{run_name}-{run_stamp()}-{uuid.uuid4().hex[:6]}",
+        sinks=[SqliteSink(results_db)] if results_db else [],
+        manifest=run_manifest(
+            extra={
+                "config_hash": config_hash,
+                "setting": manifest.get("setting"),
+                "serve_bundle": bundle_dir,
+                "serve_role": serve_role,
+            }
+        ),
+    )
+    try:
+        engine = PolicyEngine(
+            manifest=manifest, params=params, max_batch=max_batch,
+            telemetry=telemetry, device=device,
+        )
+        if warmup:
+            engine.warmup(include_step=False)
+        queue = MicroBatchQueue(engine, max_wait_s=max_wait_s)
+    except BaseException:
+        telemetry.close()
+        raise
+    return engine, queue, telemetry
+
+
+def make_bundle_factory(
+    max_batch: int = 64,
+    max_wait_s: float = 0.002,
+    results_db: Optional[str] = None,
+    device: str = "auto",
+    warmup: bool = True,
+    run_name: str = "gateway",
+):
+    """The ``/admin/register`` hook: a closure over this gateway's engine
+    settings building one runtime-registered bundle per call."""
+    def factory(bundle_dir: str):
+        return build_bundle(
+            bundle_dir,
+            max_batch=max_batch,
+            max_wait_s=max_wait_s,
+            results_db=results_db,
+            device=device,
+            warmup=warmup,
+            run_name=run_name,
+            serve_role="candidate",
+        )
+
+    return factory
+
+
 def build_registry(
     bundle_dirs,
     max_batch: int = 64,
@@ -901,46 +1137,25 @@ def build_registry(
     ``serve_request`` traces the microbatch queue already emits become
     SQL-joinable to the training/eval rows of the config being served.
     """
-    from p2pmicrogrid_tpu.serve.engine import MicroBatchQueue, PolicyEngine
-    from p2pmicrogrid_tpu.serve.export import load_policy_bundle
-    from p2pmicrogrid_tpu.telemetry import (
-        SqliteSink,
-        Telemetry,
-        run_manifest,
-    )
-    from p2pmicrogrid_tpu.telemetry.registry import run_stamp
-
     if not bundle_dirs:
         raise ValueError("pass at least one bundle directory")
     registry = BundleRegistry()
-    stamp = run_stamp()
     pending_tel = pending_queue = None
     try:
         for i, bundle_dir in enumerate(bundle_dirs):
-            manifest, params = load_policy_bundle(bundle_dir)
-            config_hash = manifest.get("config_hash")
-            pending_tel = Telemetry(
-                run_id=f"{run_name}-{stamp}-{i}",
-                sinks=[SqliteSink(results_db)] if results_db else [],
-                manifest=run_manifest(
-                    extra={
-                        "config_hash": config_hash,
-                        "setting": manifest.get("setting"),
-                        "serve_bundle": bundle_dir,
-                        "serve_role": "default" if i == 0 else "candidate",
-                    }
-                ),
+            # Warmup compiles every padding bucket before the socket
+            # opens — the first remote household must not pay an XLA
+            # compile in-slot.
+            engine, pending_queue, pending_tel = build_bundle(
+                bundle_dir,
+                max_batch=max_batch,
+                max_wait_s=max_wait_s,
+                results_db=results_db,
+                device=device,
+                warmup=warmup,
+                run_name=run_name,
+                serve_role="default" if i == 0 else "candidate",
             )
-            engine = PolicyEngine(
-                manifest=manifest, params=params, max_batch=max_batch,
-                telemetry=pending_tel, device=device,
-            )
-            if warmup:
-                # Compile every padding bucket before the socket opens —
-                # the first remote household must not pay an XLA compile
-                # in-slot.
-                engine.warmup(include_step=False)
-            pending_queue = MicroBatchQueue(engine, max_wait_s=max_wait_s)
             registry.register(
                 engine, pending_queue, telemetry=pending_tel,
                 default=(i == 0),
@@ -978,7 +1193,10 @@ def build_gateway(
     restarts: int = 0,
 ) -> ServeGateway:
     """``build_registry`` + a gateway owning the result (the one-process
-    serving entry point; the fleet harness composes the pieces itself)."""
+    serving entry point; the fleet harness composes the pieces itself).
+    The gateway gets a ``bundle_factory`` over the same engine settings,
+    so ``/admin/register`` loads runtime candidates exactly like the
+    startup bundles."""
     registry = build_registry(
         bundle_dirs,
         max_batch=max_batch,
@@ -993,6 +1211,14 @@ def build_gateway(
         fault_injector=fault_injector, replica_id=replica_id,
         mux_port=mux_port, tls=tls, authenticator=authenticator,
         restarts=restarts,
+        bundle_factory=make_bundle_factory(
+            max_batch=max_batch,
+            max_wait_s=max_wait_s,
+            results_db=results_db,
+            device=device,
+            warmup=warmup,
+            run_name=run_name,
+        ),
     )
 
 

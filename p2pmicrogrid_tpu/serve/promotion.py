@@ -406,7 +406,10 @@ class CanaryController:
     attribution: ``flush_fn`` pushes the gateway bundles' buffered
     telemetry, then the controller reads each arm's ``serve_decision``
     rows since the stage started and attributes decision cost via
-    ``data/trace_export.trace_reward``.
+    ``data/trace_export.trace_reward`` — and each arm's ``serve_request``
+    spans, whose server-measured p95 REPLACES the client-side latency in
+    the SLO guards whenever present (``_arm_server_slo``): the serving
+    bundle's own clock judges the canary, not the loadgen's.
     """
 
     def __init__(
@@ -543,6 +546,47 @@ class CanaryController:
         )
         return float(cost.mean()), len(obs_rows), nonfinite
 
+    def _arm_server_slo(
+        self, config_hash: str, since_ts: float
+    ) -> Tuple[Optional[float], int]:
+        """(p95 latency ms, n requests) for one arm from the warehouse's
+        ``serve_request`` rows since the stage started — the SERVER-side
+        record of what the arm's engines actually did. The microbatch
+        queue stamps every request with its measured enqueue->dispatch
+        wait + batch service time in the serving bundle's telemetry run
+        (keyed by config_hash), so a slow replica is charged by its own
+        clock: client-side latencies — measured by whatever drove the
+        stage — can under-report a stall the loadgen never waited out,
+        and a fast loadgen clock must not be able to hide a slow arm."""
+        if self.results_db is None:
+            return None, 0
+        con = sqlite3.connect(f"file:{self.results_db}?mode=ro", uri=True)
+        try:
+            rows = con.execute(
+                "SELECT p.attrs_json FROM telemetry_points p "
+                "JOIN telemetry_runs t ON t.run_id = p.run_id "
+                "WHERE t.config_hash = ? AND p.kind = 'serve_request' "
+                "AND p.ts >= ?",
+                (config_hash, since_ts),
+            ).fetchall()
+        except sqlite3.OperationalError:
+            return None, 0  # pre-warehouse DB
+        finally:
+            con.close()
+        latencies: List[float] = []
+        for (attrs_json,) in rows:
+            try:
+                attrs = json.loads(attrs_json) if attrs_json else {}
+            except ValueError:
+                continue
+            v = attrs.get("latency_ms")
+            if isinstance(v, (int, float)):
+                latencies.append(float(v))
+        if not latencies:
+            return None, 0
+        # host-sync: warehouse JSON payloads, host data.
+        return float(np.percentile(np.asarray(latencies), 95)), len(latencies)
+
     # -- stage evaluation ----------------------------------------------------
 
     def _expected_arm(self, plan: StagePlan, household: Optional[str]) -> str:
@@ -616,6 +660,16 @@ class CanaryController:
             )
             m["decisions"] = n_cost
             m["nonfinite_actions"] += nonfinite_db
+            # Server-side SLO attribution (ISSUE 11 satellite): when the
+            # warehouse carries the arm's own serve_request spans for this
+            # stage, THEY are the latency the guards judge — the wire
+            # number demotes to detail. A slow replica cannot hide behind
+            # a fast loadgen clock.
+            server_p95, server_n = self._arm_server_slo(hash_, since_ts)
+            if server_p95 is not None:
+                m["client_p95_ms"] = m["p95_ms"]
+                m["p95_ms"] = round(server_p95, 3)
+                m["server_requests"] = server_n
             arms[hash_] = m
         cand, inc = arms[self.candidate], arms[self.incumbent]
         # The incumbent baseline accumulates ACROSS stages: at the 100%

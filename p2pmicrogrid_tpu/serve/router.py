@@ -334,6 +334,14 @@ class FleetRouter:
             self._order.append(r.replica_id)
             self._ring.add(r.replica_id)
         self._pins: Dict[str, str] = {}   # household -> failover target
+        # config_hash -> bundle_dir learned through register_fleet: what
+        # lets the prober RE-register a runtime candidate on a relaunched
+        # replica before re-pushing a missed fleet swap (_push_swap).
+        self.known_bundles: Dict[str, str] = {}
+        self.register_timeout_s = 180.0
+        # In-flight per-replica re-register workers (_push_swap): the
+        # engine compile a register costs must never block the prober.
+        self._realigners: Dict[str, threading.Thread] = {}
         self._anon_rr = 0
         self._rng = random.Random(jitter_seed)
         self._prober: Optional[threading.Thread] = None
@@ -344,7 +352,7 @@ class FleetRouter:
             "ejections": 0, "readmissions": 0, "shed": 0,
             "budget_denied": 0, "corrupt_detected": 0, "swaps": 0,
             "swap_aligns": 0, "probes": 0, "backoff_ms": 0.0,
-            "reconnects": 0, "auth_denied": 0,
+            "reconnects": 0, "auth_denied": 0, "registers": 0,
         }
 
     # -- counters / telemetry ------------------------------------------------
@@ -565,17 +573,67 @@ class FleetRouter:
             return False, f"{type(err).__name__}: {err}"
 
     def _push_swap(self, rep: Replica, config_hash: str) -> None:
-        """Best-effort synchronous ``/admin/swap`` push (probe thread)."""
-        body = json.dumps({"config_hash": config_hash})
-        conn = self._http_conn(rep, self.probe_timeout_s)
+        """Best-effort synchronous ``/admin/swap`` push (probe thread).
+
+        A 404 means the replica does not KNOW the hash — the process-mode
+        failure the autopilot hits when a replica relaunches after a
+        promotion: the fresh child only loaded its launch-time bundles,
+        and the promoted candidate was registered at runtime. When the
+        router learned the candidate's bundle dir (``register_fleet``
+        records it in ``known_bundles``), it re-registers the bundle on
+        the replica and re-pushes the swap — otherwise a crashed replica
+        would resurrect the retired incumbent for its households forever."""
+        status = self._admin_post_sync(
+            rep, "/admin/swap", {"config_hash": config_hash}
+        )
+        if status == 404:
+            with self._lock:
+                bundle_dir = self.known_bundles.get(config_hash)
+                # The register is an engine compile + warmup on the
+                # replica (tens of seconds) — it must NOT run on the
+                # probe thread, or one realigning replica freezes health
+                # sweeps (and therefore ejection/failover) for the whole
+                # fleet. One realign worker per replica at a time; the
+                # replica stays unready until a later sweep verifies.
+                busy = self._realigners.get(rep.replica_id)
+                if bundle_dir is None or (busy is not None and
+                                          busy.is_alive()):
+                    return
+
+                def realign() -> None:
+                    reg = self._admin_post_sync(
+                        rep, "/admin/register",
+                        {"bundle_dir": bundle_dir},
+                        timeout_s=self.register_timeout_s,
+                    )
+                    if reg == 200:
+                        self._admin_post_sync(
+                            rep, "/admin/swap",
+                            {"config_hash": config_hash},
+                        )
+
+                worker = threading.Thread(target=realign, daemon=True)
+                self._realigners[rep.replica_id] = worker
+            worker.start()
+
+    def _admin_post_sync(
+        self, rep: Replica, path: str, payload: dict,
+        timeout_s: Optional[float] = None,
+    ) -> Optional[int]:
+        """One synchronous admin POST (probe thread); returns the HTTP
+        status or None on transport failure — best-effort, the caller's
+        next probe sweep retries."""
+        body = json.dumps(payload)
+        conn = self._http_conn(rep, timeout_s or self.probe_timeout_s)
         try:
             conn.request(
-                "POST", "/admin/swap", body=body,
-                headers=self._auth_headers(),
+                "POST", path, body=body, headers=self._auth_headers(),
             )
-            conn.getresponse().read()
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
         except (OSError, http.client.HTTPException):
-            pass  # the replica stays unready; a later probe retries
+            return None  # the replica stays unready; a later probe retries
         finally:
             conn.close()
 
@@ -923,6 +981,182 @@ class FleetRouter:
             "previous": previous,
         }
 
+    # -- fleet-wide candidate lifecycle (ISSUE 11) ---------------------------
+
+    async def _admin_post(
+        self, rep: Replica, path: str, payload: dict, timeout_s: float
+    ):
+        return await _http_post_json(
+            rep.host, rep.port, path, payload, timeout_s,
+            ssl=self.ssl_context, token=self.token,
+        )
+
+    async def register_fleet(
+        self, bundle_dir: str, timeout_s: float = 180.0
+    ) -> str:
+        """Push ``/admin/register {bundle_dir}`` to every healthy replica
+        (the bundle dir must be reachable from the replica processes — a
+        shared filesystem, which one-host fleets trivially have). ALL
+        replicas must load it; any failure unregisters the bundle from the
+        replicas that did (best-effort) and raises ``FleetSwapError`` — a
+        candidate half-known to the fleet would turn the later split/swap
+        pushes into partial failures. Returns the registered config_hash.
+        The generous timeout covers an engine compile + warmup per
+        replica. Idempotent: replicas already serving the hash answer
+        ``already_registered``."""
+        targets = [(rid, self.replica(rid)) for rid in self.healthy_ids()]
+        if not targets:
+            raise FleetSwapError("no healthy replicas to register on")
+
+        # Concurrent pushes: a register costs an engine compile + warmup
+        # PER REPLICA (the 180 s budget exists for it) — serial awaits
+        # would multiply every canary phase's wall-clock by fleet size.
+        async def push_one(rid: str, rep: Replica):
+            try:
+                status, doc, _ = await self._admin_post(
+                    rep, "/admin/register",
+                    {"bundle_dir": bundle_dir}, timeout_s,
+                )
+            except _TRANSPORT_ERRORS as err:
+                return rid, None, f"register push failed ({err})"
+            if status != 200:
+                return rid, None, (
+                    f"register answered {status}: "
+                    f"{(doc or {}).get('error')}"
+                )
+            return rid, (doc or {}).get("config_hash"), None
+
+        results = await asyncio.gather(
+            *(push_one(rid, rep) for rid, rep in targets)
+        )
+        config_hash = next((h for _, h, _ in results if h), None)
+        failures = [(rid, err) for rid, _, err in results if err]
+        if failures:
+            # All-or-nothing: roll the successes back (unregister is
+            # idempotent, so pushing to every target is safe).
+            if config_hash:
+                await self.unregister_fleet(config_hash, timeout_s)
+            raise FleetSwapError(
+                "; ".join(f"{rid}: {err}" for rid, err in failures)
+            )
+        self._bump("registers")
+        with self._lock:
+            if config_hash:
+                self.known_bundles[config_hash] = bundle_dir
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "fleet_register", config_hash=config_hash,
+                bundle_dir=bundle_dir, replicas=[rid for rid, _ in targets],
+            )
+        return config_hash
+
+    async def unregister_fleet(
+        self, config_hash: str, timeout_s: float = 30.0
+    ) -> dict:
+        """Best-effort ``/admin/unregister`` on EVERY replica (healthy or
+        not — an ejected replica that re-admits must not keep serving an
+        orphaned candidate). Per-replica outcomes are returned, never
+        raised: unregistration is cleanup, and cleanup retries are the
+        caller's cadence loop."""
+        return await self._admin_broadcast(
+            "/admin/unregister", {"config_hash": config_hash}, timeout_s
+        )
+
+    async def _admin_broadcast(
+        self, path: str, payload: dict, timeout_s: float
+    ) -> Dict[str, str]:
+        """One admin POST to EVERY replica concurrently, best-effort;
+        per-replica outcomes, never raises (cleanup semantics)."""
+        async def one(rid: str) -> tuple:
+            rep = self.replica(rid)
+            try:
+                status, doc, _ = await self._admin_post(
+                    rep, path, payload, timeout_s
+                )
+                return rid, (
+                    "ok" if status == 200
+                    else f"{status}: {(doc or {}).get('error')}"
+                )
+            except _TRANSPORT_ERRORS as err:
+                return rid, f"unreachable: {err}"
+
+        return dict(await asyncio.gather(
+            *(one(rid) for rid in self.replica_ids)
+        ))
+
+    async def split_fleet(
+        self, config_hash: str, percent: float, timeout_s: float = 10.0
+    ) -> None:
+        """Push the canary split to every healthy replica (clearing pins
+        so the stage re-rolls household routing — the fleet analogue of
+        ``registry.clear_pins`` + ``set_split``). Any failure rolls the
+        split back off the replicas that took it and raises: a
+        half-split fleet would expose the candidate to an unknown,
+        unattributable traffic share."""
+        targets = [(rid, self.replica(rid)) for rid in self.healthy_ids()]
+        if not targets:
+            raise FleetSwapError("no healthy replicas to split")
+        payload = {
+            "split": {"config_hash": config_hash, "percent": percent},
+            "clear_pins": True,
+        }
+
+        async def push_one(rid: str, rep: Replica) -> tuple:
+            try:
+                status, doc, _ = await self._admin_post(
+                    rep, "/admin/swap", payload, timeout_s
+                )
+            except _TRANSPORT_ERRORS as err:
+                return rid, f"split push failed ({err})"
+            if status != 200:
+                return rid, (
+                    f"split answered {status}: {(doc or {}).get('error')}"
+                )
+            return rid, None
+
+        results = await asyncio.gather(
+            *(push_one(rid, rep) for rid, rep in targets)
+        )
+        failures = [(rid, err) for rid, err in results if err]
+        if failures:
+            # Roll the split back off every replica that took it.
+            await self._admin_broadcast(
+                "/admin/swap", {"split": None, "clear_pins": True},
+                timeout_s,
+            )
+            raise FleetSwapError(
+                "; ".join(f"{rid}: {err}" for rid, err in failures)
+            )
+
+    async def clear_split_fleet(self, timeout_s: float = 10.0) -> dict:
+        """Best-effort split + pin clear on EVERY replica, plus this
+        router's own failover pins — the canary abort's routing reset.
+        Returns per-replica outcomes (cleanup semantics, like
+        ``unregister_fleet``)."""
+        outcomes = await self._admin_broadcast(
+            "/admin/swap", {"split": None, "clear_pins": True}, timeout_s
+        )
+        with self._lock:
+            self._pins.clear()
+        return outcomes
+
+    async def clear_pins_fleet(self, timeout_s: float = 10.0) -> dict:
+        """Best-effort household-pin clear on every replica (stage
+        widening: re-roll routing without touching the split) + the
+        router's failover pins."""
+        outcomes = await self._admin_broadcast(
+            "/admin/swap", {"clear_pins": True}, timeout_s
+        )
+        with self._lock:
+            self._pins.clear()
+        return outcomes
+
+    async def flush_fleet(self, timeout_s: float = 30.0) -> dict:
+        """Best-effort ``/admin/flush`` on every replica: buffered
+        per-bundle telemetry lands in the warehouse before a canary
+        stage's attribution read."""
+        return await self._admin_broadcast("/admin/flush", {}, timeout_s)
+
     # -- observability -------------------------------------------------------
 
     def fleet_stats(self, timeout_s: float = 5.0) -> dict:
@@ -1084,6 +1318,7 @@ class LocalFleet:
             GatewayServer,
             ServeGateway,
             build_registry,
+            make_bundle_factory,
         )
 
         try:
@@ -1102,12 +1337,21 @@ class LocalFleet:
                     warmup=self.warmup,
                     run_name=f"{self.run_name}-{rid}",
                 )
+                factory = make_bundle_factory(
+                    max_batch=self.max_batch,
+                    max_wait_s=self.max_wait_s,
+                    results_db=self.results_db,
+                    device=self.device,
+                    warmup=self.warmup,
+                    run_name=f"{self.run_name}-{rid}",
+                )
                 gateway = ServeGateway(
                     registry, admission=self.admission, host=self.host,
                     port=0, own_bundles=False, fault_injector=injector,
                     replica_id=rid,
                     mux_port=0 if self.mux else None,
                     tls=self.tls, authenticator=self.authenticator,
+                    bundle_factory=factory,
                 )
                 server = GatewayServer(gateway)
                 try:
@@ -1121,6 +1365,7 @@ class LocalFleet:
                         "gateway": gateway,
                         "server": server,
                         "injector": injector,
+                        "factory": factory,
                         "host": host,
                         "port": port,
                         "mux_port": gateway.mux_port,
@@ -1197,6 +1442,7 @@ class LocalFleet:
                 fault_injector=e["injector"], replica_id=replica_id,
                 mux_port=e.get("mux_port"),
                 tls=self.tls, authenticator=self.authenticator,
+                bundle_factory=e.get("factory"),
             )
             server = GatewayServer(gateway)
         server.start()

@@ -418,6 +418,13 @@ def train_continual(
             "trace_transitions": dataset.n_transitions,
             "trace_runs": list(dataset.run_ids),
             "trace_steps": int(trace_losses.size),
+            # The export window (decision timestamps) this candidate
+            # trained on — the audit link between a promoted bundle and
+            # the leased warehouse window that produced it (ISSUE 11).
+            "trace_window": [
+                getattr(dataset, "window_start_ts", None),
+                getattr(dataset, "window_end_ts", None),
+            ],
             "sim_episodes": n_episodes,
             "rollbacks": len(rollbacks),
         },

@@ -416,15 +416,21 @@ def train_chunked_with_health(
     ``train`` command has; a caller-supplied ``telemetry`` keeps its own
     sinks and ignores this).
 
-    ``pipeline`` (default) runs each training block through the async
-    depth-2 driver (donated carries, lagged readback —
-    ``train_scenarios_chunked``); health evals sit at block BOUNDARIES and
-    consume the fully-drained block state, so basin/health decisions that
-    gate training (the lr-boost program switch) are unchanged by the
-    pipeline — only within-block telemetry/callback readback is lagged.
-    ``pipeline=False`` is the synchronous escape hatch. ``carry_sync`` is
-    forwarded to the chunked driver for callbacks that read the carry
-    mid-block (checkpoint cadence).
+    ``pipeline`` (default) runs the training blocks AND the block-boundary
+    health evals through one shared async depth-2 drain: the eval is
+    dispatched on the live device carry between blocks (before the next
+    block's donating dispatch — device-side data dependence keeps it
+    exact) and its host readback resolves lagged, so eval boundaries no
+    longer stall dispatch — measurable at ``eval_every=1``, where the old
+    per-boundary drain serialized every block on the host round trip. The
+    drain turns synchronous automatically whenever something READS an
+    eval before the next block may start: a divergence ``guard`` (its
+    trip must precede the next block's checkpoint persist) or
+    ``mitigate="lr-boost"`` (the next block's program keys on
+    ``monitor.in_basin``) — those paths keep the pre-pipeline semantics
+    bit-for-bit. ``pipeline=False`` is the synchronous escape hatch.
+    ``carry_sync`` is forwarded to the chunked driver for callbacks that
+    read the carry mid-block (checkpoint cadence).
 
     ``guard`` (a ``resilience.DivergenceGuard``): every block-boundary eval
     feeds it — the in-scan device counters (nonfinite q/loss) when telemetry
@@ -519,38 +525,100 @@ def train_chunked_with_health(
             cfg, policy, greedy_eval, seed=cfg.train.seed
         )
 
-    def do_eval(ep):
+    # The eval readback rides the SAME software pipeline as the training
+    # blocks (ISSUE 11 satellite): the greedy eval is dispatched on the
+    # live device carry between blocks, and its host readback resolves
+    # LAGGED through a shared AsyncDrain — the next block's dispatch never
+    # waits on the eval's host round trip. The drain stays synchronous
+    # exactly when something READS the eval before the next block may
+    # start: a divergence guard (its trip must precede the next block's
+    # checkpoint callback) or the lr-boost mitigation (the next block's
+    # PROGRAM depends on monitor.in_basin). ``pipeline=False`` is the
+    # depth-1 escape hatch on the same code path.
+    from p2pmicrogrid_tpu.telemetry.async_drain import AsyncDrain
+
+    sync_evals = (
+        not pipeline or guard is not None or mitigate == "lr-boost"
+    )
+    drain = AsyncDrain(depth=2 if pipeline else 1, telemetry=telemetry)
+
+    def consume_eval(tag, host):
+        ep = tag[1]
         if telemetry is not None:
             from p2pmicrogrid_tpu.telemetry import dc_to_dict
 
-            with telemetry.span("greedy_eval", episode=ep):
-                c, r, dc = greedy_eval(pol_state, jax.random.PRNGKey(1))
-                jax.block_until_ready(c)
+            c, r, dc = host
             dcd = dc_to_dict(dc)
             telemetry.record_device_counters(dcd)
-            telemetry.event("device_counters", episode=ep, phase="eval", **dcd)
+            telemetry.event(
+                "device_counters", episode=ep, phase="eval", **dcd
+            )
             if guard is not None:
                 guard.observe_counters(ep, dcd)
         else:
-            c, r = greedy_eval(pol_state, jax.random.PRNGKey(1))
-        status = monitor.update(ep, c, r)
+            c, r = host
+        status = monitor.update(ep, float(c), float(r))
         if guard is not None:
             guard.observe_health(ep, status)
         if health_cb:
             health_cb(monitor.points[-1])
 
+    def dispatch_eval(ep):
+        # Dispatch-only (no block_until_ready): the span measures the
+        # dispatch; the blocking readback lands in the drain's
+        # pipeline_drain span one slot later. MUST run before the next
+        # block's donating dispatch — the eval reads the carry the next
+        # block consumes in place.
+        span = (
+            telemetry.span("greedy_eval", episode=ep)
+            if telemetry is not None else contextlib.nullcontext()
+        )
+        with span:
+            out = greedy_eval(pol_state, jax.random.PRNGKey(1))
+        drain.push(("eval", ep), out, consume_eval)
+        if sync_evals:
+            drain.flush()
+
     rewards, losses = [], []
+    block_arrays: list = []
     seconds = 0.0
     done = 0
     import contextlib
 
+    def push_block_record(ep0, block, r_list, l_list, secs, boosting):
+        # A sentinel behind the block's own episode payloads: by FIFO,
+        # when it drains, r_list/l_list are fully materialized — so the
+        # per-block warehouse record lands within one pipeline slot of
+        # the block finishing (NOT deferred to end-of-run: a crashed or
+        # guard-tripped run keeps the records of every completed block,
+        # which is exactly when they matter). ``secs`` is dispatch time
+        # (the drain owns the readback).
+        def consume(_tag, _host):
+            if telemetry is not None:
+                telemetry.event(
+                    "train_block",
+                    episode0=ep0,
+                    episodes=block,
+                    seconds=round(secs, 3),
+                    mean_reward=float(np.mean(np.stack(r_list))),
+                    mean_loss=float(np.mean(np.stack(l_list))),
+                    lr_boosted=boosting,
+                )
+                telemetry.counter("train.episodes", block)
+                telemetry.histogram("train.block_seconds", secs)
+
+        drain.push(("block", ep0), (), consume)
+
     # An auto-created telemetry must close (summary.json + Chrome trace) even
     # when a block crashes — a failed run is exactly when the record matters.
     try:
-        do_eval(episode0)
+        dispatch_eval(episode0)
         while done < n_episodes:
             block = min(eval_every, n_episodes - done)
             runner, episode_fn = normal_runner, normal_episode_fn
+            # in_basin is current here by construction: lr-boost forces
+            # sync_evals, so the eval that gates this block's program was
+            # consumed before this line.
             boosting = mitigate == "lr-boost" and monitor.in_basin
             if boosting:
                 if boosted is None:
@@ -573,24 +641,23 @@ def train_chunked_with_health(
                     telemetry=telemetry,
                     pipeline=pipeline, donate=pipeline,
                     carry_sync=carry_sync,
+                    drain=drain, finalize=False,
                 )
-            if telemetry is not None:
-                telemetry.event(
-                    "train_block",
-                    episode0=episode0 + done,
-                    episodes=block,
-                    seconds=round(secs, 3),
-                    mean_reward=float(np.mean(r)),
-                    mean_loss=float(np.mean(l)),
-                    lr_boosted=boosting,
-                )
-                telemetry.counter("train.episodes", block)
-                telemetry.histogram("train.block_seconds", secs)
-            rewards.append(r)
-            losses.append(l)
+            # r/l are still-filling lists until their payloads drain;
+            # the sentinel emits the block's telemetry as soon as they
+            # are real, and the final stack below happens post-flush.
+            push_block_record(episode0 + done, block, r, l, secs, boosting)
+            block_arrays.append((r, l))
             seconds += secs
             done += block
-            do_eval(episode0 + done)
+            dispatch_eval(episode0 + done)
+        drain.flush()
+        # host-sync: end-of-run barrier so the carry (and timing) is real.
+        jax.block_until_ready(pol_state)
+        drain.finish()
+        for r, l in block_arrays:
+            rewards.append(np.stack(r))
+            losses.append(np.stack(l))
         if telemetry is not None:
             telemetry.gauge("train.seconds_total", seconds)
             monitor.emit_summary()

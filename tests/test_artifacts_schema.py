@@ -140,9 +140,13 @@ class TestResultsDbChecker:
     def test_version_in_sync_with_results_module(self, checker):
         from p2pmicrogrid_tpu.data.results import TELEMETRY_SCHEMA_VERSION
 
-        assert (
-            checker.EXPECTED_TELEMETRY_SCHEMA_VERSION
-            == TELEMETRY_SCHEMA_VERSION
+        # The CURRENT version must verify, and every accepted version must
+        # be at most current (older ones migrate in place on next write).
+        assert TELEMETRY_SCHEMA_VERSION in (
+            checker.ACCEPTED_TELEMETRY_SCHEMA_VERSIONS
+        )
+        assert max(checker.ACCEPTED_TELEMETRY_SCHEMA_VERSIONS) == (
+            TELEMETRY_SCHEMA_VERSION
         )
 
     def test_orphaned_points_and_bad_version_flagged(self, checker, tmp_path):
@@ -202,3 +206,91 @@ class TestResultsDbChecker:
         con.close()
         problems = checker.check_all(str(tmp_path))
         assert any("telemetry_spans" in p for p in problems)
+
+
+class TestAutopilotChecker:
+    """AUTOPILOT_*.jsonl + cycle-journal validation (ISSUE 11)."""
+
+    def _good_rows(self):
+        cycle = {
+            "metric": "autopilot_cycle", "value": 0.0, "unit": "cycle",
+            "vs_baseline": 1.0, "cycle": 0, "promoted": True,
+            "blocked_at_gate": False, "rolled_back": False,
+            "outcome_ok": True,
+        }
+        head = {
+            "metric": "autopilot_bench", "value": 3.0, "unit": "cycles",
+            "vs_baseline": 1.0, "cycles": 3, "promotions": 1, "blocked": 2,
+            "rollbacks": 0, "bad_promotions": 0, "availability": 1.0,
+            "all_safe": True,
+        }
+        return cycle, head
+
+    def test_good_capture_passes(self, checker, tmp_path):
+        cycle, head = self._good_rows()
+        path = tmp_path / "AUTOPILOT_r99.jsonl"
+        path.write_text(json.dumps(cycle) + "\n" + json.dumps(head) + "\n")
+        problems = []
+        checker.check_autopilot_jsonl(str(path), problems)
+        assert problems == []
+
+    def test_bad_captures_flagged(self, checker, tmp_path):
+        cycle, head = self._good_rows()
+        bad_head = dict(head)
+        del bad_head["all_safe"]
+        bad_head["availability"] = 1.5
+        path = tmp_path / "AUTOPILOT_bad.jsonl"
+        path.write_text(
+            json.dumps(cycle) + "\n" + json.dumps(bad_head) + "\n"
+        )
+        problems = []
+        checker.check_autopilot_jsonl(str(path), problems)
+        assert any("all_safe" in p for p in problems)
+        assert any("outside [0, 1]" in p for p in problems)
+        # Headline-after-cycles ordering + presence are contractual.
+        path2 = tmp_path / "AUTOPILOT_nohead.jsonl"
+        path2.write_text(json.dumps(cycle) + "\n")
+        problems = []
+        checker.check_autopilot_jsonl(str(path2), problems)
+        assert any("headline" in p for p in problems)
+
+    def test_journal_digest_verified(self, checker, tmp_path):
+        from p2pmicrogrid_tpu.serve.autopilot import (
+            AutopilotState,
+            journal_path,
+            write_journal,
+        )
+
+        write_journal(str(tmp_path), AutopilotState(cycle=2, phase="idle"))
+        path = journal_path(str(tmp_path))
+        problems = []
+        checker.check_cycle_journal(path, problems)
+        assert problems == []
+        record = json.load(open(path))
+        record["state"]["promotions"] = 99  # tamper
+        json.dump(record, open(path, "w"))
+        problems = []
+        checker.check_cycle_journal(path, problems)
+        assert any("digest does not verify" in p for p in problems)
+
+    def test_check_all_scans_autopilot_artifacts(self, checker, tmp_path):
+        from p2pmicrogrid_tpu.serve.autopilot import (
+            AutopilotState,
+            write_journal,
+        )
+
+        art = tmp_path / "artifacts"
+        art.mkdir()
+        (art / "AUTOPILOT_r99.jsonl").write_text(
+            json.dumps({"metric": "autopilot_bench", "value": 1.0,
+                        "unit": "cycles", "vs_baseline": 1.0}) + "\n"
+        )
+        state = AutopilotState(cycle=0)
+        state.phase = "idle"
+        write_journal(str(art), state)
+        os.rename(
+            str(art / "cycle_journal.json"),
+            str(art / "AUTOPILOT_JOURNAL_r99.json"),
+        )
+        problems = checker.check_all(str(tmp_path))
+        assert any("autopilot_cycle" in p for p in problems)

@@ -602,3 +602,79 @@ def test_bench_registry_includes_chunked_pipeline():
     assert "chunked_pipeline" in BENCHES
     assert "chunked_pipeline" in CPU_RETRYABLE
     assert list(BENCHES)[-1] == "northstar"  # headline row stays last
+
+
+class TestAsyncHealthEval:
+    """The block-boundary health eval rides the shared pipeline (ISSUE 11
+    satellite): at eval_every=1 the old design drained the whole pipeline
+    at EVERY boundary; now the eval dispatches on the live carry and its
+    readback resolves lagged — with bit-identical training state, health
+    points and classifications, and the synchronous drain kept exactly
+    when a guard or the lr-boost mitigation reads the eval."""
+
+    def _run(self, pipeline, flush_counts, guard=None):
+        from p2pmicrogrid_tpu.envs import make_ratings
+        from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+        from p2pmicrogrid_tpu.train.health import train_chunked_with_health
+
+        cfg = _cfg(S=2, A=2)
+        policy = make_policy(cfg)
+        ratings = make_ratings(cfg, np.random.default_rng(0))
+        ps = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+        flush_counts.clear()
+        flush_counts.append(0)
+        return train_chunked_with_health(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=4, n_chunks=1, eval_every=1, telemetry=None,
+            pipeline=pipeline, s_eval=2, guard=guard,
+        )
+
+    def test_eval_every_1_bit_exact_and_unthrottled(self, monkeypatch):
+        counts: list = []
+        orig_flush = AsyncDrain.flush
+
+        def counting_flush(self):
+            counts[0] += 1
+            return orig_flush(self)
+
+        monkeypatch.setattr(AsyncDrain, "flush", counting_flush)
+        ps_a, r_a, l_a, _, mon_a = self._run(True, counts)
+        n_async = counts[0]
+        ps_s, r_s, l_s, _, mon_s = self._run(False, counts)
+        n_sync = counts[0]
+        # Pipelined evals: ONE terminal flush (+ finish), not one per
+        # boundary — that per-boundary drain was the measurable cost at
+        # eval_every=1.
+        assert n_async <= 3
+        assert n_sync >= 5  # depth-1: every boundary drains
+        assert _leaves_equal(ps_a, ps_s)
+        np.testing.assert_array_equal(r_a, r_s)
+        np.testing.assert_array_equal(l_a, l_s)
+        assert [tuple(p) for p in mon_a.points] == [
+            tuple(p) for p in mon_s.points
+        ]
+        # Lagged consumption preserved eval ORDER (episode monotone).
+        assert [p.episode for p in mon_a.points] == [0, 1, 2, 3, 4]
+
+    def test_guard_keeps_synchronous_drain(self, monkeypatch):
+        """A divergence guard must observe each eval BEFORE the next
+        block: the drain stays synchronous when one is attached."""
+        from p2pmicrogrid_tpu.train.resilience import DivergenceGuard
+
+        counts: list = []
+        orig_flush = AsyncDrain.flush
+
+        def counting_flush(self):
+            counts[0] += 1
+            return orig_flush(self)
+
+        monkeypatch.setattr(AsyncDrain, "flush", counting_flush)
+        ps_g, r_g, l_g, _, mon_g = self._run(
+            True, counts, guard=DivergenceGuard()
+        )
+        assert counts[0] >= 5  # per-boundary flush kept
+        ps_s, r_s, l_s, _, mon_s = self._run(False, counts)
+        assert _leaves_equal(ps_g, ps_s)
+        assert [tuple(p) for p in mon_g.points] == [
+            tuple(p) for p in mon_s.points
+        ]

@@ -970,10 +970,10 @@ class TestServeCompaction:
         self._seed_serve_requests(db)
         with ResultsStore(db) as store:
             summary = store.compact_serve_telemetry(older_than_hours=1.0)
-            assert summary == {
+            assert summary.items() >= {
                 "rows_compacted": 12, "aggregates_written": 2,
-                "decisions_compacted": 0,
-            }
+                "decisions_compacted": 0, "lease_capped": False,
+            }.items()
             # The recent row survives raw; the old tail is aggregates now.
             (raw,) = store.con.execute(
                 "SELECT COUNT(*) FROM telemetry_points "
@@ -997,10 +997,12 @@ class TestServeCompaction:
             )
             assert attrs["ts_min"] < attrs["ts_max"]
             # Idempotent: a second pass finds nothing left to compact.
-            assert store.compact_serve_telemetry(older_than_hours=1.0) == {
+            assert store.compact_serve_telemetry(
+                older_than_hours=1.0
+            ).items() >= {
                 "rows_compacted": 0, "aggregates_written": 0,
                 "decisions_compacted": 0,
-            }
+            }.items()
             # The warehouse stays orphan-free (seq continuity preserved).
             (orphans,) = store.con.execute(
                 "SELECT COUNT(*) FROM telemetry_points t WHERE NOT EXISTS "
@@ -1028,10 +1030,12 @@ class TestServeCompaction:
                        "wait_ms": 1.0, "service_ms": 1.0, "latency_ms": 2.0})
         # Compact mid-run, sink still open and counting in memory.
         with ResultsStore(db) as store:
-            assert store.compact_serve_telemetry(older_than_hours=1.0) == {
+            assert store.compact_serve_telemetry(
+                older_than_hours=1.0
+            ).items() >= {
                 "rows_compacted": 4, "aggregates_written": 1,
                 "decisions_compacted": 0,
-            }
+            }.items()
         for i in range(4):  # the live sink keeps streaming afterwards
             sink.emit({"ts": _time.time(), "kind": "serve_request",
                        "bucket": 2, "wait_ms": 1.0, "service_ms": 1.0,

@@ -438,6 +438,125 @@ def check_promotion_jsonl(path: str, problems: list) -> None:
         problems.append(f"{where}: no promotion_case row")
 
 
+def check_autopilot_jsonl(path: str, problems: list) -> None:
+    """AUTOPILOT_*.jsonl: metric rows + the unattended-cycle contract —
+    numeric cycles/promotions/blocked/rollbacks, availability in [0, 1],
+    boolean all_safe on the ``autopilot_bench`` headline (which must be
+    the LAST row), plus per-cycle ``autopilot_cycle`` rows."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return  # already reported by check_metric_jsonl
+    rows = []
+    for i, line in enumerate(lines):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # already reported
+        if isinstance(row, dict):
+            rows.append((i + 1, row))
+    cycles = [r for _, r in rows if r.get("metric") == "autopilot_cycle"]
+    if not cycles:
+        problems.append(f"{where}: no autopilot_cycle row")
+    headlines = [
+        (n, r) for n, r in rows if r.get("metric") == "autopilot_bench"
+    ]
+    if not headlines:
+        problems.append(f"{where}: no autopilot_bench headline row")
+        return
+    n, head = headlines[-1]
+    if rows and rows[-1][1] is not head:
+        problems.append(
+            f"{where}: autopilot_bench headline must be the last row"
+        )
+    for key in ("cycles", "promotions", "blocked", "rollbacks",
+                "bad_promotions"):
+        v = head.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(
+                f"{where}:{n}: autopilot_bench missing numeric {key!r}"
+            )
+    availability = head.get("availability")
+    if not isinstance(availability, (int, float)) or isinstance(
+        availability, bool
+    ):
+        problems.append(
+            f"{where}:{n}: autopilot_bench missing numeric 'availability'"
+        )
+    elif not 0.0 <= availability <= 1.0:
+        problems.append(
+            f"{where}:{n}: availability {availability} outside [0, 1]"
+        )
+    if not isinstance(head.get("all_safe"), bool):
+        problems.append(
+            f"{where}:{n}: autopilot_bench missing boolean 'all_safe'"
+        )
+    for i, row in enumerate(cycles):
+        for key in ("cycle",):
+            if not isinstance(row.get(key), (int, float)):
+                problems.append(
+                    f"{where}: autopilot_cycle row {i} missing numeric "
+                    f"{key!r}"
+                )
+        for key in ("promoted", "blocked_at_gate", "rolled_back",
+                    "outcome_ok"):
+            if not isinstance(row.get(key), bool):
+                problems.append(
+                    f"{where}: autopilot_cycle row {i} missing boolean "
+                    f"{key!r}"
+                )
+
+
+_JOURNAL_PHASES = (
+    "idle", "exporting", "retraining", "gating", "canarying",
+    "promoted", "aborted",
+)
+
+
+def check_cycle_journal(path: str, problems: list) -> None:
+    """Validate one autopilot cycle journal (serve/autopilot.py): kind +
+    format_version, a digest that VERIFIES over the canonical state
+    payload, a known phase, and the safety counters. The digest check is
+    the whole point — a committed journal that does not verify is
+    exactly the torn write the atomic-rename contract exists to
+    prevent."""
+    import hashlib
+
+    where = os.path.relpath(path)
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as err:
+        problems.append(f"{where}: unreadable journal ({err})")
+        return
+    if record.get("kind") != "autopilot_journal":
+        problems.append(f"{where}: kind != 'autopilot_journal'")
+        return
+    if not isinstance(record.get("format_version"), int):
+        problems.append(f"{where}: missing integer format_version")
+    state = record.get("state")
+    if not isinstance(state, dict):
+        problems.append(f"{where}: missing state object")
+        return
+    payload = json.dumps(state, sort_keys=True, separators=(",", ":"))
+    want = f"sha256:{hashlib.sha256(payload.encode()).hexdigest()}"
+    if record.get("digest") != want:
+        problems.append(f"{where}: journal digest does not verify")
+    if state.get("phase") not in _JOURNAL_PHASES:
+        problems.append(f"{where}: unknown phase {state.get('phase')!r}")
+    for key in ("cycle", "promotions", "blocked", "rollbacks",
+                "bad_promotions"):
+        if not isinstance(state.get(key), (int, float)) or isinstance(
+            state.get(key), bool
+        ):
+            problems.append(f"{where}: state missing numeric {key!r}")
+    if not isinstance(state.get("lineage"), list):
+        problems.append(f"{where}: state missing list 'lineage'")
+
+
 # Checkpoint integrity manifests (train/checkpoint.py save layout):
 # models_<impl>/<setting>/ep_<episode>/p2p_manifest.json.
 CHECKPOINT_MANIFEST_GLOBS = (
@@ -684,7 +803,10 @@ def check_run_dir(run_dir: str, problems: list) -> None:
 
 # Keep in sync with p2pmicrogrid_tpu/data/results.py:TELEMETRY_SCHEMA_VERSION
 # (hardcoded so this tool stays stdlib-only and runs without the package).
-EXPECTED_TELEMETRY_SCHEMA_VERSION = 1
+# v1 = warehouse tables; v2 added export_leases (the export/retention
+# handshake). A v1 DB is still valid — it migrates in place on its next
+# write (data/results.ensure_telemetry_schema) — so both verify.
+ACCEPTED_TELEMETRY_SCHEMA_VERSIONS = (1, 2)
 
 _TELEMETRY_TABLES = ("telemetry_runs", "telemetry_points", "telemetry_spans")
 
@@ -727,10 +849,10 @@ def check_results_db(path: str, problems: list) -> None:
             )
             return
         (version,) = con.execute("PRAGMA user_version").fetchone()
-        if version != EXPECTED_TELEMETRY_SCHEMA_VERSION:
+        if version not in ACCEPTED_TELEMETRY_SCHEMA_VERSIONS:
             problems.append(
                 f"{where}: telemetry schema version {version}, expected "
-                f"{EXPECTED_TELEMETRY_SCHEMA_VERSION}"
+                f"one of {ACCEPTED_TELEMETRY_SCHEMA_VERSIONS}"
             )
         for table in ("telemetry_points", "telemetry_spans"):
             (orphans,) = con.execute(
@@ -796,6 +918,16 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
         glob.glob(os.path.join(repo_root, "artifacts", "PROMOTION_*.jsonl"))
     ):
         check_promotion_jsonl(path, problems)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "AUTOPILOT_*.jsonl"))
+    ):
+        check_autopilot_jsonl(path, problems)
+    for pattern in (
+        os.path.join("artifacts", "AUTOPILOT_JOURNAL_*.json"),
+        os.path.join("artifacts", "autopilot*", "cycle_journal.json"),
+    ):
+        for path in sorted(glob.glob(os.path.join(repo_root, pattern))):
+            check_cycle_journal(path, problems)
     for pattern in CHECKPOINT_MANIFEST_GLOBS:
         for path in sorted(glob.glob(os.path.join(repo_root, pattern))):
             check_checkpoint_manifest(path, problems)

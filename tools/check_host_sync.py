@@ -76,6 +76,10 @@ HOT_PATH_FILES = (
     # either stall training or the canary's stage cadence.
     os.path.join("p2pmicrogrid_tpu", "train", "continual.py"),
     os.path.join("p2pmicrogrid_tpu", "serve", "promotion.py"),
+    # The autopilot (ISSUE 11) drives the whole continual cycle next to
+    # live fleet traffic: a stray blocking readback in its cycle loop
+    # stalls the canary cadence and the recovery path alike.
+    os.path.join("p2pmicrogrid_tpu", "serve", "autopilot.py"),
     os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
 )
 
