@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -899,6 +900,304 @@ def bench_chunked_pipeline() -> dict:
     }
 
 
+def _emit_row(row: dict) -> None:
+    """Emit an extra metric row through the current telemetry sink (the
+    multi-row benches return their headline and emit siblings here)."""
+    from p2pmicrogrid_tpu.telemetry import current
+
+    current().emit(row)
+
+
+def _slot_fused_row(impl: str, n_agents: int, n_scenarios: int,
+                    episodes: int = 2) -> dict:
+    """Fused-vs-unfused same-seed comparison for one policy, ONE process:
+    the same shared-scenario episode program run through the op chain and
+    through the slot megakernel (ops/pallas_slot.py), from identical inits
+    with identical keys — both rates, a bit-exactness verdict on the final
+    learner state, and the fused/unfused speedup."""
+    import jax
+
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel import (
+        init_shared_state,
+        make_scenario_traces,
+        stack_scenario_arrays,
+    )
+    from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+    from p2pmicrogrid_tpu.train import make_policy
+
+    cfg = default_config(
+        # Explicit factored market: the clearing variant the north-star TPU
+        # slot runs (and the megakernel's main fusion target), exact on any
+        # backend.
+        sim=SimConfig(
+            n_agents=n_agents, n_scenarios=n_scenarios,
+            market_impl="factored",
+        ),
+        train=TrainConfig(implementation=impl),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    traces = make_scenario_traces(cfg)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    slots = int(arrays.time.shape[1])
+
+    results = {}
+    for fused in (False, True):
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        fn = make_shared_episode_fn(cfg, policy, arrays, ratings, fused=fused)
+        carry = (ps, scen)
+        carry, _ = fn(carry, jax.random.PRNGKey(99))  # compile + warm
+        jax.block_until_ready(carry[0])
+        start = time.time()
+        for e in range(episodes):
+            carry, _ = fn(carry, jax.random.PRNGKey(100 + e))
+        jax.block_until_ready(carry[0])
+        secs = time.time() - start
+        results[fused] = {
+            "rate": episodes * slots * n_scenarios / secs,
+            "final": carry[0],
+        }
+
+    import jax.tree_util as jtu
+
+    bit_exact = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jtu.tree_leaves(results[False]["final"]),
+            jtu.tree_leaves(results[True]["final"]),
+        )
+    )
+    from p2pmicrogrid_tpu.ops.pallas_slot import _interpret
+
+    speedup = results[True]["rate"] / results[False]["rate"]
+    return {
+        "metric": (
+            f"slot_fused_env_steps_per_sec_{n_agents}agent_"
+            f"{n_scenarios}scenario_{impl}"
+        ),
+        "value": round(results[True]["rate"], 1),
+        "unit": _chip_unit(),
+        # The megakernel's own baseline is the unfused chain on the same
+        # program/seeds: the ratio IS the fusion payoff (on non-TPU hosts
+        # the kernel runs in the interpreter, so this reads < 1 there —
+        # interpret_mode flags it; the TPU capture is ROADMAP debt).
+        "vs_baseline": round(speedup, 3),
+        "speedup": round(speedup, 3),
+        "bit_exact": bool(bit_exact),
+        "fused_env_steps_per_sec": round(results[True]["rate"], 1),
+        "unfused_env_steps_per_sec": round(results[False]["rate"], 1),
+        "implementation": impl,
+        "market_impl": "factored",
+        "episodes_measured": episodes,
+        "interpret_mode": bool(_interpret()),
+    }
+
+
+def bench_slot_fused() -> dict:
+    """Fused slot megakernel vs the op chain, tabular AND dqn (dqn row
+    emitted as a sibling; the tabular row is the returned headline)."""
+    _emit_row(_slot_fused_row("dqn", 8, 8, episodes=1))
+    return _slot_fused_row("tabular", 16, 16, episodes=2)
+
+
+def bench_serve_quantized() -> dict:
+    """Per-dtype serving: p50/p99, cold-start and AOT swap-warmup delta for
+    float32 / float16 / int8 bundles of the same checkpoint — one engine
+    process per dtype, greedy actions compared against the float32 bundle.
+    float32/float16 rows are emitted as siblings; int8 is the headline."""
+    import tempfile
+
+    import jax
+
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.train import init_policy_state
+
+    A, max_batch, slo_ms = 50, 64, 100.0
+    cfg = default_config(
+        sim=SimConfig(n_agents=A), train=TrainConfig(implementation="tabular")
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    ps = ps._replace(
+        q_table=rng.standard_normal(ps.q_table.shape).astype(np.float32) * 0.1
+    )
+    tmp = tempfile.mkdtemp(prefix="p2p-quantbench-")
+    try:
+        return _bench_serve_quantized_in(tmp, cfg, ps, A, max_batch, slo_ms)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_serve_quantized_in(tmp, cfg, ps, A, max_batch, slo_ms) -> dict:
+    from p2pmicrogrid_tpu.serve.engine import (
+        PolicyEngine,
+        clear_aot_program_cache,
+    )
+    from p2pmicrogrid_tpu.serve.export import (
+        calibration_obs,
+        export_policy_bundle,
+    )
+    from p2pmicrogrid_tpu.serve.loadgen import serve_bench
+
+    obs = calibration_obs(max_batch, A, seed=11)
+
+    ref_actions = None
+    headline = None
+    for dtype in ("float32", "float16", "int8"):
+        # Cold start measured honestly per dtype: drop the process-wide AOT
+        # program cache, then time bundle-load + warmup from nothing.
+        clear_aot_program_cache()
+        bundle = export_policy_bundle(
+            cfg, ps, os.path.join(tmp, dtype), dtype=dtype
+        )
+        t0 = time.perf_counter()
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=max_batch)
+        engine.warmup(include_step=False)
+        cold_start_s = time.perf_counter() - t0
+        actions = engine.act(obs)
+        if dtype == "float32":
+            ref_actions = actions
+        bit_exact = bool(np.array_equal(actions, ref_actions))
+
+        # Sink-less telemetry around the SLO bench: serve_bench streams
+        # per-request trace records into the current sinks, and the bench
+        # suite's guarded stdout sink must carry metric rows ONLY (one
+        # non-metric line would invalidate the committed capture).
+        from p2pmicrogrid_tpu.telemetry import Telemetry, current, set_current
+
+        prev_tel = current()
+        set_current(Telemetry(run_id=f"serve-quantized-{dtype}"))
+        try:
+            bench_rows = serve_bench(
+                engine, rate_hz=256.0, n_requests=512, seed=0, slo_ms=slo_ms
+            )
+        finally:
+            set_current(prev_tel)
+        stats = bench_rows[-1]
+
+        # Swap warmup: a FRESH same-architecture engine (the gateway
+        # hot-swap/candidate-promotion path) adopting the AOT-cached bucket
+        # programs instead of recompiling.
+        t1 = time.perf_counter()
+        engine2 = PolicyEngine(bundle_dir=bundle, max_batch=max_batch)
+        engine2.warmup(include_step=False)
+        swap_warmup_s = time.perf_counter() - t1
+        import json as _json
+
+        with open(os.path.join(bundle, "manifest.json")) as f:
+            param_bytes = _json.load(f)["param_bytes"]
+        p99 = float(stats["p99_ms"])
+        row = {
+            "metric": f"serve_quantized_{dtype}",
+            "value": round(p99, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / p99, 2) if p99 > 0 else 0.0,
+            "dtype": dtype,
+            "p50_ms": stats["p50_ms"],
+            "p99_ms": stats["p99_ms"],
+            "throughput_rps": stats["throughput_rps"],
+            "cold_start_s": round(cold_start_s, 4),
+            "swap_warmup_s": round(swap_warmup_s, 4),
+            "warmup_speedup": round(
+                cold_start_s / swap_warmup_s, 1
+            ) if swap_warmup_s > 0 else 0.0,
+            "aot_hits_on_swap": engine2.stats["aot_hits"],
+            "bit_exact": bit_exact,
+            "param_bytes": param_bytes,
+            "implementation": "tabular",
+            "n_agents": A,
+            "max_batch": max_batch,
+        }
+        if dtype == "int8":
+            headline = row
+        else:
+            _emit_row(row)
+    return headline
+
+
+def bench_pipeline_depth() -> dict:
+    """Pipeline-depth sweep on the chunked async driver (ROADMAP
+    measurement debt): the SAME chunked program driven at drain depth 1
+    (sync), 2 (the shipped default) and 4, same seeds — per-depth rates in
+    one row, speedup = best-async/sync, plus a bit-identical check across
+    depths (readback depth must never change values)."""
+    import jax
+
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+    from p2pmicrogrid_tpu.parallel.scenarios import (
+        make_chunked_episode_runner,
+        make_shared_episode_fn,
+        train_scenarios_chunked,
+    )
+    from p2pmicrogrid_tpu.telemetry.async_drain import AsyncDrain
+    from p2pmicrogrid_tpu.train import make_policy
+
+    A, S, K, episodes = 20, 16, 8, 4
+    depths = (1, 2, 4)
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S),
+        train=TrainConfig(implementation="tabular"),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    policy = make_policy(cfg)
+    episode_fn = make_shared_episode_fn(
+        cfg, policy, None, ratings,
+        arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, S),
+        n_scenarios=S,
+    )
+    runner = make_chunked_episode_runner(cfg, episode_fn, K, donate=True)
+    slots = cfg.sim.slots_per_day
+
+    rates, finals = {}, {}
+    for depth in depths:
+        ps = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+        # Warm the exact measured program.
+        ps, _, _, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=1, n_chunks=K, episode_fn=episode_fn, runner=runner,
+            donate=True,
+        )
+        ps, _, _, secs = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=episodes, n_chunks=K, episode0=1,
+            episode_fn=episode_fn, runner=runner, donate=True,
+            drain=AsyncDrain(depth=depth),
+        )
+        rates[depth] = episodes * slots * S * K / secs
+        finals[depth] = ps
+
+    import jax.tree_util as jtu
+
+    bit_exact = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for d in depths[1:]
+        for a, b in zip(
+            jtu.tree_leaves(finals[depths[0]]), jtu.tree_leaves(finals[d])
+        )
+    )
+    best = max(rates[d] for d in depths if d > 1)
+    speedup = best / rates[1]
+    return {
+        "metric": f"pipeline_depth_env_steps_per_sec_{A}agent_{S}x{K}scenario",
+        "value": round(rates[2], 1),
+        "unit": _chip_unit(),
+        "vs_baseline": round(speedup, 3),
+        "speedup": round(speedup, 3),
+        "depth_1_env_steps_per_sec": round(rates[1], 1),
+        "depth_2_env_steps_per_sec": round(rates[2], 1),
+        "depth_4_env_steps_per_sec": round(rates[4], 1),
+        "bit_exact": bool(bit_exact),
+        "chunks_per_episode": K,
+        "chunk_scenarios": S,
+        "episodes_measured": episodes,
+    }
+
+
 def converged_episode(
     prices: np.ndarray, window: int, band_abs: float = 0.002, band_rel: float = 0.02
 ) -> int:
@@ -1163,6 +1462,9 @@ BENCHES = {
     "cfg5": bench_cfg5,
     "cfg4": bench_cfg4,
     "chunked_pipeline": bench_chunked_pipeline,
+    "slot_fused": bench_slot_fused,
+    "serve_quantized": bench_serve_quantized,
+    "pipeline_depth": bench_pipeline_depth,
     # North star last: the driver parses the final JSON line, and the
     # full-aggregate 1000x10240 number is the headline.
     "northstar": bench_northstar,
@@ -1175,7 +1477,7 @@ BENCHES = {
 # the error row they'd otherwise produce.
 CPU_RETRYABLE = {
     "cfg1", "cfg2", "cfg3", "cfg5", "convergence", "convergence_fast",
-    "chunked_pipeline",
+    "chunked_pipeline", "slot_fused", "serve_quantized", "pipeline_depth",
 }
 
 

@@ -1621,12 +1621,20 @@ def cmd_export_bundle(args) -> int:
     out = args.out or os.path.join(
         "bundles", f"{_persist_setting(args, cfg)}-{cfg.train.implementation}"
     )
+    export_kw = {}
+    if getattr(args, "ulp_budget", None) is not None:
+        export_kw["ulp_budget"] = args.ulp_budget
+    if getattr(args, "aot_buckets", None):
+        export_kw["aot_buckets"] = [
+            int(b) for b in str(args.aot_buckets).split(",") if b.strip()
+        ]
     path = export_policy_bundle(
         cfg,
         pol_state,
         out,
         source={"checkpoint": os.path.abspath(ckpt_dir), "episode": episode},
         dtype=args.dtype,
+        **export_kw,
     )
     import json as _json
 
@@ -3545,11 +3553,28 @@ def main(argv=None) -> int:
     p.add_argument("--out",
                    help="bundle output directory (default "
                         "bundles/<setting>-<implementation>)")
-    p.add_argument("--dtype", choices=["float32", "float16"],
+    p.add_argument("--dtype", choices=["float32", "float16", "int8"],
                    default="float32",
                    help="on-disk dtype for floating parameter leaves "
-                        "(float16 halves the bundle; the engine computes in "
-                        "float32 either way)")
+                        "(float16 halves the bundle, int8 quarters it with "
+                        "per-leaf scale calibration and the error-bound "
+                        "contract of serve/export.py; the engine computes "
+                        "in float32 either way)")
+    p.add_argument("--ulp-budget", type=float, dest="ulp_budget",
+                   default=None,
+                   help="int8 continuous-actor error budget in float32 ulps "
+                        "(default: serve/export.py DEFAULT_ULP_BUDGET; the "
+                        "export refuses a bundle whose measured max ulp "
+                        "exceeds it, and the promotion gate re-checks the "
+                        "recorded bound)")
+    p.add_argument("--aot-buckets", dest="aot_buckets", default=None,
+                   help="comma-separated padding buckets to AOT-compile at "
+                        "export time, e.g. '1,8,64' (jit().lower().compile() "
+                        "into the IN-PROCESS program cache, compile timings "
+                        "recorded in the manifest): engine warmup / gateway "
+                        "hot-swap of this architecture skips the cold "
+                        "compile WITHIN the exporting process — a later "
+                        "process recompiles; executables are not serialized")
     p.set_defaults(fn=cmd_export_bundle)
 
     p = sub.add_parser(
@@ -3829,9 +3854,10 @@ def main(argv=None) -> int:
     p.add_argument("--lr-drop", type=float, default=0.5, dest="lr_drop",
                    help="rollback perturbation: effective lrs x this "
                         "factor per rollback (default 0.5)")
-    p.add_argument("--dtype", choices=["float32", "float16"],
+    p.add_argument("--dtype", choices=["float32", "float16", "int8"],
                    default="float32",
-                   help="candidate bundle export dtype")
+                   help="candidate bundle export dtype (int8 applies the "
+                        "quantization error-bound contract at export)")
     p.add_argument("--pipeline", action=argparse.BooleanOptionalAction,
                    default=True,
                    help="async episode pipeline for the simulator phase")

@@ -286,6 +286,16 @@ class SimConfig:
     # else; compute is always f32 in VMEM. Resolution:
     # envs/community.py:resolve_market_dtype.
     market_dtype: str = "auto"
+    # Fused per-slot Pallas megakernel (ops/pallas_slot.py): the whole slot
+    # — obs build, tabular/DQN policy act, market clearing, battery +
+    # thermal integration — as ONE kernel with VMEM-resident carries,
+    # replacing the per-slot chain of small fusions. None (default)
+    # resolves to False (the unfused chain stays the committed-seed
+    # reference; the megakernel's TPU capture is ROADMAP measurement
+    # debt); True opts in (tabular/dqn only — validated at resolution,
+    # envs/community.py:resolve_use_fused). Same-seed bit-exact vs the
+    # chain on the interpret-mode CPU path (tests/test_pallas_slot.py).
+    fused_slot: Optional[bool] = None
     # Negotiation/clearing implementation for the scenario-batched path
     # (envs/community.py:slot_dynamics_batched):
     #   "matrix"   — materialize the [S, A, A] proposal matrices (jnp ops or

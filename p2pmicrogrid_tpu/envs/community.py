@@ -425,6 +425,31 @@ def resolve_use_pallas(cfg: ExperimentConfig) -> bool:
     return use_pallas
 
 
+def resolve_use_fused(cfg: ExperimentConfig) -> bool:
+    """Resolve ``SimConfig.fused_slot``'s None auto-default.
+
+    The fused slot megakernel (ops/pallas_slot.py) runs the whole per-slot
+    env — obs build, tabular/DQN policy act, market clearing, battery +
+    thermal integration — as one Pallas kernel with VMEM-resident carries.
+    Auto (None) resolves to False: the unfused chain is the committed-seed
+    reference everywhere, and the megakernel's TPU capture is still
+    measurement debt (ROADMAP), so fusion is an explicit opt-in
+    (``fused_slot=True``, or the ``fused=`` flag on ``run_episode`` /
+    ``make_shared_episode_fn`` / the scenario trainers). Requesting it for
+    an unsupported configuration fails loudly here rather than at trace
+    time."""
+    f = cfg.sim.fused_slot
+    if f is None or not f:
+        return False
+    if cfg.train.implementation not in ("tabular", "dqn"):
+        raise ValueError(
+            "fused_slot=True supports tabular/dqn policies only (ddpg "
+            f"advances OU state inside act), got "
+            f"{cfg.train.implementation!r}"
+        )
+    return True
+
+
 # Smallest community size at which the auto market dtype compresses to
 # bfloat16: below it the [S, A, A] stream is not the traffic that matters
 # and f32 keeps bit-compat with the jnp reference path.
@@ -483,10 +508,17 @@ def slot_dynamics_batched(
     settlement_hook=None,
     act_fn=None,
     explore_state=None,
+    fused: bool = False,
 ):
     """Scenario-batched slot dynamics: same semantics as ``slot_dynamics``
     but with an explicit leading scenario axis on all simulation state
     (leaves [S, ...]; policy parameters shared).
+
+    ``fused=True`` routes the whole slot through the Pallas megakernel
+    (ops/pallas_slot.py::slot_step_fused) — one kernel instead of the op
+    chain, same-seed bit-exact on the interpret-mode CPU path for
+    tabular/dqn. Incompatible with ``settlement_hook``/``act_fn`` (the
+    kernel owns settlement and the policy act).
 
     Written for the shared-parameter trainer (parallel/scenarios.py): the
     matrix passes run once over [S, A, A] — via broadcasting jnp ops, or the
@@ -508,6 +540,29 @@ def slot_dynamics_batched(
 
     Returns (phys', pol_state, outputs, transition, explore_state').
     """
+    if fused:
+        if settlement_hook is not None or act_fn is not None:
+            raise ValueError(
+                "fused slot dynamics cannot take settlement_hook/act_fn "
+                "overrides — the megakernel owns settlement and the policy "
+                "act (use fused=False for multi-community/ddpg paths)"
+            )
+        from p2pmicrogrid_tpu.ops.pallas_slot import slot_step_fused
+
+        market_impl_f = resolve_market_impl(cfg) if cfg.sim.trading else "matrix"
+        f_dtype = (
+            jnp.bfloat16
+            if cfg.sim.trading
+            and market_impl_f == "factored"
+            and resolve_market_dtype(cfg) == "bfloat16"
+            else None
+        )
+        phys_f, outputs_f, tr_f = slot_step_fused(
+            cfg, pol_state, phys_s, xs, key, ratings, explore,
+            market_impl=market_impl_f, compute_dtype=f_dtype,
+        )
+        return phys_f, pol_state, outputs_f, tr_f, explore_state
+
     time_s, t_out_s, load_w, pv_w, next_time_s, next_load_w, next_pv_w = xs
     n_scenarios = load_w.shape[0]
     th = cfg.thermal
@@ -742,15 +797,25 @@ def community_slot(
     xs,
     training: bool,
     ratings: AgentRatings,
+    fused: bool = False,
 ):
     """One 15-minute slot: negotiate -> clear -> settle -> learn -> step assets
-    (community.py:149-170)."""
+    (community.py:149-170). ``fused=True`` replaces the slot-dynamics op
+    chain with the Pallas megakernel (ops/pallas_slot.py) — learning stays
+    outside either way."""
     phys, pol_state, key = carry
     key, k_round, k_learn = jax.random.split(key, 3)
 
-    phys, pol_state, outputs, tr = slot_dynamics(
-        cfg, policy, pol_state, phys, xs, k_round, ratings, explore=training
-    )
+    if fused:
+        from p2pmicrogrid_tpu.ops.pallas_slot import slot_step_fused_single
+
+        phys, outputs, tr = slot_step_fused_single(
+            cfg, pol_state, phys, xs, k_round, ratings, explore=training
+        )
+    else:
+        phys, pol_state, outputs, tr = slot_dynamics(
+            cfg, policy, pol_state, phys, xs, k_round, ratings, explore=training
+        )
 
     if training:
         pol_state, loss = policy.learn(
@@ -771,6 +836,7 @@ def run_episode(
     key: jax.Array,
     training: bool = True,
     collect_device_metrics: bool = False,
+    fused: "bool | None" = None,
 ) -> Tuple[PhysState, object, SlotOutputs]:
     """One full episode as a single ``lax.scan`` (community.py:149-182 for
     training, :95-123 for greedy evaluation).
@@ -780,7 +846,16 @@ def run_episode(
     ``telemetry.DeviceCounters`` total rides the scan carry — per-slot NaN/
     comfort/market counters accumulated in-program and reduced once per
     device call — and a 4th element is returned (the episode-total counters).
+
+    ``fused`` selects the Pallas slot megakernel (ops/pallas_slot.py) for
+    every slot of the scan; ``None`` resolves ``SimConfig.fused_slot``
+    (``resolve_use_fused`` — off by default, tabular/dqn only).
     """
+    use_fused = resolve_use_fused(cfg) if fused is None else bool(fused)
+    if use_fused and cfg.train.implementation not in ("tabular", "dqn"):
+        raise ValueError(
+            "run_episode(fused=True) supports tabular/dqn policies only"
+        )
     xs = (
         arrays.time,
         arrays.t_out,
@@ -803,7 +878,9 @@ def run_episode(
     # pytree) when disabled, so the program is unchanged.
     def step(carry, x):
         inner, dc = carry
-        inner, outputs = community_slot(cfg, policy, inner, x, training, ratings)
+        inner, outputs = community_slot(
+            cfg, policy, inner, x, training, ratings, fused=use_fused
+        )
         if collect_device_metrics:
             dc = dc_add(dc, dc_from_slot(cfg, outputs))
         return (inner, dc), outputs

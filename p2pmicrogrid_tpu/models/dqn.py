@@ -72,20 +72,33 @@ def dqn_init(cfg: DQNConfig, n_agents: int, key: jax.Array) -> DQNState:
     )
 
 
+def _q_all_actions_for(
+    action_values: jnp.ndarray, cfg: DQNConfig, params, obs: jnp.ndarray
+) -> jnp.ndarray:
+    """``_q_all_actions`` with the enumerated action column passed in.
+
+    The fused slot megakernel (ops/pallas_slot.py) traces this forward
+    INSIDE a Pallas kernel, where the module-level ``ACTION_VALUES``
+    constant cannot be captured — it rides in as a kernel operand instead.
+    One source for the enumeration forward either way.
+    """
+    net = QNetwork(hidden=cfg.hidden)
+
+    def one(p, o):
+        s = jnp.broadcast_to(o, (action_values.shape[0], OBS_DIM))
+        a = action_values[:, None]
+        return net.apply({"params": p}, s, a)[:, 0]
+
+    return jax.vmap(one)(params, obs)
+
+
 def _q_all_actions(cfg: DQNConfig, params, obs: jnp.ndarray) -> jnp.ndarray:
     """Q-values of the 3 discrete actions for each agent.
 
     params: per-agent pytree [A, ...]; obs: [A, 4] -> [A, 3].
     (The action-enumeration argmax of rl.py:186-194.)
     """
-    net = QNetwork(hidden=cfg.hidden)
-
-    def one(p, o):
-        s = jnp.broadcast_to(o, (ACTION_VALUES.shape[0], OBS_DIM))
-        a = ACTION_VALUES[:, None]
-        return net.apply({"params": p}, s, a)[:, 0]
-
-    return jax.vmap(one)(params, obs)
+    return _q_all_actions_for(ACTION_VALUES, cfg, params, obs)
 
 
 def dqn_act(

@@ -31,11 +31,18 @@ def make_observation(
     )
 
 
-def discretize(cfg: QLearningConfig, obs: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
-    """Map a [..., 4] observation to Q-table indices (rl.py:89-95).
+def discretize_features(
+    cfg: QLearningConfig,
+    time_norm: jnp.ndarray,
+    norm_temp: jnp.ndarray,
+    balance: jnp.ndarray,
+    p2p_mean: jnp.ndarray,
+) -> Tuple[jnp.ndarray, ...]:
+    """``discretize`` on the four UNSTACKED feature arrays.
 
-    The reference uses Python ``int()`` (truncation toward zero) then clamps;
-    ``astype(int32)`` matches the truncation semantics exactly.
+    Single source of the binning arithmetic: the fused slot megakernel
+    (ops/pallas_slot.py) carries the features as separate VMEM vectors and
+    must bin them bit-identically to the stacked-observation path.
     """
     nt, ntp, nb, np_ = (
         cfg.num_time_states,
@@ -43,10 +50,21 @@ def discretize(cfg: QLearningConfig, obs: jnp.ndarray) -> Tuple[jnp.ndarray, ...
         cfg.num_balance_states,
         cfg.num_p2p_states,
     )
-    time_i = jnp.clip((obs[..., 0] * nt).astype(jnp.int32), 0, nt - 1)
+    time_i = jnp.clip((time_norm * nt).astype(jnp.int32), 0, nt - 1)
     temp_i = jnp.clip(
-        ((obs[..., 1] + 1.0) / 2.0 * (ntp - 2) + 1.0).astype(jnp.int32), 0, ntp - 1
+        ((norm_temp + 1.0) / 2.0 * (ntp - 2) + 1.0).astype(jnp.int32), 0, ntp - 1
     )
-    bal_i = jnp.clip(((obs[..., 2] + 1.0) / 2.0 * nb).astype(jnp.int32), 0, nb - 1)
-    p2p_i = jnp.clip(((obs[..., 3] + 1.0) / 2.0 * np_).astype(jnp.int32), 0, np_ - 1)
+    bal_i = jnp.clip(((balance + 1.0) / 2.0 * nb).astype(jnp.int32), 0, nb - 1)
+    p2p_i = jnp.clip(((p2p_mean + 1.0) / 2.0 * np_).astype(jnp.int32), 0, np_ - 1)
     return time_i, temp_i, bal_i, p2p_i
+
+
+def discretize(cfg: QLearningConfig, obs: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """Map a [..., 4] observation to Q-table indices (rl.py:89-95).
+
+    The reference uses Python ``int()`` (truncation toward zero) then clamps;
+    ``astype(int32)`` matches the truncation semantics exactly.
+    """
+    return discretize_features(
+        cfg, obs[..., 0], obs[..., 1], obs[..., 2], obs[..., 3]
+    )

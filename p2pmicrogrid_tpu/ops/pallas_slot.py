@@ -1,0 +1,598 @@
+"""Fused per-slot Pallas megakernel: the whole training-slot env in ONE kernel.
+
+The per-slot hot path is a CHAIN of small ops — obs build (ops/obs.py),
+policy greedy/explore, market clearing (midpoint matrix and factored
+variants), settlement, comfort/reward, battery and thermal 2R2C integration
+(ops/battery.py, ops/thermal.py). Compiled separately, each link is its own
+XLA fusion that re-touches HBM: the committed device profiles name the cost
+precisely — ``artifacts/SLOT_PROFILE_r05.json`` shows the north-star slot
+spending 610 us across the chain (242 us alone in the factored-market
+reduce), and ``artifacts/ROOFLINE_cfg5_r05.json`` shows the multi-community
+episode dominated by dozens of ~6 us loop fusions each re-reading state that
+a resident kernel would keep in VMEM.
+
+``slot_step_fused`` runs the full slot as one ``pallas_call``: the physical
+carries (t_in, t_bm, soc, hp_frac) are loaded into VMEM once, every
+negotiation round's observation features, policy decision and proposal
+arithmetic stay resident, the clearing (factored rank-1 min pass or the
+midpoint matrix matching) runs on the in-VMEM values, and the slot's
+settlement + thermal/battery integration write the carries back exactly
+once. It is a drop-in for the unfused op chain:
+
+* ``envs/community.py::slot_dynamics_batched(fused=True)`` — the
+  scenario-batched training path (``make_shared_episode_fn(fused=...)``).
+* ``envs/community.py::run_episode(fused=True)`` — the single-scenario
+  path, via ``slot_step_fused_single``.
+
+Exactness contract (tests/test_pallas_slot.py): on the interpret-mode CPU
+path the fused slot is SAME-SEED BIT-EXACT vs the existing op chain for
+tabular and DQN policies, across the factored, matrix and no-trading
+variants, because every piece of arithmetic is the SAME function the chain
+calls (grid_prices, battery_rule_update, discretize_features, _q_all_actions,
+clear_factored_rounds{0,1}, zero_diagonal/divide_power/clear_market,
+comfort_penalty, thermal_step) restaged inside the kernel body, and the
+exploration draws are precomputed OUTSIDE the kernel with the chain's exact
+key structure (``jax.random`` is never called in-kernel). Two policy-specific
+moves keep the kernel gather-free (Mosaic has no general dynamic gather):
+
+* tabular — the Q-rows for the slot's (time, temp, balance) bins are
+  pre-gathered by XLA into a ``[S, A, n_p2p, n_actions]`` operand (those
+  three bins are fixed at slot start; only the p2p bin moves between
+  negotiation rounds), and the per-round p2p-bin select is a one-hot
+  reduction in VMEM — exact value copies.
+* dqn — the per-agent online Q-networks ride in as whole-array operands and
+  the forward pass (``models/dqn.py::_q_all_actions``) is traced INSIDE the
+  kernel, identically to the chain's vmapped call.
+
+DDPG is not supported fused (its exploration state advances inside act);
+``envs/community.py::resolve_use_fused`` refuses it. On non-TPU backends the
+kernel runs in interpreter mode (slow but exact) — the same pattern as
+ops/pallas_market.py — so CPU tier-1 stays bit-exact; the TPU capture is
+recorded as measurement debt in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.models.dqn import _q_all_actions_for
+from p2pmicrogrid_tpu.ops.battery import battery_rule_update
+from p2pmicrogrid_tpu.ops.factored_market import (
+    clear_factored_rounds0,
+    clear_factored_rounds1,
+)
+from p2pmicrogrid_tpu.ops.market import (
+    clear_market,
+    compute_costs,
+    divide_power,
+    zero_diagonal,
+)
+from p2pmicrogrid_tpu.ops.obs import discretize_features, make_observation
+from p2pmicrogrid_tpu.ops.tariff import grid_prices, p2p_price as p2p_price_fn
+from p2pmicrogrid_tpu.ops.thermal import (
+    comfort_penalty,
+    normalized_temperature,
+    thermal_step,
+)
+
+# Mirrors ops/pallas_market.py's VMEM accounting: the kernel holds a handful
+# of [SB, A, A] temporaries (matrix clearing) or the factored min pass's
+# broadcast blocks in VMEM at once; SB is sized so they fit the raised
+# scoped-VMEM limit.
+_VMEM_BUDGET = 96 * 1024 * 1024
+_VMEM_LIMIT = 110 * 1024 * 1024
+_MAX_BLOCK_S = 8
+
+# Discrete heat-pump action values (models/dqn.py ACTION_VALUES) — inlined as
+# Python floats so the in-kernel select needs no constant operand.
+_ACTION_VALUES = (0.0, 0.5, 1.0)
+
+
+def _interpret() -> bool:
+    # P2P_DISABLE_PALLAS pins Mosaic lowering off, same contract as
+    # envs/community.py::resolve_use_pallas: the benchmark suite's host-CPU
+    # retry runs under ``jax.default_device(cpu)``, which places arrays on
+    # the host while ``default_backend()`` still reports "tpu". The fused
+    # slot has no jnp fallback, so its escape hatch is the interpreter.
+    import os
+
+    if os.environ.get("P2P_DISABLE_PALLAS", "") not in ("", "0"):
+        return True
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params():
+    # jax renamed TPUCompilerParams -> CompilerParams across releases; accept
+    # both so the kernel builds against either (same pattern would apply to
+    # ops/pallas_market.py's pinned name on newer jax).
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(vmem_limit_bytes=_VMEM_LIMIT)
+
+
+def _block(s: int, a: int, slabs_aa: int, extra_scenario_bytes: int,
+           fixed_bytes: int) -> int:
+    """Scenario-block size: [SB, A, A] slabs + per-scenario extras must fit
+    the VMEM budget after the block-invariant operands (DQN params)."""
+    budget = max(_VMEM_BUDGET - fixed_bytes, 1)
+    slab = max(slabs_aa * a * a * 4 + extra_scenario_bytes, 1)
+    b = max(1, min(_MAX_BLOCK_S, s, budget // slab))
+    while s % b:
+        b -= 1
+    return b
+
+
+class _FusedSpec(NamedTuple):
+    """Static kernel configuration (closure state of the kernel body)."""
+
+    impl: str             # 'tabular' | 'dqn'
+    trading: bool
+    market_impl: str      # 'factored' | 'matrix' (ignored when not trading)
+    n_rounds: int         # rounds + 1 decision passes (1 when not trading)
+    explore: bool
+    a: int
+    compute_dtype: object  # factored clearing narrow dtype (None = f32)
+
+
+def _select_action_value(action: jnp.ndarray) -> jnp.ndarray:
+    """ACTION_VALUES[action] as an exact, gather-free select."""
+    out = jnp.full(action.shape, _ACTION_VALUES[-1], dtype=jnp.float32)
+    for j in range(len(_ACTION_VALUES) - 2, -1, -1):
+        out = jnp.where(action == j, jnp.float32(_ACTION_VALUES[j]), out)
+    return out
+
+
+def _greedy_from_rows(rows: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(argmax index int32, greedy value) — one-hot select, gather-free.
+
+    ``jnp.argmax`` keeps the chain's first-occurrence tie rule; the value
+    select copies the winning entry exactly (the other lanes contribute
+    true zeros)."""
+    greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+    acts = jax.lax.broadcasted_iota(jnp.int32, rows.shape, rows.ndim - 1)
+    greedy_q = jnp.sum(
+        jnp.where(acts == greedy[..., None], rows, 0.0), axis=-1
+    )
+    return greedy, greedy_q
+
+
+def _make_kernel(cfg: ExperimentConfig, spec: _FusedSpec, dqn_treedef=None):
+    """Build the kernel body. Ref layout (all VMEM):
+
+    inputs:  time [SB,1,1], t_out [SB,1,1], load [SB,1,A], pv [SB,1,A],
+             t_in [SB,1,A], t_bm [SB,1,A], soc [SB,1,A], hp_frac [SB,1,A],
+             max_in [1,1,A],
+             (explore) mask [SB,R,A] f32, rand [SB,R,A] int32,
+             (tabular) qrows [SB, A, NP*NACT],
+             (dqn) online-param leaves (whole arrays).
+    outputs: t_in', t_bm', soc', hp', cost, reward, p_grid, p_p2p, q, aux,
+             f_time, f_temp, f_bal, f_p2p  (each [SB,1,A]),
+             decisions [SB, R, A].
+    """
+    th = cfg.thermal
+    qcfg = cfg.qlearning
+    A = spec.a
+    R = spec.n_rounds
+    n_fixed_in = 9
+    n_rand = 2 if spec.explore else 0
+
+    def kernel(*refs):
+        time = refs[0][:, 0, 0]        # [SB]
+        t_out = refs[1][:, 0, 0]
+        load_w = refs[2][:, 0, :]      # [SB, A]
+        pv_w = refs[3][:, 0, :]
+        t_in = refs[4][:, 0, :]
+        t_bm = refs[5][:, 0, :]
+        soc = refs[6][:, 0, :]
+        hp_frac0 = refs[7][:, 0, :]
+        max_in = refs[8][0, 0, :]      # [A]
+        if spec.explore:
+            mask_all = refs[n_fixed_in][:]      # [SB, R, A] f32
+            rand_all = refs[n_fixed_in + 1][:]  # [SB, R, A] int32
+        pol0 = n_fixed_in + n_rand
+        if spec.impl == "tabular":
+            qrows = refs[pol0][:].reshape(
+                (-1, A, qcfg.num_p2p_states, qcfg.num_actions)
+            )
+            n_pol = 1
+        else:
+            av = refs[pol0][0, 0, :]  # enumerated action column [3]
+            leaves = [
+                refs[pol0 + 1 + i][:] for i in range(dqn_treedef.num_leaves)
+            ]
+            dqn_params = jax.tree_util.tree_unflatten(dqn_treedef, leaves)
+            n_pol = 1 + dqn_treedef.num_leaves
+        out0 = pol0 + n_pol
+
+        buy, inj = grid_prices(cfg.tariff, time)          # [SB]
+        trade = p2p_price_fn(buy, inj)
+
+        balance_w = load_w - pv_w
+        if cfg.battery.enabled:
+            soc, balance_w = battery_rule_update(
+                cfg.battery, soc, balance_w, cfg.sim.dt_seconds
+            )
+        norm_balance = balance_w / max_in[None, :]
+        norm_temp = normalized_temperature(th, t_in)
+        f_time = jnp.broadcast_to(time[:, None], balance_w.shape)
+
+        def act(p2p_feat, r):
+            """One decision pass: (hp_frac, aux f32, q) — the chain's
+            tabular_act / dqn_act restaged on the resident features."""
+            if spec.impl == "tabular":
+                _, _, _, pi = discretize_features(
+                    qcfg, f_time, norm_temp, norm_balance, p2p_feat
+                )
+                bins = jax.lax.broadcasted_iota(
+                    jnp.int32, (1, 1, qcfg.num_p2p_states, 1), 2
+                )
+                rows = jnp.sum(
+                    jnp.where(bins == pi[:, :, None, None], qrows, 0.0),
+                    axis=2,
+                )  # [SB, A, NACT]
+            else:
+                obs = jnp.stack(
+                    jnp.broadcast_arrays(
+                        f_time, norm_temp, norm_balance, p2p_feat
+                    ),
+                    axis=-1,
+                )  # [SB, A, 4]
+                rows = jax.vmap(
+                    lambda o: _q_all_actions_for(av, cfg.dqn, dqn_params, o)
+                )(obs)
+            greedy, greedy_q = _greedy_from_rows(rows)
+            if spec.explore:
+                m = mask_all[:, r, :] > 0.0
+                action = jnp.where(m, rand_all[:, r, :], greedy)
+                qv = jnp.where(m, 0.0, greedy_q)
+            else:
+                action, qv = greedy, greedy_q
+            return _select_action_value(action), action.astype(jnp.float32), qv
+
+        hp_power_l = []
+        if not spec.trading:
+            feat = jnp.zeros_like(norm_balance)
+            frac, aux, qv = act(feat, 0)
+            hp_power_l.append(frac * th.hp_max_power)
+            p_grid = balance_w + frac * th.hp_max_power
+            p_p2p = jnp.zeros_like(p_grid)
+        elif spec.market_impl == "factored":
+            feat = jnp.zeros_like(balance_w)
+            frac, aux, qv = act(feat, 0)
+            hp_power_l.append(frac * th.hp_max_power)
+            out_power = balance_w + frac * th.hp_max_power
+            if R == 1:
+                p_grid, p_p2p = clear_factored_rounds0(
+                    out_power, compute_dtype=spec.compute_dtype
+                )
+            else:
+                tot = jnp.sum(out_power, axis=-1, keepdims=True)
+                mean_raw = -(tot - out_power) / (A * A)
+                feat = mean_raw / max_in[None, :]
+                frac, aux, qv = act(feat, 1)
+                hp_power_l.append(frac * th.hp_max_power)
+                out1 = balance_w + frac * th.hp_max_power
+                p_grid, p_p2p = clear_factored_rounds1(
+                    out_power, out1, compute_dtype=spec.compute_dtype
+                )
+        else:
+            sb = balance_w.shape[0]
+            p2p = jnp.zeros((sb, A, A))
+            frac = hp_frac0
+            feat = aux = qv = None
+            for r in range(R):
+                p2p = zero_diagonal(p2p)
+                powers = -jnp.swapaxes(p2p, -1, -2)
+                feat = jnp.mean(powers, axis=-1) / max_in[None, :]
+                frac, aux, qv = act(feat, r)
+                hp_power_l.append(frac * th.hp_max_power)
+                out_power = balance_w + frac * th.hp_max_power
+                p2p = divide_power(out_power, powers)
+            p_grid, p_p2p = clear_market(p2p)
+
+        cost = compute_costs(
+            p_grid, p_p2p, buy[:, None], inj[:, None], trade[:, None],
+            cfg.sim.slot_hours,
+        )
+        penalty = comfort_penalty(th, t_in)
+        reward = -(cost + 10.0 * penalty)
+        hp_power = frac * th.hp_max_power
+        t_in_new, t_bm_new = thermal_step(
+            th, cfg.sim.dt_seconds, t_out[:, None], t_in, t_bm, hp_power
+        )
+
+        for i, val in enumerate(
+            (t_in_new, t_bm_new, soc, frac, cost, reward, p_grid, p_p2p,
+             qv, aux, f_time, norm_temp, norm_balance, feat)
+        ):
+            refs[out0 + i][:] = val[:, None, :]
+        refs[out0 + 14][:] = jnp.stack(hp_power_l, axis=1)  # [SB, R, A]
+
+    return kernel
+
+
+def _chain_explore_draws(
+    impl: str,
+    cfg: ExperimentConfig,
+    key: jax.Array,
+    epsilon: jnp.ndarray,
+    n_rounds: int,
+    s: int,
+    a: int,
+    trading: bool,
+    batched: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exploration draws with the op chain's EXACT key structure.
+
+    Returns (mask [S, R, A] f32 — ``uniform < epsilon`` —, rand [S, R, A]
+    int32). The chain splits ``key`` into one key per negotiation round
+    (trading) or uses it directly (single decision pass), then — on the
+    batched path only — splits per scenario before each policy act's
+    ``k_mask, k_rand = split(key)`` (models/tabular.py::tabular_act,
+    models/dqn.py::dqn_act). Replicating those calls verbatim outside the
+    kernel is what makes the fused slot same-seed bit-exact."""
+    n_actions = (
+        cfg.qlearning.num_actions if impl == "tabular" else len(_ACTION_VALUES)
+    )
+    round_keys = jax.random.split(key, n_rounds) if trading else key[None]
+
+    def one(k):
+        k_mask, k_rand = jax.random.split(k)
+        rand = jax.random.randint(k_rand, (a,), 0, n_actions, dtype=jnp.int32)
+        u = jax.random.uniform(k_mask, (a,))
+        return u, rand
+
+    def per_round(rk):
+        if batched:
+            return jax.vmap(one)(jax.random.split(rk, s))  # [S, A] each
+        u, rand = one(rk)
+        return u[None], rand[None]
+
+    us, rands = zip(*(per_round(round_keys[r]) for r in range(n_rounds)))
+    u = jnp.stack(us, axis=1)       # [S, R, A]
+    rand = jnp.stack(rands, axis=1)
+    mask = (u < epsilon).astype(jnp.float32)
+    return mask, rand
+
+
+def _tabular_pregather(cfg, q_table, time_s, t_in, balance_w, ratings_max_in):
+    """[S, A, NP, NACT] Q-rows for the slot's fixed (time, temp, balance)
+    bins, all p2p bins — the slot-start gather XLA runs so the kernel's
+    per-round bin select is a pure one-hot reduction."""
+    qcfg = cfg.qlearning
+    a = q_table.shape[0]
+    f_time = jnp.broadcast_to(time_s[:, None], balance_w.shape)
+    ti, tpi, bi, _ = discretize_features(
+        qcfg,
+        f_time,
+        normalized_temperature(cfg.thermal, t_in),
+        balance_w / ratings_max_in,
+        jnp.zeros_like(balance_w),
+    )
+    return q_table[jnp.arange(a)[None, :], ti, tpi, bi]
+
+
+def slot_step_fused(
+    cfg: ExperimentConfig,
+    pol_state,
+    phys_s,
+    xs,
+    key: jax.Array,
+    ratings,
+    explore: bool,
+    market_impl: Optional[str] = None,
+    compute_dtype=None,
+    batched_keys: bool = True,
+):
+    """One fused training slot over a scenario batch.
+
+    Drop-in for the no-hook ``slot_dynamics_batched`` body (learning stays
+    outside — it consumes the returned transition): ``xs`` is the usual
+    7-tuple of slot inputs with leading scenario axis, ``phys_s`` the
+    [S, A] physical carries. Returns ``(phys', outputs, transition)``
+    exactly shaped like the unfused path's.
+
+    ``market_impl`` must be the RESOLVED implementation ('factored' |
+    'matrix'); ``compute_dtype`` is the factored clearing's narrow dtype
+    (the resolved market_dtype, None = f32). ``batched_keys`` selects the
+    scenario-batched key structure (split per scenario inside each round —
+    the slot_dynamics_batched contract); False keeps the single-scenario
+    chain's structure for ``slot_step_fused_single``.
+    """
+    impl = cfg.train.implementation
+    if impl not in ("tabular", "dqn"):
+        raise ValueError(
+            f"slot_step_fused supports tabular/dqn policies, got {impl!r} "
+            "(ddpg advances exploration state inside act — unfused only)"
+        )
+    time_s, t_out_s, load_w, pv_w, next_time_s, next_load_w, next_pv_w = xs
+    s, a = load_w.shape
+    th = cfg.thermal
+    trading = cfg.sim.trading
+    if market_impl is None:
+        market_impl = "matrix"
+    n_rounds = (cfg.sim.rounds + 1) if trading else 1
+    max_in = jnp.asarray(ratings.max_in)
+
+    spec = _FusedSpec(
+        impl=impl,
+        trading=trading,
+        market_impl=market_impl,
+        n_rounds=n_rounds,
+        explore=bool(explore),
+        a=a,
+        compute_dtype=compute_dtype,
+    )
+
+    # --- XLA-side prep: exploration draws, policy operands ------------------
+    epsilon = pol_state.epsilon
+    operands = [
+        time_s.reshape(s, 1, 1),
+        t_out_s.reshape(s, 1, 1),
+        load_w[:, None, :],
+        pv_w[:, None, :],
+        phys_s.t_in[:, None, :],
+        phys_s.t_bm[:, None, :],
+        phys_s.soc[:, None, :],
+        phys_s.hp_frac[:, None, :],
+        max_in[None, None, :],
+    ]
+    if explore:
+        mask, rand = _chain_explore_draws(
+            impl, cfg, key, epsilon, n_rounds, s, a, trading, batched_keys
+        )
+        operands += [mask, rand]
+
+    dqn_treedef = None
+    fixed_bytes = 0
+    extra_scenario = 0
+    if impl == "tabular":
+        # The gather runs the chain's own battery/feature arithmetic so the
+        # pre-gathered rows bin identically to the in-kernel features.
+        balance_pre = load_w - pv_w
+        if cfg.battery.enabled:
+            _, balance_pre = battery_rule_update(
+                cfg.battery, phys_s.soc, balance_pre, cfg.sim.dt_seconds
+            )
+        qrows = _tabular_pregather(
+            cfg, pol_state.q_table, time_s, phys_s.t_in, balance_pre, max_in
+        )
+        npa = cfg.qlearning.num_p2p_states * cfg.qlearning.num_actions
+        operands.append(qrows.reshape(s, a, npa))
+        extra_scenario = a * npa * 4
+    else:
+        leaves, dqn_treedef = jax.tree_util.tree_flatten(pol_state.online)
+        av = jnp.asarray(_ACTION_VALUES, dtype=jnp.float32)
+        operands += [av[None, None, :]] + leaves
+        fixed_bytes = sum(l.size * 4 for l in leaves)
+
+    slabs_aa = 0
+    if trading:
+        slabs_aa = 8 if market_impl == "matrix" else 6
+    sb = _block(s, a, slabs_aa, extra_scenario + 32 * a * 4, fixed_bytes)
+
+    def _spec3(shape_tail, blocked=True):
+        if blocked:
+            return pl.BlockSpec(
+                (sb,) + shape_tail, lambda i: (i,) + (0,) * len(shape_tail),
+                memory_space=pltpu.VMEM,
+            )
+        return pl.BlockSpec(
+            shape_tail, lambda i: (0,) * len(shape_tail),
+            memory_space=pltpu.VMEM,
+        )
+
+    in_specs = [
+        _spec3((1, 1)), _spec3((1, 1)),
+        _spec3((1, a)), _spec3((1, a)),
+        _spec3((1, a)), _spec3((1, a)), _spec3((1, a)), _spec3((1, a)),
+        _spec3((1, 1, a), blocked=False),
+    ]
+    if explore:
+        in_specs += [_spec3((n_rounds, a)), _spec3((n_rounds, a))]
+    if impl == "tabular":
+        npa = cfg.qlearning.num_p2p_states * cfg.qlearning.num_actions
+        in_specs.append(_spec3((a, npa)))
+    else:
+        in_specs += [_spec3((1, 1, len(_ACTION_VALUES)), blocked=False)] + [
+            _spec3(l.shape, blocked=False)
+            for l in jax.tree_util.tree_leaves(pol_state.online)
+        ]
+
+    vec = jax.ShapeDtypeStruct((s, 1, a), jnp.float32)
+    out_shape = tuple([vec] * 14) + (
+        jax.ShapeDtypeStruct((s, n_rounds, a), jnp.float32),
+    )
+    out_specs = tuple([_spec3((1, a))] * 14) + (_spec3((n_rounds, a)),)
+
+    kernel = _make_kernel(cfg, spec, dqn_treedef)
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=(s // sb,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )(*operands)
+
+    (t_in_new, t_bm_new, soc_new, frac, cost, reward, p_grid, p_p2p, qv,
+     aux, f_time, f_temp, f_bal, f_p2p) = (o[:, 0, :] for o in outs[:14])
+    decisions = outs[14]  # [S, R, A]
+
+    # --- XLA-side assembly (same formulas as the chain) ---------------------
+    from p2pmicrogrid_tpu.envs.community import (  # local: avoids a cycle
+        PhysState,
+        SlotOutputs,
+        SlotTransition,
+    )
+
+    buy, inj = grid_prices(cfg.tariff, time_s)
+    trade = p2p_price_fn(buy, inj)
+    obs = make_observation(f_time, f_temp, f_bal, f_p2p)
+    next_temp = phys_s.t_in if cfg.sim.stale_next_temp else t_in_new
+    next_balance = (next_load_w - next_pv_w) / max_in
+    next_obs = make_observation(
+        next_time_s[:, None],
+        normalized_temperature(th, next_temp),
+        next_balance,
+        jnp.zeros_like(next_balance),
+    )
+
+    phys = PhysState(t_in=t_in_new, t_bm=t_bm_new, soc=soc_new, hp_frac=frac)
+    outputs = SlotOutputs(
+        cost=cost,
+        reward=reward,
+        loss=jnp.zeros_like(reward),
+        p_grid=p_grid,
+        p_p2p=p_p2p,
+        buy_price=buy,
+        injection_price=inj,
+        trade_price=trade,
+        t_in=phys_s.t_in,
+        hp_power_w=decisions[:, -1, :],
+        decisions=decisions,
+        q=qv,
+    )
+    transition = SlotTransition(obs=obs, aux=aux, reward=reward, next_obs=next_obs)
+    return phys, outputs, transition
+
+
+def slot_step_fused_single(
+    cfg: ExperimentConfig,
+    pol_state,
+    phys,
+    xs,
+    key: jax.Array,
+    ratings,
+    explore: bool,
+):
+    """Single-scenario fused slot: lifts the [A] state to a 1-scenario batch,
+    runs the megakernel with the SINGLE-scenario key structure (the chain's
+    ``_negotiate`` passes each round key straight into the policy act — no
+    per-scenario split) and the matrix midpoint clearing (the only market
+    the single-scenario chain implements), then squeezes. Drop-in for
+    ``slot_dynamics``' (phys', outputs, transition) contract."""
+    time_n, t_out, load_w, pv_w, next_time, next_load_w, next_pv_w = xs
+    from p2pmicrogrid_tpu.envs.community import PhysState
+
+    lift = lambda v: jnp.asarray(v)[None]
+    xs_b = (
+        jnp.reshape(time_n, (1,)),
+        jnp.reshape(t_out, (1,)),
+        lift(load_w), lift(pv_w),
+        jnp.reshape(next_time, (1,)),
+        lift(next_load_w), lift(next_pv_w),
+    )
+    phys_b = PhysState(*(lift(leaf) for leaf in phys))
+    phys1, outputs1, tr1 = slot_step_fused(
+        cfg, pol_state, phys_b, xs_b, key, ratings, explore,
+        market_impl="matrix", batched_keys=False,
+    )
+    squeeze = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+    return squeeze(phys1), squeeze(outputs1), squeeze(tr1)

@@ -39,6 +39,7 @@ from p2pmicrogrid_tpu.envs.community import (
     Policy,
     build_episode_arrays,
     init_physical,
+    resolve_use_fused,
     run_episode,
     slot_dynamics_batched,
 )
@@ -338,13 +339,16 @@ def make_independent_episode_fn(
     arrays_s: EpisodeArrays,
     ratings: AgentRatings,
     donate: bool = False,
+    fused: Optional[bool] = None,
 ) -> Callable:
     """Jitted: one training episode for each of S independent learners.
 
     Signature: (pol_state_s, key) -> (pol_state_s, (rewards [S], losses [S])).
     ``donate`` donates the carry: the S stacked learner states update in
     place (callers must not reuse a consumed ``pol_state_s`` — see the
-    README "Training pipeline" donation contract).
+    README "Training pipeline" donation contract). ``fused`` selects the
+    per-slot Pallas megakernel inside every scenario's episode
+    (``run_episode(fused=...)``; None resolves ``SimConfig.fused_slot``).
     """
     n_scenarios = arrays_s.time.shape[0]
 
@@ -356,7 +360,8 @@ def make_independent_episode_fn(
             k_phys, k_ep = jax.random.split(k)
             phys = init_physical(cfg, k_phys)
             _, pol_state, outputs = run_episode(
-                cfg, policy, pol_state, phys, arrays, ratings, k_ep, training=True
+                cfg, policy, pol_state, phys, arrays, ratings, k_ep,
+                training=True, fused=fused,
             )
             return pol_state, (
                 jnp.sum(jnp.mean(outputs.reward, axis=-1)),
@@ -383,6 +388,7 @@ def train_scenarios_independent(
     donate: Optional[bool] = None,
     telemetry=None,
     carry_sync: Optional[Callable[[int], bool]] = None,
+    fused: Optional[bool] = None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """S independent learners, one device program per episode.
 
@@ -401,7 +407,7 @@ def train_scenarios_independent(
         donate = pipeline and episode_fn is None
     if episode_fn is None:
         episode_fn = make_independent_episode_fn(
-            cfg, policy, arrays_s, ratings, donate=donate
+            cfg, policy, arrays_s, ratings, donate=donate, fused=fused
         )
     return _run_episode_loop(
         episode_fn,
@@ -846,8 +852,13 @@ def make_shared_episode_fn(
     n_scenarios: Optional[int] = None,
     collect_device_metrics: bool = False,
     donate: bool = False,
+    fused: Optional[bool] = None,
 ) -> Callable:
     """Jitted: one shared-parameter training episode over S scenarios.
+
+    ``fused`` routes every slot through the Pallas megakernel
+    (ops/pallas_slot.py, tabular/dqn only — bit-exact vs the chain on the
+    interpret-mode CPU path); ``None`` resolves ``SimConfig.fused_slot``.
 
     ``donate`` donates the ``(pol_state, scen_state)`` carry: the policy
     trees AND the per-scenario replay (multi-GB at the north star) update in
@@ -893,6 +904,17 @@ def make_shared_episode_fn(
         raise ValueError("pass exactly one of arrays_s or arrays_fn")
     if arrays_fn is not None and n_scenarios is None:
         raise ValueError("arrays_fn requires an explicit n_scenarios")
+    if fused is None:
+        fused = resolve_use_fused(cfg)
+    if fused and impl not in ("tabular", "dqn"):
+        raise ValueError(
+            f"fused episodes support tabular/dqn, got {impl!r}"
+        )
+    if fused and settlement_hook is not None:
+        raise ValueError(
+            "fused episodes cannot take a settlement_hook (the megakernel "
+            "owns settlement) — multi-community training stays unfused"
+        )
     if arrays_s is not None:
         n_scenarios = arrays_s.time.shape[0]
     # Pooled-batch lr rule (docstring of auto_scale_ddpg_lrs): the episode
@@ -923,6 +945,7 @@ def make_shared_episode_fn(
         phys_s, _, outputs_s, tr_s, ex = slot_dynamics_batched(
             cfg, policy, pol_state, phys_s, xs_t, k_act, ratings_j, explore=True,
             settlement_hook=settlement_hook, act_fn=act_fn, explore_state=ex,
+            fused=fused,
         )
 
         if impl == "tabular":
@@ -1024,6 +1047,7 @@ def train_scenarios_shared(
     donate: Optional[bool] = None,
     telemetry=None,
     carry_sync: Optional[Callable[[int], bool]] = None,
+    fused: Optional[bool] = None,
 ) -> Tuple[object, object, np.ndarray, np.ndarray, float]:
     """One shared learner over S scenarios: per slot, vmapped dynamics produce
     per-scenario transitions and a single averaged update is applied.
@@ -1049,7 +1073,7 @@ def train_scenarios_shared(
         donate = pipeline and episode_fn is None
     if episode_fn is None:
         episode_fn = make_shared_episode_fn(
-            cfg, policy, arrays_s, ratings, donate=donate
+            cfg, policy, arrays_s, ratings, donate=donate, fused=fused
         )
     carry, rewards, losses, seconds = _run_episode_loop(
         episode_fn,
@@ -1251,6 +1275,7 @@ def train_scenarios_chunked(
     carry_sync: Optional[Callable[[int], bool]] = None,
     drain=None,
     finalize: bool = True,
+    fused: Optional[bool] = None,
 ) -> Tuple[object, np.ndarray, np.ndarray, float]:
     """Aggregate-scenario training: ``n_chunks x cfg.sim.n_scenarios``
     Monte-Carlo scenarios per episode through ONE compiled chunk-size program.
@@ -1360,7 +1385,7 @@ def train_scenarios_chunked(
         )
         episode_fn = make_shared_episode_fn(
             cfg, policy, None, ratings, arrays_fn=arrays_fn, n_scenarios=S,
-            collect_device_metrics=collect,
+            collect_device_metrics=collect, fused=fused,
         )
         if cfg.train.implementation == "dqn" and cfg.dqn.warmup_passes > 0:
             # Per-chunk replay warmup (see make_chunked_episode_runner): a
@@ -1370,7 +1395,7 @@ def train_scenarios_chunked(
             # own warmup_fn/runner if it wants warmed chunks.
             warmup_fn = make_shared_episode_fn(
                 cfg, policy, None, ratings, arrays_fn=arrays_fn,
-                n_scenarios=S, record_only=True,
+                n_scenarios=S, record_only=True, fused=fused,
             )
     if donate is None:
         donate = pipeline and runner is None

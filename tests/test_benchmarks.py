@@ -56,6 +56,16 @@ def test_bench_registry_has_all_configs_and_headline_last():
     assert names[-1] == "northstar"
 
 
+def test_bench_registry_includes_rawspeed_rows():
+    from p2pmicrogrid_tpu.benchmarks import CPU_RETRYABLE
+
+    for name in ("slot_fused", "serve_quantized", "pipeline_depth"):
+        assert name in BENCHES
+        # All three are small enough to re-run on the host when the
+        # accelerator dies mid-suite.
+        assert name in CPU_RETRYABLE
+
+
 def test_numpy_baseline_is_jax_free(monkeypatch):
     """The baseline must stay measurable with the backend down: it may not
     dispatch a single JAX op (round-2 BENCH died inside its jnp.asarray)."""

@@ -1,0 +1,241 @@
+"""Fused slot megakernel parity (ops/pallas_slot.py, interpret mode on CPU).
+
+The acceptance contract of the raw-speed pass: ``slot_step_fused`` must be
+SAME-SEED BIT-EXACT vs the existing op chain for tabular AND dqn on the
+interpret-mode CPU path — slot-level (one ``slot_dynamics_batched`` call)
+and episode-level (the shared-scenario trainer end to end), across the
+factored, matrix and no-trading market variants, with and without the
+battery. Shapes are kept tiny: interpreter-mode Pallas pays per-call
+overhead, and the equivalence is shape-independent (all reductions are
+per-scenario).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    BatteryConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.envs.community import (
+    AgentRatings,
+    init_physical,
+    resolve_use_fused,
+    run_episode,
+    slot_dynamics_batched,
+)
+from p2pmicrogrid_tpu.parallel import (
+    init_shared_state,
+    make_scenario_traces,
+    stack_scenario_arrays,
+)
+from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+from p2pmicrogrid_tpu.train import init_policy_state, make_policy
+
+S, A, T = 4, 6, 8
+
+
+def _cfg(impl="tabular", **sim_kw):
+    sim = dict(n_agents=A, n_scenarios=S)
+    sim.update(sim_kw)
+    return default_config(
+        sim=SimConfig(**sim), train=TrainConfig(implementation=impl)
+    )
+
+
+def _setup(cfg, seed=0):
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    traces = make_scenario_traces(cfg, seed=seed)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    policy = make_policy(cfg)
+    ps, scen = init_shared_state(cfg, jax.random.PRNGKey(seed))
+    return ratings, ratings_j, arrays, policy, ps, scen
+
+
+def _slot_xs(arrays, t=0):
+    return (
+        arrays.time[:, t],
+        arrays.t_out[:, t],
+        arrays.load_w[:, t],
+        arrays.pv_w[:, t],
+        arrays.next_time[:, t],
+        arrays.next_load_w[:, t],
+        arrays.next_pv_w[:, t],
+    )
+
+
+def _rand_state(cfg, ps, seed=7):
+    """Perturb the learner state so argmaxes/ties are non-trivial (a
+    zero-init Q-table argmaxes to action 0 everywhere — too easy)."""
+    rng = np.random.default_rng(seed)
+    if cfg.train.implementation == "tabular":
+        q = rng.standard_normal(ps.q_table.shape).astype(np.float32) * 0.1
+        return ps._replace(q_table=jnp.asarray(q))
+    return ps
+
+
+def _assert_tree_equal(a, b, what):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"{what}: leaf {i}"
+        )
+
+
+def _slot_both(cfg, explore=True, seed=3, state_seed=7):
+    """One jitted slot through both paths. Jitted deliberately: the training
+    drivers always jit the slot, and the UNJITTED chain itself drifts ~1 ulp
+    from its own jitted form (XLA fusion differences) — the contract is
+    program-vs-program, not eager-vs-program."""
+    ratings, ratings_j, arrays, policy, ps, _ = _setup(cfg)
+    ps = _rand_state(cfg, ps, seed=state_seed)
+    phys = jax.vmap(lambda k: init_physical(cfg, k))(
+        jax.random.split(jax.random.PRNGKey(1), S)
+    )
+    xs = _slot_xs(arrays)
+    key = jax.random.PRNGKey(seed)
+
+    @jax.jit
+    def ref_fn(ps, phys, xs, key):
+        return slot_dynamics_batched(
+            cfg, policy, ps, phys, xs, key, ratings_j, explore=explore
+        )
+
+    @jax.jit
+    def fused_fn(ps, phys, xs, key):
+        return slot_dynamics_batched(
+            cfg, policy, ps, phys, xs, key, ratings_j, explore=explore,
+            fused=True,
+        )
+
+    return ref_fn(ps, phys, xs, key), fused_fn(ps, phys, xs, key)
+
+
+MARKET_VARIANTS = [
+    pytest.param({"market_impl": "factored"}, id="factored-r1"),
+    pytest.param({"market_impl": "factored", "rounds": 0}, id="factored-r0"),
+    pytest.param({"market_impl": "matrix"}, id="matrix-r1"),
+    pytest.param({"market_impl": "matrix", "rounds": 2}, id="matrix-r2"),
+    pytest.param({"trading": False}, id="no-trading"),
+]
+
+
+@pytest.mark.parametrize("impl", ["tabular", "dqn"])
+@pytest.mark.parametrize("sim_kw", MARKET_VARIANTS)
+def test_slot_fused_bit_exact(impl, sim_kw):
+    cfg = _cfg(impl, **sim_kw)
+    (phys_r, _, out_r, tr_r, _), (phys_f, _, out_f, tr_f, _) = _slot_both(cfg)
+    _assert_tree_equal(phys_r, phys_f, "phys")
+    _assert_tree_equal(out_r, out_f, "outputs")
+    _assert_tree_equal(tr_r, tr_f, "transition")
+
+
+@pytest.mark.parametrize("impl", ["tabular", "dqn"])
+def test_slot_fused_greedy_bit_exact(impl):
+    cfg = _cfg(impl, market_impl="factored")
+    (phys_r, _, out_r, tr_r, _), (phys_f, _, out_f, tr_f, _) = _slot_both(
+        cfg, explore=False
+    )
+    _assert_tree_equal(phys_r, phys_f, "phys")
+    _assert_tree_equal(out_r, out_f, "outputs")
+    _assert_tree_equal(tr_r, tr_f, "transition")
+
+
+def test_slot_fused_battery_bit_exact():
+    cfg = default_config(
+        sim=SimConfig(n_agents=A, n_scenarios=S, market_impl="factored"),
+        train=TrainConfig(implementation="tabular"),
+        battery=BatteryConfig(enabled=True),
+    )
+    ref, got = _slot_both(cfg)
+    _assert_tree_equal(ref[0], got[0], "phys")
+    _assert_tree_equal(ref[2], got[2], "outputs")
+    _assert_tree_equal(ref[3], got[3], "transition")
+
+
+@pytest.mark.parametrize("impl", ["tabular", "dqn"])
+@pytest.mark.parametrize(
+    "sim_kw",
+    [
+        pytest.param({"market_impl": "factored"}, id="factored"),
+        pytest.param({"market_impl": "matrix"}, id="matrix"),
+    ],
+)
+def test_episode_fused_bit_exact(impl, sim_kw):
+    """Full shared-scenario training episodes (acts + learning) fused vs
+    unfused: bit-identical final learner state, rewards and losses."""
+    cfg = _cfg(impl, **sim_kw)
+    ratings, _, arrays, policy, ps0, scen0 = _setup(cfg)
+    ps0 = _rand_state(cfg, ps0)
+    # Slice the day down to T slots: interpret-mode kernels pay per-call
+    # overhead and the equivalence is slot-count-independent.
+    arrays = jax.tree_util.tree_map(lambda x: x[:, :T], arrays)
+
+    finals = {}
+    for fused in (False, True):
+        fn = make_shared_episode_fn(
+            cfg, policy, arrays, ratings, fused=fused
+        )
+        carry = (ps0, scen0)
+        ys = None
+        for e in range(2):
+            carry, ys = fn(carry, jax.random.PRNGKey(100 + e))
+        finals[fused] = (carry, ys)
+    _assert_tree_equal(finals[False][0], finals[True][0], "final state")
+    _assert_tree_equal(finals[False][1], finals[True][1], "rewards/losses")
+
+
+def test_run_episode_fused_bit_exact():
+    """Single-scenario path: run_episode(fused=True) == the unfused chain
+    (the single-scenario key structure differs from the batched one — the
+    kernel must replicate it, not the batched split)."""
+    from p2pmicrogrid_tpu.data import synthetic_traces
+    from p2pmicrogrid_tpu.envs import build_episode_arrays
+
+    cfg = default_config(
+        sim=SimConfig(n_agents=A),
+        train=TrainConfig(implementation="tabular"),
+    )
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    traces = synthetic_traces(n_days=1, start_day=11).normalized()
+    arrays = build_episode_arrays(cfg, traces, ratings)
+    arrays = jax.tree_util.tree_map(lambda x: x[:T], arrays)
+    policy = make_policy(cfg)
+    ps = _rand_state(cfg, init_policy_state(cfg, jax.random.PRNGKey(0)))
+    phys = init_physical(cfg, jax.random.PRNGKey(2))
+    key = jax.random.PRNGKey(5)
+
+    ref = run_episode(cfg, policy, ps, phys, arrays, ratings, key, fused=False)
+    got = run_episode(cfg, policy, ps, phys, arrays, ratings, key, fused=True)
+    _assert_tree_equal(ref[0], got[0], "phys")
+    _assert_tree_equal(ref[1], got[1], "pol_state")
+    _assert_tree_equal(ref[2], got[2], "outputs")
+
+
+def test_fused_rejects_ddpg():
+    cfg = _cfg("ddpg")
+    with pytest.raises(ValueError, match="tabular/dqn"):
+        make_shared_episode_fn(
+            cfg, make_policy(cfg), None, make_ratings(cfg, np.random.default_rng(0)),
+            arrays_fn=lambda k: None, n_scenarios=S, fused=True,
+        )
+    cfg2 = dataclasses.replace(cfg, sim=dataclasses.replace(cfg.sim, fused_slot=True))
+    with pytest.raises(ValueError, match="tabular/dqn"):
+        resolve_use_fused(cfg2)
+
+
+def test_resolve_use_fused_default_off():
+    assert resolve_use_fused(_cfg("tabular")) is False
+    cfg = _cfg("tabular")
+    cfg = dataclasses.replace(cfg, sim=dataclasses.replace(cfg.sim, fused_slot=True))
+    assert resolve_use_fused(cfg) is True

@@ -589,6 +589,48 @@ class TestServePlacement:
         assert ratio == 3.0 and "A=100" in source
         assert serve_cpu_advantage("tabular", 2, 1, art) is None
 
+    def test_committed_serve_crossover_capture_loads(self):
+        """ISSUE 12 satellite: the committed CROSSOVER_SERVE capture gives
+        the loader (live since the gateway round) a real non-empty table."""
+        from p2pmicrogrid_tpu.train.placement import (
+            load_serve_crossover,
+            serve_cpu_advantage,
+        )
+
+        table = load_serve_crossover()
+        assert table, "artifacts/CROSSOVER_SERVE_*.json should be committed"
+        measured = serve_cpu_advantage("tabular", 10, 8)
+        assert measured is not None
+        ratio, source = measured
+        assert ratio > 0 and "measured at" in source
+
+    def test_host_only_capture_ignored_on_accelerator(self, tmp_path):
+        """A capture measured WITHOUT an accelerator (accelerator: false)
+        must not decide placement on an accelerator host — its ratios
+        measured CPU-vs-CPU; the honest fallbacks apply instead."""
+        import json
+
+        from p2pmicrogrid_tpu.train.placement import (
+            pick_serve_device,
+            serve_crossover_is_host_only,
+        )
+
+        doc = {
+            "kind": "serve_crossover", "accelerator": False,
+            "rows": [
+                {"implementation": "tabular", "n_agents": 2, "max_batch": 64,
+                 "tpu_over_cpu": 1.0},
+            ],
+        }
+        (tmp_path / "CROSSOVER_SERVE_r98.json").write_text(json.dumps(doc))
+        art = str(tmp_path)
+        assert serve_crossover_is_host_only(art) is True
+        dev, reason = pick_serve_device(
+            "tabular", 2, max_batch=64, default_backend="tpu",
+            artifacts_dir=art,
+        )
+        assert dev is None and "no serve-specific crossover" in reason
+
     def test_gateway_modules_on_host_sync_hot_path(self, host_sync_checker):
         """The async gateway/registry handlers are hot-path modules: one
         blocking readback stalls every connected household."""

@@ -1,0 +1,306 @@
+"""int8 quantized bundles + AOT bucket programs (the serving half of the
+raw-speed pass).
+
+The error-bound contract (serve/export.py):
+
+* discrete policies (tabular, dqn) — export→load round trip serves a
+  BIT-EXACT greedy argmax vs the float32 bundle, across padding buckets;
+* continuous actors (ddpg) — the measured max-ulp action distance is
+  recorded in the manifest and must fit the budget;
+* the promotion gate refuses a quantized candidate exceeding its budget;
+* export-time AOT bucket programs make a same-architecture engine's warmup
+  (the gateway hot-swap path) adopt cached executables instead of
+  recompiling.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.serve.engine import (
+    PolicyEngine,
+    clear_aot_program_cache,
+)
+from p2pmicrogrid_tpu.serve.export import (
+    DEFAULT_ULP_BUDGET,
+    calibration_obs,
+    export_policy_bundle,
+    load_policy_bundle,
+)
+from p2pmicrogrid_tpu.train import init_policy_state
+
+A = 4
+
+
+def _cfg(impl, **kw):
+    return default_config(
+        sim=SimConfig(n_agents=A),
+        train=TrainConfig(implementation=impl),
+        **kw,
+    )
+
+
+def _state(cfg, seed=0, perturb=0.1):
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
+    if cfg.train.implementation == "tabular" and perturb:
+        rng = np.random.default_rng(seed + 1)
+        q = rng.standard_normal(ps.q_table.shape).astype(np.float32) * perturb
+        # Plant exact near-ties so the argmax-repair pass has real work:
+        # entries closer than one quantization step WILL collapse or flip
+        # without repair.
+        q[:, 0, 0, 0, 0, 0] = 0.5
+        q[:, 0, 0, 0, 0, 1] = 0.5 - 1e-6
+        ps = ps._replace(q_table=q)
+    if cfg.train.implementation == "dqn":
+        # A decisive network: scale the action-input row of the first layer
+        # so inter-action Q gaps dwarf the int8 weight noise. A fresh-init
+        # net has near-tied actions at some calibration points, and the
+        # export REFUSES those (the documented contract) — which
+        # test_int8_export_refuses_tied_dqn asserts separately.
+        k = np.asarray(ps.online["Dense_0"]["kernel"]).copy()
+        k[:, -1, :] *= 20.0
+        online = dict(ps.online)
+        online["Dense_0"] = dict(online["Dense_0"], kernel=k)
+        ps = ps._replace(online=online)
+    return ps
+
+
+def _export_pair(cfg, ps, tmp, **kw):
+    f32_dir = export_policy_bundle(cfg, ps, os.path.join(tmp, "f32"))
+    q_dir = export_policy_bundle(
+        cfg, ps, os.path.join(tmp, "int8"), dtype="int8", **kw
+    )
+    return f32_dir, q_dir
+
+
+@pytest.mark.parametrize("impl", ["tabular", "dqn"])
+def test_int8_discrete_greedy_bit_exact_two_buckets(impl, tmp_path):
+    """Export→load round trip: the int8 bundle's greedy actions equal the
+    float32 bundle's BIT-EXACTLY, through the real engine, across two
+    padding buckets."""
+    cfg = _cfg(impl)
+    ps = _state(cfg)
+    f32_dir, q_dir = _export_pair(cfg, ps, str(tmp_path))
+
+    eng_f32 = PolicyEngine(bundle_dir=f32_dir, max_batch=8)
+    eng_q = PolicyEngine(bundle_dir=q_dir, max_batch=8)
+    rng = np.random.default_rng(3)
+    for batch in (3, 8):  # two padding buckets (4 and 8)
+        obs = np.concatenate(
+            [
+                rng.uniform(0, 1, (batch, A, 1)),
+                rng.uniform(-1, 1, (batch, A, 3)),
+            ],
+            axis=-1,
+        ).astype(np.float32)
+        np.testing.assert_array_equal(eng_f32.act(obs), eng_q.act(obs))
+
+
+def test_int8_manifest_contract_fields(tmp_path):
+    cfg = _cfg("tabular")
+    _, q_dir = _export_pair(cfg, _state(cfg), str(tmp_path))
+    manifest, raw = load_policy_bundle(q_dir, dequantize=False)
+    assert manifest["dtype"] == "int8"
+    quant = manifest["quant"]
+    assert quant["scheme"] == "symmetric-per-leaf-int8"
+    assert quant["scales"] and all(
+        isinstance(s, float) and s > 0 for s in quant["scales"].values()
+    )
+    eb = quant["error_bound"]
+    assert eb["kind"] == "discrete_argmax"
+    assert eb["bit_exact_argmax"] is True
+    assert eb["rows_repaired"] >= 1  # the planted near-ties forced repairs
+    assert raw["q_table"].dtype == np.int8
+    # Dequantized load reconstructs floats through the recorded scales.
+    _, deq = load_policy_bundle(q_dir)
+    assert deq["q_table"].dtype == np.float32
+    # int8 bundles are ~4x smaller than f32 on disk.
+    assert manifest["param_bytes"] * 4 <= manifest["param_count"] * 4 + 4
+
+
+def test_int8_tabular_argmax_repair_exhaustive(tmp_path):
+    """The repair pass guarantees argmax equality over the WHOLE table, not
+    just sampled observations."""
+    cfg = _cfg("tabular")
+    ps = _state(cfg)
+    _, q_dir = _export_pair(cfg, ps, str(tmp_path))
+    _, deq = load_policy_bundle(q_dir)
+    np.testing.assert_array_equal(
+        np.argmax(np.asarray(ps.q_table), axis=-1),
+        np.argmax(deq["q_table"], axis=-1),
+    )
+
+
+@pytest.mark.parametrize("share", [False, True])
+def test_int8_continuous_ulp_recorded(share, tmp_path):
+    cfg = _cfg("ddpg", ddpg=DDPGConfig(share_across_agents=share))
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    if share:
+        # The shared-actor bundle path exports the bare shared params the
+        # way the CLI does for share-agents checkpoints.
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_params_init
+
+        ps = ddpg_params_init(cfg.ddpg, cfg.sim.n_agents, jax.random.PRNGKey(0))
+    q_dir = export_policy_bundle(
+        cfg, ps, os.path.join(str(tmp_path), "int8"), dtype="int8"
+    )
+    manifest, _ = load_policy_bundle(q_dir)
+    eb = manifest["quant"]["error_bound"]
+    assert eb["kind"] == "continuous_ulp"
+    assert 0 <= eb["max_ulp"] <= eb["ulp_budget"] == DEFAULT_ULP_BUDGET
+    assert eb["max_abs_action_err"] >= 0.0
+
+
+def test_int8_export_refuses_tied_dqn(tmp_path):
+    """A DQN whose calibration argmax flips under quantization is REFUSED at
+    export (it cannot be repaired row-wise) — the contract fails loudly
+    instead of shipping a bundle that serves different actions."""
+    cfg = _cfg("dqn")
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    # Fresh-init nets carry near-tied actions at some calibration points;
+    # if this seed happens to be decisive, force a tie by zeroing the
+    # action-input row (all actions then share near-identical Q values and
+    # int8 noise flips first-occurrence winners).
+    k = np.asarray(ps.online["Dense_0"]["kernel"]).copy()
+    k[:, -1, :] *= 1e-6
+    online = dict(ps.online)
+    online["Dense_0"] = dict(online["Dense_0"], kernel=k)
+    ps = ps._replace(online=online)
+    with pytest.raises(ValueError, match="bit-exact argmax"):
+        export_policy_bundle(
+            cfg, ps, os.path.join(str(tmp_path), "int8"), dtype="int8"
+        )
+
+
+def test_int8_export_refuses_over_budget(tmp_path):
+    cfg = _cfg("ddpg")
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="error budget"):
+        export_policy_bundle(
+            cfg, ps, os.path.join(str(tmp_path), "int8"),
+            dtype="int8", ulp_budget=0.0,
+        )
+
+
+def test_promotion_gate_refuses_over_budget_candidate(tmp_path):
+    """A quantized candidate whose recorded max_ulp exceeds the gate's
+    enforced budget is refused BEFORE any eval/SLO work."""
+    from p2pmicrogrid_tpu.serve.promotion import GateBudgets, run_promotion_gate
+
+    cfg = _cfg("ddpg")
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    inc_dir = export_policy_bundle(cfg, ps, os.path.join(str(tmp_path), "inc"))
+    cand_dir = export_policy_bundle(
+        cfg, ps, os.path.join(str(tmp_path), "cand"), dtype="int8"
+    )
+    # Tighten the enforced budget below the recorded measurement.
+    manifest = json.load(open(os.path.join(cand_dir, "manifest.json")))
+    measured = manifest["quant"]["error_bound"]["max_ulp"]
+    assert measured > 0
+    verdict = run_promotion_gate(
+        cfg, cand_dir, inc_dir,
+        budgets=GateBudgets(max_quant_ulp=measured / 2.0),
+        s_eval=2, bench_requests=8,
+        service_time_fn=lambda i, j: 0.001,
+    )
+    assert not verdict.passed
+    assert any("max ulp" in r for r in verdict.reasons)
+
+    # An un-tampered budget does NOT add a quant reason (the candidate may
+    # still fail the beat-the-incumbent check — same params tie).
+    verdict_ok = run_promotion_gate(
+        cfg, cand_dir, inc_dir, s_eval=2, bench_requests=8,
+        service_time_fn=lambda i, j: 0.001,
+    )
+    assert not any("ulp" in r for r in verdict_ok.reasons)
+
+
+def test_promotion_gate_refuses_uncertified_discrete_quant(tmp_path):
+    from p2pmicrogrid_tpu.serve.promotion import run_promotion_gate
+
+    cfg = _cfg("tabular")
+    ps = _state(cfg)
+    inc_dir = export_policy_bundle(cfg, ps, os.path.join(str(tmp_path), "inc"))
+    cand_dir = export_policy_bundle(
+        cfg, ps, os.path.join(str(tmp_path), "cand"), dtype="int8"
+    )
+    mpath = os.path.join(cand_dir, "manifest.json")
+    manifest = json.load(open(mpath))
+    manifest["quant"]["error_bound"]["bit_exact_argmax"] = False
+    json.dump(manifest, open(mpath, "w"))
+    verdict = run_promotion_gate(
+        cfg, cand_dir, inc_dir, s_eval=2, bench_requests=8,
+        service_time_fn=lambda i, j: 0.001,
+    )
+    assert not verdict.passed
+    assert any("bit-exact greedy argmax" in r for r in verdict.reasons)
+
+
+def test_promotion_gate_refuses_stripped_quant_block(tmp_path):
+    """An int8 candidate whose quant block was deleted outright (so nothing
+    certifies the contract and the loader cannot dequantize) is refused."""
+    from p2pmicrogrid_tpu.serve.promotion import run_promotion_gate
+
+    cfg = _cfg("tabular")
+    ps = _state(cfg)
+    inc_dir = export_policy_bundle(cfg, ps, os.path.join(str(tmp_path), "inc"))
+    cand_dir = export_policy_bundle(
+        cfg, ps, os.path.join(str(tmp_path), "cand"), dtype="int8"
+    )
+    mpath = os.path.join(cand_dir, "manifest.json")
+    manifest = json.load(open(mpath))
+    del manifest["quant"]
+    json.dump(manifest, open(mpath, "w"))
+    verdict = run_promotion_gate(
+        cfg, cand_dir, inc_dir, s_eval=2, bench_requests=8,
+        service_time_fn=lambda i, j: 0.001,
+    )
+    assert not verdict.passed
+    assert any("no quant block" in r for r in verdict.reasons)
+
+
+def test_aot_bucket_cache_warm_swap(tmp_path):
+    """Export-time AOT compiles populate the process-wide program cache; a
+    fresh same-architecture engine's warmup adopts them without compiling
+    (the gateway hot-swap path), and serves bit-identically."""
+    clear_aot_program_cache()
+    try:
+        cfg = _cfg("tabular")
+        ps = _state(cfg)
+        bundle = export_policy_bundle(
+            cfg, ps, os.path.join(str(tmp_path), "b"), aot_buckets=[1, 4],
+        )
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["aot"]["buckets"] == [1, 4]
+
+        eng = PolicyEngine(bundle_dir=bundle, max_batch=4, device="default")
+        warmed = eng.warmup([1, 4], include_step=False)
+        assert warmed == [1, 4]
+        assert eng.stats["aot_hits"] == 2
+        assert eng.stats["aot_compiles"] == 0
+
+        # A cold engine of a DIFFERENT architecture still compiles.
+        cfg2 = default_config(
+            sim=SimConfig(n_agents=A + 1),
+            train=TrainConfig(implementation="tabular"),
+        )
+        ps2 = init_policy_state(cfg2, jax.random.PRNGKey(0))
+        b2 = export_policy_bundle(cfg2, ps2, os.path.join(str(tmp_path), "b2"))
+        eng2 = PolicyEngine(bundle_dir=b2, max_batch=4, device="default")
+        eng2.warmup([4], include_step=False)
+        assert eng2.stats["aot_compiles"] == 1
+
+        obs = calibration_obs(4, A, seed=9)
+        eng_cold = PolicyEngine(bundle_dir=bundle, max_batch=4, device="default")
+        np.testing.assert_array_equal(eng.act(obs), eng_cold.act(obs))
+    finally:
+        clear_aot_program_cache()

@@ -139,7 +139,9 @@ def check_bench_capture(path: str, problems: list, strict_tail: bool) -> None:
                     )
 
 
-def check_metric_jsonl(path: str, problems: list) -> None:
+def _iter_jsonl_rows(path: str, problems: list):
+    """Yield (row, "relpath:lineno") for each JSON line; parse problems are
+    reported once here so every per-row checker shares one read."""
     where = os.path.relpath(path)
     try:
         with open(path) as f:
@@ -155,7 +157,82 @@ def check_metric_jsonl(path: str, problems: list) -> None:
         except json.JSONDecodeError:
             problems.append(f"{where}:{i + 1}: not valid JSON: {line[:60]!r}")
             continue
-        check_metric_row(row, f"{where}:{i + 1}", problems)
+        yield row, f"{where}:{i + 1}"
+
+
+def check_metric_jsonl(path: str, problems: list) -> None:
+    for row, where in _iter_jsonl_rows(path, problems):
+        check_metric_row(row, where, problems)
+        check_rawspeed_row(row, where, problems)
+
+
+# Raw-speed rows (ISSUE 12): the three bench families the megakernel /
+# quantized-serving round added. Validated in EVERY metric jsonl sweep —
+# a slot_fused row without its bit-exactness verdict, or a serve_quantized
+# row with an unknown dtype, measured nothing the raw-speed pass promises.
+QUANT_DTYPES = ("float32", "float16", "int8")
+
+
+def _require_numeric(row, keys, where, problems, label):
+    for key in keys:
+        v = row.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"{where}: {label} row missing numeric {key!r}")
+
+
+def _require_bool(row, keys, where, problems, label):
+    for key in keys:
+        if not isinstance(row.get(key), bool):
+            problems.append(f"{where}: {label} row missing boolean {key!r}")
+
+
+def check_rawspeed_rows(path: str, problems: list) -> None:
+    """slot_fused / serve_quantized / pipeline_depth row contracts inside a
+    metric jsonl capture, without the general metric-row checks (rows of
+    other metrics are ignored; check_all reaches check_rawspeed_row through
+    check_metric_jsonl's single parse instead)."""
+    parse_problems: list = []
+    for row, where in _iter_jsonl_rows(path, parse_problems):
+        if isinstance(row, dict):
+            check_rawspeed_row(row, where, problems)
+
+
+def check_rawspeed_row(row: dict, where: str, problems: list) -> None:
+    """One row's raw-speed contract (no-op for rows of other metrics)."""
+    if not isinstance(row, dict):
+        return
+    metric = row.get("metric")
+    if not isinstance(metric, str):
+        return
+    if metric.startswith("slot_fused"):
+        _require_numeric(
+            row,
+            ("speedup", "fused_env_steps_per_sec",
+             "unfused_env_steps_per_sec"),
+            where, problems, "slot_fused",
+        )
+        _require_bool(row, ("bit_exact",), where, problems, "slot_fused")
+    elif metric.startswith("serve_quantized"):
+        _require_numeric(
+            row,
+            ("p50_ms", "p99_ms", "cold_start_s", "swap_warmup_s"),
+            where, problems, "serve_quantized",
+        )
+        _require_bool(
+            row, ("bit_exact",), where, problems, "serve_quantized"
+        )
+        if row.get("dtype") not in QUANT_DTYPES:
+            problems.append(
+                f"{where}: serve_quantized row dtype "
+                f"{row.get('dtype')!r} not in {QUANT_DTYPES}"
+            )
+    elif metric.startswith("pipeline_depth"):
+        _require_numeric(
+            row,
+            ("speedup", "depth_1_env_steps_per_sec",
+             "depth_2_env_steps_per_sec", "depth_4_env_steps_per_sec"),
+            where, problems, "pipeline_depth",
+        )
 
 
 # Numeric stats every serve_bench_network headline row must carry — the
@@ -728,6 +805,55 @@ def check_bundle_dir(bundle_dir: str, problems: list) -> None:
         os.path.join(bundle_dir, pfile)
     ):
         problems.append(f"{where}: params_file {pfile!r} does not exist")
+    if isinstance(m.get("dtype"), str) and m["dtype"] not in QUANT_DTYPES:
+        problems.append(
+            f"{where}/manifest.json: dtype {m['dtype']!r} not in "
+            f"{QUANT_DTYPES}"
+        )
+    if m.get("dtype") == "int8":
+        # The quantization contract (serve/export.py): per-leaf scales and
+        # the measured error bound must be recorded — an int8 bundle
+        # without them cannot be dequantized or gate-checked.
+        quant = m.get("quant")
+        if not isinstance(quant, dict):
+            problems.append(
+                f"{where}/manifest.json: int8 bundle missing 'quant' object"
+            )
+        else:
+            scales = quant.get("scales")
+            if not isinstance(scales, dict) or not scales:
+                problems.append(
+                    f"{where}/manifest.json: int8 quant.scales missing/empty"
+                )
+            elif not all(
+                isinstance(s, (int, float)) and not isinstance(s, bool)
+                and s > 0
+                for s in scales.values()
+            ):
+                problems.append(
+                    f"{where}/manifest.json: int8 quant.scales must be "
+                    "positive numbers"
+                )
+            eb = quant.get("error_bound")
+            if not isinstance(eb, dict) or "kind" not in eb:
+                problems.append(
+                    f"{where}/manifest.json: int8 quant.error_bound "
+                    "missing (the recorded contract measurement)"
+                )
+            elif eb.get("kind") == "continuous_ulp" and not isinstance(
+                eb.get("max_ulp"), (int, float)
+            ):
+                problems.append(
+                    f"{where}/manifest.json: continuous int8 error_bound "
+                    "missing numeric max_ulp"
+                )
+            elif eb.get("kind") == "discrete_argmax" and eb.get(
+                "bit_exact_argmax"
+            ) is not True:
+                problems.append(
+                    f"{where}/manifest.json: discrete int8 bundle must "
+                    "certify bit_exact_argmax=true"
+                )
 
 
 def check_run_dir(run_dir: str, problems: list) -> None:

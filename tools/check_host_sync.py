@@ -47,6 +47,10 @@ HOT_PATH_FILES = (
     os.path.join("p2pmicrogrid_tpu", "parallel", "scenarios.py"),
     os.path.join("p2pmicrogrid_tpu", "train", "loop.py"),
     os.path.join("p2pmicrogrid_tpu", "envs", "community.py"),
+    # The fused slot megakernel (ISSUE 12): its wrapper runs inside every
+    # fused episode's scan — a blocking readback there would serialize the
+    # whole training dispatch per slot.
+    os.path.join("p2pmicrogrid_tpu", "ops", "pallas_slot.py"),
     os.path.join("p2pmicrogrid_tpu", "serve", "engine.py"),
     # The gateway's async handlers serve every connected household from one
     # event loop — a single un-annotated blocking readback stalls ALL of

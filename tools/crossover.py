@@ -72,10 +72,28 @@ def _serve_batches_per_sec(engine, max_batch: int) -> float:
 
 
 def serve_main() -> dict:
-    """The (n_agents, max_batch) padded-batch serve crossover sweep."""
-    tpu = jax.devices()[0]
+    """The (n_agents, max_batch) padded-batch serve crossover sweep.
+
+    On a host WITHOUT an accelerator the sweep still runs — both placements
+    resolve to host XLA-CPU, the honest ratio is ~1.0, and the capture is
+    marked ``accelerator: false`` so ``train/placement.py`` only trusts it
+    when the serving process itself runs on the CPU backend (a host-only
+    capture says nothing about where a TPU host should place a bucket; the
+    TPU capture stays ROADMAP measurement debt). Committing it exercises
+    the crossover-table loader end to end, which had been live with nothing
+    to read since the gateway round.
+    """
+    accel = jax.devices()[0]
     cpu = jax.devices("cpu")[0]
-    assert tpu.platform != "cpu", "run this on a TPU host"
+    has_accel = accel.platform != "cpu"
+    if not has_accel:
+        accel = cpu
+        print(
+            "crossover --serve: no accelerator backend; measuring the "
+            "host-only sweep (both placements = XLA-CPU, accelerator: "
+            "false in the capture)",
+            flush=True,
+        )
 
     rows = []
     for impl in ("tabular", "ddpg"):
@@ -85,7 +103,7 @@ def serve_main() -> dict:
                     _serve_engine(impl, a, b, cpu), b
                 )
                 r_tpu = _serve_batches_per_sec(
-                    _serve_engine(impl, a, b, tpu), b
+                    _serve_engine(impl, a, b, accel), b
                 )
                 rows.append(
                     {
@@ -99,8 +117,9 @@ def serve_main() -> dict:
                     }
                 )
                 print(
-                    f"{impl} A={a} B={b}: cpu {r_cpu:.0f} vs tpu "
-                    f"{r_tpu:.0f} batches/s ({r_tpu / r_cpu:.2f}x)",
+                    f"{impl} A={a} B={b}: cpu {r_cpu:.0f} vs "
+                    f"{accel.platform} {r_tpu:.0f} batches/s "
+                    f"({r_tpu / r_cpu:.2f}x)",
                     flush=True,
                 )
 
@@ -109,8 +128,15 @@ def serve_main() -> dict:
             "padded-bucket PolicyEngine.act placed on each backend; one "
             "full max_batch bucket per call, fresh-init bundles, "
             f"{SERVE_REPEATS} timed calls after warmup"
+            + (
+                "" if has_accel else
+                " — HOST-ONLY capture: no accelerator was present, both "
+                "placements ran on XLA-CPU (placement ignores this table "
+                "on accelerator hosts)"
+            )
         ),
         "kind": "serve_crossover",
+        "accelerator": has_accel,
         "device": jax.devices()[0].device_kind,
         "rows": rows,
     }
