@@ -1002,6 +1002,23 @@ def bench_slot_fused() -> dict:
     return _slot_fused_row("tabular", 16, 16, episodes=2)
 
 
+def bench_regime_generalization() -> dict:
+    """Regime-portfolio generalization (ISSUE 13): a mixed batch of 4
+    train regimes runs through ONE compiled shared-scenario episode
+    program (single_compile asserted via the jit cache), then the trained
+    policy evaluates per-regime on the train set AND a held-out regime
+    set. Per-regime eval rows and the gate case (a crafted candidate that
+    improves mean cost but regresses a held-out regime, blocked by the
+    regime-aware gate) emit as siblings; the ``regime_generalization``
+    row is the returned headline."""
+    from p2pmicrogrid_tpu.regimes.bench import run_regime_bench
+
+    rows = run_regime_bench(episodes=2, emit=None)
+    for row in rows[:-1]:
+        _emit_row(row)
+    return rows[-1]
+
+
 def bench_serve_quantized() -> dict:
     """Per-dtype serving: p50/p99, cold-start and AOT swap-warmup delta for
     float32 / float16 / int8 bundles of the same checkpoint — one engine
@@ -1465,6 +1482,7 @@ BENCHES = {
     "slot_fused": bench_slot_fused,
     "serve_quantized": bench_serve_quantized,
     "pipeline_depth": bench_pipeline_depth,
+    "regime_generalization": bench_regime_generalization,
     # North star last: the driver parses the final JSON line, and the
     # full-aggregate 1000x10240 number is the headline.
     "northstar": bench_northstar,
@@ -1478,6 +1496,7 @@ BENCHES = {
 CPU_RETRYABLE = {
     "cfg1", "cfg2", "cfg3", "cfg5", "convergence", "convergence_fast",
     "chunked_pipeline", "slot_fused", "serve_quantized", "pipeline_depth",
+    "regime_generalization",
 }
 
 

@@ -42,6 +42,12 @@ Subcommands:
             POST /v1/act, /healthz, /readyz, /stats, POST /admin/swap
             (hot-swap + A/B split), admission control, drain-before-exit
             (serve/gateway.py)
+  regime-bench
+            regime-portfolio acceptance harness: train a mixed >=4-regime
+            batch in one compiled program, print per-regime eval tables
+            (train + held-out sets), run the mean-better/regime-worse
+            gate case, close with the regime_generalization headline row
+            (regimes/bench.py)
 """
 
 from __future__ import annotations
@@ -2565,6 +2571,7 @@ def cmd_promote(args) -> int:
         slo_p95_ms=args.slo_p95_ms,
         slo_p99_ms=args.slo_p99_ms,
         max_shed_rate=args.max_shed_rate,
+        max_regime_regression=getattr(args, "max_regime_regression", 0.0),
     )
     canary_budgets = CanaryBudgets(
         max_cost_regression=args.max_cost_regression,
@@ -2587,6 +2594,17 @@ def cmd_promote(args) -> int:
                     out_f.flush()
 
             if args.inject:
+                if getattr(args, "regimes", None):
+                    # The seeded harness crafts its own candidates per
+                    # case; silently dropping the per-regime rail would
+                    # misreport what was exercised — refuse loudly.
+                    print(
+                        "--inject and --regimes cannot combine (the seeded "
+                        "harness does not run the per-regime gate); drop "
+                        "one",
+                        file=sys.stderr,
+                    )
+                    return 2
                 cases = (
                     ("good", "cost_regressed", "nan_poisoned",
                      "slo_violating")
@@ -2619,6 +2637,10 @@ def cmd_promote(args) -> int:
                     cfg, args.candidate, args.incumbent,
                     budgets=gate_budgets, telemetry=tel,
                     bench_seed=args.seed, max_batch=args.max_batch,
+                    regime_specs=(
+                        [r for r in args.regimes.split(",") if r]
+                        if getattr(args, "regimes", None) else None
+                    ),
                 )
                 emit({
                     "metric": "promotion_gate",
@@ -2641,6 +2663,10 @@ def cmd_promote(args) -> int:
                 n_households=args.households,
                 skip_gate=args.skip_gate,
                 max_batch=args.max_batch,
+                regime_specs=(
+                    [r for r in args.regimes.split(",") if r]
+                    if getattr(args, "regimes", None) else None
+                ),
             )
             emit({
                 "metric": "promotion_case",
@@ -2651,6 +2677,78 @@ def cmd_promote(args) -> int:
                 **fields,
             })
             return 0 if fields.get("promoted") else 1
+    finally:
+        tel.close()
+        if out_f is not None:
+            out_f.close()
+
+
+def cmd_regime_bench(args) -> int:
+    """Regime-portfolio acceptance harness (regimes/bench.py).
+
+    Trains a mixed batch of >= 4 regimes in ONE compiled program, prints
+    the per-regime eval table for the train set and a held-out set, runs
+    the gate case (a crafted candidate that improves mean cost but
+    regresses a held-out regime MUST be blocked by the regime-aware
+    gate), and closes with the ``regime_generalization`` headline row —
+    one JSON metric row per stdout line through the guarded sink, the
+    committed ``artifacts/REGIME_*.jsonl`` capture driver. With
+    ``--results-db`` the per-regime ``regime_eval`` events also land in
+    the warehouse (``telemetry-query --regimes``).
+    """
+    from p2pmicrogrid_tpu.regimes.bench import bench_config, run_regime_bench
+    from p2pmicrogrid_tpu.telemetry import (
+        SqliteSink,
+        Telemetry,
+        guarded_stdout_sink,
+    )
+    from p2pmicrogrid_tpu.telemetry.registry import run_manifest, run_stamp
+
+    train_regimes = [r for r in args.train_regimes.split(",") if r]
+    held_out = [r for r in args.held_out_regimes.split(",") if r]
+    # The cfg run_regime_bench trains under (one builder, no drift) — so
+    # the warehouse run carries the config_hash the --regimes view
+    # groups by.
+    cfg = bench_config(
+        args.agents,
+        args.scenarios_per_regime * len(train_regimes),
+        args.implementation,
+        args.seed,
+    )
+    out_f = open(args.out, "a") if args.out else None
+    tel = Telemetry(
+        run_id=f"regime-bench-{run_stamp()}",
+        sinks=[SqliteSink(args.results_db)] if args.results_db else [],
+        manifest=run_manifest(cfg, extra={"serve_role": "regime-bench"}),
+    )
+    try:
+        with guarded_stdout_sink() as sink:
+            def emit(row: dict) -> None:
+                sink.emit(row)
+                tel.emit(row)
+                if out_f is not None:
+                    out_f.write(json.dumps(row) + "\n")
+                    out_f.flush()
+
+            rows = run_regime_bench(
+                train_regimes=train_regimes,
+                held_out_regimes=held_out,
+                n_agents=args.agents,
+                scenarios_per_regime=args.scenarios_per_regime,
+                episodes=args.episodes,
+                s_eval_per_regime=args.eval_scenarios,
+                implementation=args.implementation,
+                seed=args.seed,
+                telemetry=tel if args.results_db else None,
+                gate_case=not args.no_gate_case,
+                emit=emit,
+            )
+        headline = rows[-1]
+        ok = bool(headline.get("single_compile")) and (
+            args.no_gate_case
+            or bool(headline.get("gate_blocked_regime_regression"))
+        )
+        return 0 if ok else 1
     finally:
         tel.close()
         if out_f is not None:
@@ -3026,14 +3124,16 @@ def cmd_telemetry_query(args) -> int:
             getattr(args, "fleet", False)
             or getattr(args, "rollbacks", False)
             or getattr(args, "promotions", False)
+            or getattr(args, "regimes", False)
         ):
             # Silently tailing the EVAL join when the user asked for the
-            # fleet/rollback/promotion view would stream unrelated rows;
-            # refuse loudly.
+            # fleet/rollback/promotion/regime view would stream unrelated
+            # rows; refuse loudly.
             which = (
                 "--fleet" if getattr(args, "fleet", False)
                 else "--rollbacks" if getattr(args, "rollbacks", False)
-                else "--promotions"
+                else "--promotions" if getattr(args, "promotions", False)
+                else "--regimes"
             )
             print(
                 f"{which} and --watch cannot combine (the watch tails the "
@@ -3057,6 +3157,10 @@ def cmd_telemetry_query(args) -> int:
             from p2pmicrogrid_tpu.data.results import ROLLBACK_VIEW_SQL
 
             rows = select(ROLLBACK_VIEW_SQL)
+        elif getattr(args, "regimes", False):
+            from p2pmicrogrid_tpu.data.results import REGIME_VIEW_SQL
+
+            rows = select(REGIME_VIEW_SQL)
         elif getattr(args, "promotions", False):
             from p2pmicrogrid_tpu.data.results import (
                 PROMOTION_VIEW_SQL,
@@ -3940,7 +4044,61 @@ def main(argv=None) -> int:
                    dest="canary_min_requests",
                    help="canary: candidate-arm decisions needed per stage "
                         "for a cost verdict (default 8)")
+    p.add_argument("--regimes",
+                   help="gate: comma-separated held-out regime names "
+                        "(p2pmicrogrid_tpu/regimes/) — the candidate may "
+                        "not regress ANY of them, even when its mean cost "
+                        "improves (honored by --gate-only and the full "
+                        "pipeline's gate; --skip-gate skips it with the "
+                        "rest of the gate)")
+    p.add_argument("--max-regime-regression", type=float, default=0.0,
+                   dest="max_regime_regression",
+                   help="gate: scale-free per-regime regression tolerance "
+                        "for --regimes (default 0 — any regression blocks)")
     p.set_defaults(fn=cmd_promote)
+
+    p = sub.add_parser(
+        "regime-bench",
+        help="regime-portfolio acceptance harness: mixed >=4-regime "
+             "training in one compiled program, per-regime eval tables "
+             "(train + held-out sets), the mean-better/regime-worse gate "
+             "case, and the regime_generalization headline row "
+             "(regimes/bench.py; the REGIME_*.jsonl capture driver)",
+    )
+    p.add_argument("--train-regimes", dest="train_regimes",
+                   default="baseline,winter,ev_evening,double_auction",
+                   help="comma-separated regime names trained as one "
+                        "mixed batch (default: "
+                        "baseline,winter,ev_evening,double_auction)")
+    p.add_argument("--held-out-regimes", dest="held_out_regimes",
+                   default="dr_spike,islanding_noon,cold_snap,"
+                           "uniform_price",
+                   help="comma-separated held-out regime names for the "
+                        "generalization eval and the gate case")
+    p.add_argument("--agents", type=int, default=3)
+    p.add_argument("--scenarios-per-regime", type=int, default=2,
+                   dest="scenarios_per_regime",
+                   help="training scenarios per train regime in the "
+                        "mixed batch (default 2)")
+    p.add_argument("--episodes", type=int, default=3)
+    p.add_argument("--eval-scenarios", type=int, default=4,
+                   dest="eval_scenarios",
+                   help="held-out eval scenarios per regime (default 4)")
+    p.add_argument("--implementation",
+                   choices=["tabular", "dqn", "ddpg"], default="tabular")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--results-db",
+                   help="also stream regime_eval events + metric rows "
+                        "into this SQLite warehouse "
+                        "(telemetry-query --regimes)")
+    p.add_argument("--out",
+                   help="append the metric rows to this JSONL capture "
+                        "(schema-checked as artifacts/REGIME_*.jsonl)")
+    p.add_argument("--no-gate-case", action="store_true",
+                   dest="no_gate_case",
+                   help="skip the crafted mean-better/regime-worse gate "
+                        "case (eval tables + headline only)")
+    p.set_defaults(fn=cmd_regime_bench)
 
     p = sub.add_parser(
         "autopilot",
@@ -4141,6 +4299,11 @@ def main(argv=None) -> int:
                         "candidate config's gate verdicts, promotions and "
                         "canary rollbacks with the newest decision phase "
                         "(serve/promotion.py)")
+    p.add_argument("--regimes", action="store_true",
+                   help="regime view instead of the eval join: per-regime "
+                        "cost/comfort/trade-energy breakdown per "
+                        "config_hash out of the regime_eval events "
+                        "(p2pmicrogrid_tpu/regimes/)")
     p.add_argument("--watch", action="store_true",
                    help="tail mode: poll the warehouse join and stream "
                         "new/updated rows as JSON lines until interrupted "

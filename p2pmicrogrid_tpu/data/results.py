@@ -725,6 +725,45 @@ def promotion_lineage(con: sqlite3.Connection) -> dict:
     return {"links": links, "chain": chain}
 
 
+# The regime view (ISSUE 13, p2pmicrogrid_tpu/regimes/): per-regime
+# cost/comfort/trade-energy breakdown per config_hash out of the
+# ``regime_eval`` events the per-regime greedy evaluator emits
+# (regimes/evaluate.py) — the warehouse answer to "how does this config
+# do in each world, not just on average". One LEFT-JOIN-free pass over
+# telemetry_points grouped by (config_hash, bundle, regime); held_out marks
+# rows from generalization evals (train on set A, eval on held-out set B),
+# and ``bundle`` (when the evaluator tagged one — the promotion gate tags
+# candidate/incumbent) keeps two policies of one config in separate rows
+# instead of averaging them.
+REGIME_VIEW_SQL = """
+SELECT t.config_hash,
+       json_extract(p.attrs_json, '$.bundle') AS bundle,
+       json_extract(p.attrs_json, '$.regime') AS regime,
+       COUNT(*) AS n_evals,
+       COUNT(CASE WHEN json_extract(p.attrs_json, '$.held_out') = 1
+           THEN 1 END) AS n_held_out_evals,
+       AVG(json_extract(p.attrs_json, '$.cost_eur')) AS mean_cost_eur,
+       AVG(json_extract(p.attrs_json, '$.reward')) AS mean_reward,
+       AVG(json_extract(p.attrs_json, '$.comfort_violations'))
+           AS mean_comfort_violations,
+       AVG(json_extract(p.attrs_json, '$.trade_wh')) AS mean_trade_wh,
+       AVG(json_extract(p.attrs_json, '$.grid_wh')) AS mean_grid_wh,
+       AVG(json_extract(p.attrs_json, '$.curtailed_wh'))
+           AS mean_curtailed_wh,
+       AVG(json_extract(p.attrs_json, '$.ev_charged_wh'))
+           AS mean_ev_charged_wh,
+       AVG(json_extract(p.attrs_json, '$.ev_missed_wh'))
+           AS mean_ev_missed_wh,
+       MAX(p.ts) AS last_ts
+FROM telemetry_points p
+JOIN telemetry_runs t ON t.run_id = p.run_id
+WHERE p.kind = 'regime_eval'
+  AND json_extract(p.attrs_json, '$.regime') IS NOT NULL
+GROUP BY t.config_hash, bundle, regime
+ORDER BY t.config_hash, bundle, regime
+"""
+
+
 # The default telemetry-query join (cli.py `telemetry-query`): one row per
 # (telemetry run, eval run) pair sharing a config_hash, with the run's gauge
 # points aggregated alongside the eval cost.
@@ -1089,6 +1128,14 @@ class ResultsStore:
         config_hash (``ROLLBACK_VIEW_SQL``): rollback/divergence counter
         sums and the last rollback's episode detail, as dicts."""
         cur = self.con.execute(ROLLBACK_VIEW_SQL)
+        cols = [d[0] for d in cur.description]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
+
+    def query_regime_view(self) -> list:
+        """Per-(config_hash, regime) breakdown of the ``regime_eval``
+        events (``REGIME_VIEW_SQL``): mean cost/comfort/trade-energy and
+        EV/curtailment attribution per regime, as dicts."""
+        cur = self.con.execute(REGIME_VIEW_SQL)
         cols = [d[0] for d in cur.description]
         return [dict(zip(cols, row)) for row in cur.fetchall()]
 

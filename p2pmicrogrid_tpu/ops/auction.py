@@ -1,0 +1,137 @@
+"""Alternative P2P market mechanisms: drop-in siblings of the midpoint rule.
+
+The paper settles every matched P2P trade at the midpoint of the grid
+buy/injection spread (``ops/tariff.p2p_price``, reference community.py:70).
+This module adds the two standard mechanisms the scenario-regime engine
+(p2pmicrogrid_tpu/regimes/) composes per scenario:
+
+* ``double_auction_price`` — a k-double auction over the community book.
+  Every buyer's outside option is the grid buy price and every seller's is
+  the injection price, so in the induced flat-valuation book the marginal
+  bid/ask pair is ``(buy, inj)`` whenever both sides are present and the
+  cleared price is ``ask + k * (bid - ask)``. Written in midpoint-anchored
+  form (``mid + (k - 1/2) * spread``) so the symmetric split ``k = 0.5``
+  reduces BIT-FOR-BIT to the midpoint rule (tests assert it).
+
+* ``uniform_clearing_price`` — one uniform price at the crossing of the
+  aggregate demand/supply curves, tilted toward the scarce side by the
+  book imbalance: ``mid + spread/2 * (demand - supply) / (demand +
+  supply)`` (algebraically ``inj + spread * demand / (demand + supply)``).
+  A balanced book (``demand == supply`` — symmetric bids) reduces
+  BIT-FOR-BIT to the midpoint rule.
+
+All three mechanisms share one signature class — pure elementwise functions
+of ``(buy, inj, demand_w, supply_w)`` broadcasting over any leading batch
+axes — and only set the PRICE of the already-matched trades: the physical
+matching (``ops/market.clear_market`` / the factored clearing) is mechanism-
+independent, so per-slot energy conservation holds across all mechanisms by
+construction (tests assert that too). ``mechanism_trade_price`` is the
+vmappable mixed-batch dispatcher: the mechanism id is an int32 ARRAY leaf
+(one per scenario), so one compiled program clears a batch mixing all three
+mechanisms with two ``jnp.where`` selects — no per-mechanism retrace.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.ops.tariff import p2p_price
+
+# Mechanism ids (int32 array leaves on the regime axis).
+MECH_MIDPOINT = 0
+MECH_DOUBLE_AUCTION = 1
+MECH_UNIFORM = 2
+
+MECHANISM_IDS = {
+    "midpoint": MECH_MIDPOINT,
+    "double_auction": MECH_DOUBLE_AUCTION,
+    "uniform": MECH_UNIFORM,
+}
+MECHANISM_NAMES = {v: k for k, v in MECHANISM_IDS.items()}
+
+
+def trade_volumes(powers: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Community book volumes from per-agent net powers.
+
+    ``powers`` is [..., A] (positive = wants to buy, negative = sells);
+    returns ``(demand_w, supply_w)`` each [...] — the agent-summed buy and
+    sell sides. Callers MUST pass the PRE-clearing book (the proposed net
+    powers, i.e. ``p_grid + p_p2p`` after matching): the matched trades
+    alone balance by construction (every matched Watt has a counterparty),
+    which would pin the uniform price's imbalance tilt at exactly zero.
+    """
+    return (
+        jnp.sum(jnp.maximum(powers, 0.0), axis=-1),
+        jnp.sum(jnp.maximum(-powers, 0.0), axis=-1),
+    )
+
+
+def double_auction_price(
+    buy: jnp.ndarray,
+    inj: jnp.ndarray,
+    demand_w: jnp.ndarray,
+    supply_w: jnp.ndarray,
+    k: jnp.ndarray = 0.5,
+) -> jnp.ndarray:
+    """k-double-auction price over the community's flat-valuation book.
+
+    Buyers bid their outside option (the grid buy price), sellers ask
+    theirs (the injection price); the auction clears at ``ask + k * (bid -
+    ask)``. ``k`` is the seller-surplus share: 0 hands the whole spread to
+    buyers, 1 to sellers, and the symmetric ``k = 0.5`` is exactly the
+    midpoint rule — the midpoint-anchored form below makes that reduction
+    bit-for-bit (``mid + 0.0 * spread == mid``), which the regime tests
+    pin. ``demand_w``/``supply_w`` are accepted for the shared mechanism
+    signature; a flat-valuation book's marginal pair is volume-independent,
+    and with an empty side no trade matches, so the price is unobservable
+    in settlement either way.
+    """
+    del demand_w, supply_w  # flat-valuation book: marginal pair is (buy, inj)
+    return p2p_price(buy, inj) + (jnp.asarray(k) - 0.5) * (buy - inj)
+
+
+def uniform_clearing_price(
+    buy: jnp.ndarray,
+    inj: jnp.ndarray,
+    demand_w: jnp.ndarray,
+    supply_w: jnp.ndarray,
+) -> jnp.ndarray:
+    """Uniform market-clearing price at the demand/supply crossing.
+
+    One price for every trade in the slot, set where the aggregate curves
+    cross: the demand share of the book pulls the price from the injection
+    floor toward the buy ceiling — ``inj + spread * demand / (demand +
+    supply)``, written midpoint-anchored (``mid + spread/2 * (demand -
+    supply) / (demand + supply)``) so a balanced book (symmetric bids,
+    ``demand == supply`` — the tilt term is exactly 0.0) reduces
+    bit-for-bit to the midpoint rule. The denominator is floored at 1 W:
+    an empty book has no trades, so its price is unobservable.
+    """
+    total = jnp.maximum(demand_w + supply_w, 1.0)
+    tilt = (demand_w - supply_w) / total
+    return p2p_price(buy, inj) + 0.5 * (buy - inj) * tilt
+
+
+def mechanism_trade_price(
+    mechanism: jnp.ndarray,
+    buy: jnp.ndarray,
+    inj: jnp.ndarray,
+    demand_w: jnp.ndarray,
+    supply_w: jnp.ndarray,
+    auction_k: jnp.ndarray = 0.5,
+) -> jnp.ndarray:
+    """Mixed-batch mechanism dispatch: ``mechanism`` is an int32 array
+    (``MECH_*`` per element, broadcasting with the price arrays), so one
+    compiled program prices scenarios running different mechanisms side by
+    side. All three candidate prices are elementwise-cheap; the selects
+    cost nothing next to the clearing itself."""
+    mech = jnp.asarray(mechanism)
+    mid = p2p_price(buy, inj)
+    da = double_auction_price(buy, inj, demand_w, supply_w, auction_k)
+    up = uniform_clearing_price(buy, inj, demand_w, supply_w)
+    return jnp.where(
+        mech == MECH_DOUBLE_AUCTION, da,
+        jnp.where(mech == MECH_UNIFORM, up, mid),
+    )

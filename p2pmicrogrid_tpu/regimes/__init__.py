@@ -1,0 +1,64 @@
+"""Scenario regime engine: composable worlds as a vmappable config axis.
+
+One ``RegimeSpec`` composes weather/season transforms, EV charging as a
+second schedulable load, demand-response / islanding event windows, and
+the market mechanism; a portfolio of specs becomes ``RegimeParams`` array
+leaves on the scenario axis, so ONE compiled episode program trains and
+evaluates a mixed-regime batch (see ISSUE 13 / README "Scenario regimes").
+"""
+
+from p2pmicrogrid_tpu.regimes.engine import (
+    RegimeCounters,
+    apply_weather_regimes,
+    ev_charge_step,
+    init_ev_need,
+    rc_to_dicts,
+    regime_slot_batched,
+)
+from p2pmicrogrid_tpu.regimes.evaluate import (
+    evaluate_bundle_regimes,
+    evaluate_regimes,
+    make_regime_eval,
+)
+from p2pmicrogrid_tpu.regimes.spec import (
+    REGIME_LIBRARY,
+    RegimeParams,
+    RegimeSpec,
+    assign_regimes,
+    assignment_one_hot,
+    regime_assignment,
+    resolve_specs,
+    stack_regime_params,
+)
+from p2pmicrogrid_tpu.regimes.train import (
+    RegimePortfolio,
+    build_portfolio,
+    make_regime_episode_fn,
+    refuse_fused_regimes,
+    train_regime_portfolio,
+)
+
+__all__ = [
+    "REGIME_LIBRARY",
+    "RegimeCounters",
+    "RegimeParams",
+    "RegimePortfolio",
+    "RegimeSpec",
+    "apply_weather_regimes",
+    "assign_regimes",
+    "assignment_one_hot",
+    "build_portfolio",
+    "ev_charge_step",
+    "evaluate_bundle_regimes",
+    "evaluate_regimes",
+    "init_ev_need",
+    "make_regime_episode_fn",
+    "make_regime_eval",
+    "rc_to_dicts",
+    "refuse_fused_regimes",
+    "regime_assignment",
+    "regime_slot_batched",
+    "resolve_specs",
+    "stack_regime_params",
+    "train_regime_portfolio",
+]

@@ -1,0 +1,310 @@
+"""The regime-aware slot: weather, EV, event windows, market mechanisms.
+
+``regime_slot_batched`` wraps ``envs.community.slot_dynamics_batched`` with
+the four regime composition points, all driven by ``RegimeParams`` array
+leaves on the scenario axis (one compiled program, mixed regimes):
+
+* **weather** happens before the slot: ``apply_weather_regimes`` scales the
+  episode arrays once per episode (host-built or device-generated alike).
+* **EV charging** happens pre-negotiation: the deadline-feasible charge
+  rate (the agent's flexibility dial above a feasibility floor) is added to
+  the slot's load, so it flows through the balance OBSERVATION, the
+  negotiation, every market mechanism's settlement and the reward — the
+  second schedulable load rides the exact channels the heat pump uses.
+* **events + mechanism** happen at settlement, through the
+  ``settlement_hook`` extension point ``slot_dynamics_batched`` already
+  exposes: the hook re-prices the slot (spike multiplier, per-scenario
+  mechanism select), masks grid exchange to zero in islanding windows
+  (curtailing unserved load at the value-of-lost-load price; spilled
+  surplus is wasted, not billed), and bills EV deadline misses — so the
+  regime economics land in ``cost`` and therefore in the REWARD the
+  learners train on, with no change to the policy interface.
+
+An all-default (baseline) regime is the identity: the wrapped slot is
+bit-exact with the plain ``slot_dynamics_batched`` chain (tests pin it).
+
+``RegimeCounters`` is the per-regime mirror of ``telemetry.DeviceCounters``:
+[R]-leaf totals accumulated through the episode scan via a one-hot
+segment-sum over the scenario→regime assignment, so a mixed-regime program
+reports cost/comfort/trade/curtailment/EV attribution PER REGIME from one
+device call.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from p2pmicrogrid_tpu.config import ExperimentConfig, KWH_TO_WS
+from p2pmicrogrid_tpu.envs.community import (
+    EpisodeArrays,
+    slot_dynamics_batched,
+)
+from p2pmicrogrid_tpu.ops.auction import mechanism_trade_price, trade_volumes
+from p2pmicrogrid_tpu.ops.market import compute_costs
+from p2pmicrogrid_tpu.regimes.spec import RegimeParams
+
+
+def apply_weather_regimes(
+    arrays: EpisodeArrays, rp: RegimeParams
+) -> EpisodeArrays:
+    """Per-scenario weather transform over [S, T(, A)] episode arrays.
+
+    Scales are time-invariant per scenario, so the rolled ``next_*``
+    leaves scale by the same factor — the np.roll (state, next_state)
+    pairing stays exact. Neutral params (offset 0, scales 1) are the
+    bitwise identity.
+    """
+    off = rp.temp_offset_c[:, None]
+    pv = rp.pv_scale[:, None, None]
+    load = rp.load_scale[:, None, None]
+    return arrays._replace(
+        t_out=arrays.t_out + off,
+        load_w=arrays.load_w * load,
+        pv_w=arrays.pv_w * pv,
+        next_load_w=arrays.next_load_w * load,
+        next_pv_w=arrays.next_pv_w * pv,
+    )
+
+
+def init_ev_need(rp: RegimeParams, n_agents: int) -> jnp.ndarray:
+    """[S, A] energy (Ws) each agent's EV still owes at episode start."""
+    per_scenario = rp.ev_energy_ws * rp.ev_present  # [S]
+    return jnp.broadcast_to(
+        per_scenario[:, None], (per_scenario.shape[0], n_agents)
+    ).astype(jnp.float32)
+
+
+def ev_charge_step(
+    cfg: ExperimentConfig,
+    rp: RegimeParams,
+    ev_need: jnp.ndarray,   # [S, A] Ws still owed
+    slot_idx: jnp.ndarray,  # [S] int32 slot of day
+    dial: jnp.ndarray,      # [S, A] flexibility dial in [0, 1] (prev hp_frac)
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One slot of deadline-constrained EV charging.
+
+    The charge rate is the agent's dial times the charger rating, floored
+    at the deadline-feasibility rate (``need / time_left`` — an idle dial
+    cannot strand the vehicle; the floor back-loads charging, a low dial
+    defers energy toward the deadline) and capped at the rating and at the
+    remaining need. Charging only happens inside the availability window.
+    At the slot entering the deadline the remaining need becomes the MISS
+    (billed by the settlement hook) and the window closes.
+
+    Returns ``(ev_power_w [S, A], ev_need' [S, A], miss_ws [S, A])``.
+    """
+    dt = cfg.sim.dt_seconds
+    arrival = rp.ev_arrival_slot[:, None]
+    deadline = rp.ev_deadline_slot[:, None]
+    slot = slot_idx[:, None]
+    in_window = (
+        (rp.ev_present[:, None] > 0.0)
+        & (slot >= arrival)
+        & (slot < deadline)
+        & (ev_need > 0.0)
+    )
+    slots_left = jnp.maximum(deadline - slot, 1).astype(jnp.float32)
+    floor_w = ev_need / (slots_left * dt)
+    want_w = jnp.clip(dial, 0.0, 1.0) * rp.ev_max_power_w[:, None]
+    rate_w = jnp.clip(
+        jnp.maximum(want_w, floor_w), 0.0, rp.ev_max_power_w[:, None]
+    )
+    rate_w = jnp.minimum(rate_w, ev_need / dt)  # never overshoot the need
+    ev_power = jnp.where(in_window, rate_w, 0.0)
+    new_need = jnp.maximum(ev_need - ev_power * dt, 0.0)
+    at_deadline = (slot + 1 >= deadline) & (rp.ev_present[:, None] > 0.0)
+    miss_ws = jnp.where(at_deadline, new_need, 0.0)
+    new_need = jnp.where(at_deadline, 0.0, new_need)
+    return ev_power, new_need, miss_ws
+
+
+def regime_slot_batched(
+    cfg: ExperimentConfig,
+    policy,
+    pol_state,
+    phys_s,
+    ev_need: jnp.ndarray,
+    xs_t,
+    key,
+    ratings,
+    rp: RegimeParams,
+    explore: bool,
+    act_fn=None,
+    explore_state=None,
+):
+    """Scenario-batched slot with the regime composition applied.
+
+    Same contract as ``slot_dynamics_batched`` plus the EV-need carry:
+    returns ``(phys', pol_state, outputs, transition, explore_state',
+    ev_need', extras)`` where ``extras`` is a dict of per-slot regime
+    series (``ev_power_w``, ``curtailed_w``, ``ev_miss_ws`` — [S, A]) the
+    per-regime counters reduce. ``outputs`` records the REGIME-EFFECTIVE
+    market: masked grid power, spiked buy price, the mechanism's trade
+    price. The hook's cost (and hence the reward the learners see) already
+    includes curtailment and EV-miss billing.
+    """
+    time_s = xs_t[0]
+    slot_idx = jnp.round(time_s * cfg.sim.slots_per_day).astype(jnp.int32)
+
+    ev_power, ev_need, miss_ws = ev_charge_step(
+        cfg, rp, ev_need, slot_idx, phys_s.hp_frac
+    )
+    # The EV charge joins the slot's inflexible load BEFORE negotiation:
+    # it is observed (balance feature), negotiated over, traded and
+    # settled exactly like any other Watt. (The next-slot observation
+    # keeps the non-EV balance — the same stale-next-state convention the
+    # reference applies to temperature and the p2p signal.)
+    xs_mod = (time_s, xs_t[1], xs_t[2] + ev_power) + tuple(xs_t[3:])
+
+    islanded = (slot_idx >= rp.outage_start_slot) & (
+        slot_idx < rp.outage_end_slot
+    )  # [S]
+    spiked = (slot_idx >= rp.spike_start_slot) & (
+        slot_idx < rp.spike_end_slot
+    )
+    spike_mult = jnp.where(spiked, rp.spike_mult, 1.0)  # [S]
+
+    recorded = {}
+
+    def settlement(p_grid, p_p2p, buy, inj, trade):
+        del trade  # the mechanism select below owns the trade price
+        buy_eff = buy * spike_mult  # [S]
+        # The mechanisms price off the PRE-clearing book: the proposed net
+        # powers (matched + residual = p_grid + p_p2p), not the matched
+        # trades — matched volumes balance by construction, which would
+        # pin the uniform price's imbalance tilt at exactly zero.
+        demand_w, supply_w = trade_volumes(p_grid + p_p2p)
+        trade_eff = mechanism_trade_price(
+            rp.mechanism, buy_eff, inj, demand_w, supply_w, rp.auction_k
+        )
+        # Islanding: the grid tie is open — matched P2P trades stand,
+        # the grid residual is physically curtailed. Unserved LOAD
+        # (positive residual) bills at the value-of-lost-load price;
+        # spilled surplus earns nothing.
+        p_grid_eff = jnp.where(islanded[:, None], 0.0, p_grid)
+        curtailed = p_grid - p_grid_eff  # [S, A], nonzero only islanded
+        cost = compute_costs(
+            p_grid_eff, p_p2p, buy_eff[:, None], inj[:, None],
+            trade_eff[:, None], cfg.sim.slot_hours,
+        )
+        cost = cost + (
+            jnp.maximum(curtailed, 0.0)
+            * rp.curtail_price_eur_kwh[:, None]
+            * cfg.sim.slot_hours
+            * 1e-3
+        )
+        cost = cost + (
+            miss_ws / KWH_TO_WS * rp.ev_miss_price_eur_kwh[:, None]
+        )
+        recorded["p_grid"] = p_grid_eff
+        recorded["curtailed"] = curtailed
+        recorded["buy"] = buy_eff
+        recorded["trade"] = trade_eff
+        return cost
+
+    phys_s, pol_state, outputs, transition, explore_state = (
+        slot_dynamics_batched(
+            cfg, policy, pol_state, phys_s, xs_mod, key, ratings,
+            explore=explore, settlement_hook=settlement, act_fn=act_fn,
+            explore_state=explore_state, fused=False,
+        )
+    )
+    outputs = outputs._replace(
+        p_grid=recorded["p_grid"],
+        buy_price=recorded["buy"],
+        trade_price=recorded["trade"],
+    )
+    extras = {
+        "ev_power_w": ev_power,
+        "curtailed_w": recorded["curtailed"],
+        "ev_miss_ws": miss_ws,
+    }
+    return (
+        phys_s, pol_state, outputs, transition, explore_state, ev_need,
+        extras,
+    )
+
+
+class RegimeCounters(NamedTuple):
+    """Per-regime episode totals ([R] leaves) — the regime-attributed
+    mirror of ``telemetry.DeviceCounters``, accumulated through the scan
+    carry and reduced to host numbers once per device call."""
+
+    cost_eur: jnp.ndarray            # [R] settlement cost (incl. penalties)
+    reward: jnp.ndarray              # [R] agent-mean reward sum
+    comfort_violations: jnp.ndarray  # [R] agent-slots outside the band
+    trade_wh: jnp.ndarray            # [R] P2P-matched energy
+    grid_wh: jnp.ndarray             # [R] |grid| energy (post-islanding)
+    curtailed_wh: jnp.ndarray        # [R] islanded unserved-load energy
+    ev_charged_wh: jnp.ndarray       # [R] EV energy delivered
+    ev_missed_wh: jnp.ndarray        # [R] EV energy undelivered at deadline
+
+
+def rc_zero(n_regimes: int) -> RegimeCounters:
+    z = jnp.zeros((n_regimes,), jnp.float32)
+    return RegimeCounters(z, z, z, z, z, z, z, z)
+
+
+def rc_add(a: RegimeCounters, b: RegimeCounters) -> RegimeCounters:
+    return RegimeCounters(*(x + y for x, y in zip(a, b)))
+
+
+def rc_from_slot(
+    cfg: ExperimentConfig,
+    outputs,
+    extras: dict,
+    one_hot_sr: jnp.ndarray,  # [S, R] assignment one-hot
+) -> RegimeCounters:
+    """One slot's per-regime counter contribution: agent-axis reductions
+    followed by one [S] x [S, R] segment matvec per series."""
+    th = cfg.thermal
+    hours = cfg.sim.slot_hours
+    seg = lambda x_s: x_s @ one_hot_sr  # [S] -> [R]
+    t = outputs.t_in
+    return RegimeCounters(
+        cost_eur=seg(jnp.sum(outputs.cost, axis=-1)),
+        reward=seg(jnp.mean(outputs.reward, axis=-1)),
+        comfort_violations=seg(
+            jnp.sum(
+                ((t < th.lower_bound) | (t > th.upper_bound)).astype(
+                    jnp.float32
+                ),
+                axis=-1,
+            )
+        ),
+        trade_wh=seg(
+            jnp.sum(jnp.maximum(outputs.p_p2p, 0.0), axis=-1) * hours
+        ),
+        grid_wh=seg(jnp.sum(jnp.abs(outputs.p_grid), axis=-1) * hours),
+        curtailed_wh=seg(
+            jnp.sum(jnp.maximum(extras["curtailed_w"], 0.0), axis=-1)
+            * hours
+        ),
+        ev_charged_wh=seg(
+            jnp.sum(extras["ev_power_w"], axis=-1) * hours
+        ),
+        ev_missed_wh=seg(
+            jnp.sum(extras["ev_miss_ws"], axis=-1) / 3600.0
+        ),
+    )
+
+
+def rc_to_dicts(
+    rc: RegimeCounters, regime_names: Optional[list] = None
+) -> list:
+    """Host-side per-regime dicts (one transfer per leaf pytree)."""
+    import numpy as np
+
+    # host-sync: the once-per-call counter transfer (mirrors dc_to_dict).
+    leaves = {name: np.asarray(v) for name, v in rc._asdict().items()}
+    n = next(iter(leaves.values())).shape[0]
+    names = regime_names or [f"regime_{i}" for i in range(n)]
+    return [
+        {
+            "regime": names[i],
+            **{k: float(v[i]) for k, v in leaves.items()},
+        }
+        for i in range(n)
+    ]

@@ -1,0 +1,237 @@
+"""Per-regime greedy evaluation: the regime dimension of every verdict.
+
+``make_regime_eval`` is the regime-portfolio mirror of
+``train.health.make_greedy_eval``: one jitted greedy (explore=False)
+episode over a FIXED held-out mixed-regime scenario batch, returning
+per-regime cost/reward vectors plus the per-regime ``RegimeCounters`` —
+one compiled program regardless of how many regimes the portfolio mixes.
+
+``evaluate_regimes`` is the host-facing table builder: per-regime dicts
+(cost, reward, comfort, trade/grid/curtailed energy, EV delivery), each
+also emitted as a ``regime_eval`` telemetry event so the warehouse's
+``telemetry-query --regimes`` view can aggregate them per config_hash.
+
+``evaluate_bundle_regimes`` grafts a serving BUNDLE's greedy subtree into
+a fresh learner (train/continual.state_from_bundle) and runs the same
+fixed eval — both sides of a promotion-gate comparison see identical
+scenarios, regimes, physics and keys, so the only free variable is the
+policy (the per-regime no-regression rule of ``serve/promotion.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.envs.community import AgentRatings, init_physical
+from p2pmicrogrid_tpu.regimes.engine import (
+    apply_weather_regimes,
+    init_ev_need,
+    rc_add,
+    rc_from_slot,
+    rc_to_dicts,
+    rc_zero,
+    regime_slot_batched,
+)
+from p2pmicrogrid_tpu.regimes.train import RegimePortfolio, build_portfolio
+
+# Held-out eval draws: distinct from training episode keys AND from the
+# health eval's fixed set (train/health.py uses 10_000).
+REGIME_EVAL_SEED = 20_000
+
+
+def make_regime_eval(
+    cfg: ExperimentConfig,
+    policy,
+    ratings,
+    portfolio: RegimePortfolio,
+    s_per_regime: int = 4,
+    eval_seed: int = REGIME_EVAL_SEED,
+):
+    """Jitted ``fn(pol_state, key) -> (cost_r [R], reward_r [R],
+    RegimeCounters)`` over a fixed held-out batch of ``R * s_per_regime``
+    scenarios (regime r owns scenarios [r*s, (r+1)*s) — block assignment,
+    so per-regime means are exact segment means). Weather is applied
+    inside the program from the portfolio's params."""
+    from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+
+    R = portfolio.n_regimes
+    S = R * s_per_regime
+    block_assignment = np.repeat(np.arange(R), s_per_regime).astype(np.int32)
+    pf = build_portfolio(list(portfolio.specs), S, assignment=block_assignment)
+    eval_arrays = device_episode_arrays(
+        cfg, jax.random.PRNGKey(eval_seed), ratings, S
+    )
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    impl = cfg.train.implementation
+
+    act_fn = None
+    if impl == "ddpg":
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+
+        def act_fn(p, obs_s, prev, round_key, ex):
+            frac, q, _ = ddpg_shared_act(
+                cfg.ddpg, p, obs_s, jnp.zeros(obs_s.shape[:2]),
+                round_key, explore=False,
+            )
+            return frac, frac, q, ex
+
+    counts = jnp.sum(pf.one_hot, axis=0)  # [R] scenarios per regime
+
+    @jax.jit
+    def regime_eval(pol_state, key, rp):
+        k_phys, k_scan = jax.random.split(key)
+        phys = jax.vmap(lambda k: init_physical(cfg, k))(
+            jax.random.split(k_phys, S)
+        )
+        arrs = apply_weather_regimes(eval_arrays, rp)
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrs)
+        xs = (xs.time, xs.t_out, xs.load_w, xs.pv_w,
+              xs.next_time, xs.next_load_w, xs.next_pv_w)
+        ev0 = init_ev_need(rp, cfg.sim.n_agents)
+
+        def slot(carry, xs_t):
+            phys_s, ev_need, kk, rc = carry
+            kk, k_act = jax.random.split(kk)
+            phys_s, _, out, _, _, ev_need, extras = regime_slot_batched(
+                cfg, policy, pol_state, phys_s, ev_need, xs_t, k_act,
+                ratings_j, rp, explore=False, act_fn=act_fn,
+            )
+            rc = rc_add(rc, rc_from_slot(cfg, out, extras, pf.one_hot))
+            return (phys_s, ev_need, kk, rc), (out.cost, out.reward)
+
+        (_, _, _, rc), (cost, reward) = jax.lax.scan(
+            slot, (phys, ev0, k_scan, rc_zero(R)), xs
+        )
+        # cost [T, S, A] -> per-scenario episode cost [S] -> regime mean.
+        cost_s = jnp.sum(cost, axis=(0, 2))
+        reward_s = jnp.sum(jnp.mean(reward, axis=-1), axis=0)
+        cost_r = (cost_s @ pf.one_hot) / counts
+        reward_r = (reward_s @ pf.one_hot) / counts
+        return cost_r, reward_r, rc
+
+    def eval_fn(pol_state, key, rp=None):
+        return regime_eval(
+            pol_state, key, pf.scenario_params if rp is None else rp
+        )
+
+    eval_fn.jitted = regime_eval
+    eval_fn.portfolio = pf
+    eval_fn.s_per_regime = s_per_regime
+    return eval_fn
+
+
+def evaluate_regimes(
+    cfg: ExperimentConfig,
+    policy,
+    pol_state,
+    ratings,
+    regimes: Sequence,
+    key: Optional[jax.Array] = None,
+    s_per_regime: int = 4,
+    eval_seed: int = REGIME_EVAL_SEED,
+    telemetry=None,
+    held_out: bool = False,
+    eval_fn=None,
+    bundle: Optional[str] = None,
+) -> list:
+    """Per-regime greedy eval table: one dict per regime with the cost /
+    reward / counter breakdown, telemetry ``regime_eval`` events included
+    when a telemetry is bound (the warehouse rows ``--regimes`` reads).
+
+    ``eval_fn`` (a prior ``make_regime_eval`` result for the SAME regime
+    list) reuses its compiled program across candidates — the promotion
+    gate evaluates two bundles against one program. ``bundle`` tags the
+    emitted events with the evaluated policy's identity so the warehouse
+    view keeps two bundles of one config (the gate's candidate vs
+    incumbent) in separate rows instead of averaging them.
+    """
+    if eval_fn is None:
+        specs_portfolio = build_portfolio(list(regimes), len(list(regimes)))
+        eval_fn = make_regime_eval(
+            cfg, policy, ratings, specs_portfolio,
+            s_per_regime=s_per_regime, eval_seed=eval_seed,
+        )
+    if key is None:
+        key = jax.random.PRNGKey(eval_seed + 1)
+    cost_r, reward_r, rc = eval_fn(pol_state, key)
+    names = list(eval_fn.portfolio.names)
+    rows = rc_to_dicts(rc, names)
+    cost_r = np.asarray(cost_r)    # host-sync: eval table is a host artifact
+    reward_r = np.asarray(reward_r)  # host-sync: eval table is a host artifact
+    s = eval_fn.s_per_regime
+    out = []
+    for i, row in enumerate(rows):
+        d = {
+            "regime": names[i],
+            "held_out": bool(held_out),
+            "cost_eur": float(cost_r[i]),
+            "reward": float(reward_r[i]),
+            "n_scenarios": s,
+            # Counters are episode totals over the regime's scenario
+            # block; report per-scenario means so regimes stay comparable
+            # across block sizes.
+            **{
+                k: float(v) / s
+                for k, v in row.items()
+                if k not in ("regime", "cost_eur", "reward")
+            },
+        }
+        out.append(d)
+        if telemetry is not None:
+            attrs = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in d.items()
+            }
+            if bundle is not None:
+                attrs["bundle"] = bundle
+            telemetry.event("regime_eval", **attrs)
+    return out
+
+
+def evaluate_bundle_regimes(
+    cfg: ExperimentConfig,
+    bundle_dir: str,
+    regimes: Sequence,
+    s_per_regime: int = 4,
+    eval_seed: int = REGIME_EVAL_SEED,
+    eval_key: int = 1,
+    telemetry=None,
+    held_out: bool = False,
+    eval_fn=None,
+    bundle_tag: Optional[str] = None,
+) -> dict:
+    """Per-regime held-out eval of a serving BUNDLE:
+    ``{regime_name: cost_eur}`` (plus the full rows under ``"rows"``) —
+    the comparison input of the promotion gate's per-regime
+    no-regression rule. ``bundle_tag`` (default: the bundle dir's
+    basename) labels the telemetry events — two bundles of one config
+    stay distinguishable in the ``--regimes`` warehouse view."""
+    from p2pmicrogrid_tpu.envs import make_ratings
+    from p2pmicrogrid_tpu.serve.export import load_policy_bundle
+    from p2pmicrogrid_tpu.train import make_policy
+    from p2pmicrogrid_tpu.train.continual import state_from_bundle
+
+    import os
+
+    manifest, params = load_policy_bundle(bundle_dir)
+    ps = state_from_bundle(
+        cfg, manifest, params, jax.random.PRNGKey(cfg.train.seed)
+    )
+    policy = make_policy(cfg)
+    ratings = make_ratings(cfg, np.random.default_rng(cfg.train.seed))
+    if bundle_tag is None:
+        bundle_tag = os.path.basename(os.path.normpath(bundle_dir))
+    rows = evaluate_regimes(
+        cfg, policy, ps, ratings, regimes,
+        key=jax.random.PRNGKey(eval_key), s_per_regime=s_per_regime,
+        eval_seed=eval_seed, telemetry=telemetry, held_out=held_out,
+        eval_fn=eval_fn, bundle=bundle_tag,
+    )
+    out = {row["regime"]: row["cost_eur"] for row in rows}
+    out["rows"] = rows
+    return out

@@ -1,0 +1,378 @@
+"""Regime-portfolio episode programs for the existing trainers.
+
+``make_regime_episode_fn`` builds ONE jitted episode program over a mixed-
+regime scenario batch, signature-compatible with the drivers the repo
+already has:
+
+* ``mode="shared"`` — carry ``(pol_state, scen_state)``: plugs into
+  ``train_scenarios_shared(episode_fn=...)`` and — because the chunked
+  runner seeds per-chunk scen state through the same carry shape — into
+  ``train_scenarios_chunked(episode_fn=...)``.
+* ``mode="independent"`` — carry ``pol_state_s`` ([S]-stacked learners):
+  plugs into ``train_scenarios_independent(episode_fn=...)``.
+
+Regime fields enter the program as ARRAY ARGUMENTS (RegimeParams [S]
+leaves bound via a closure over traced values), never as static jit
+arguments: a 4-regime mixed batch, or a swap to an entirely different
+portfolio of the same batch shape, reuses the one compiled program —
+``episode.jitted._cache_size() == 1`` is asserted by the tests and the
+``regime_generalization`` bench row.
+
+The Pallas slot megakernel stages the BASELINE world only; requesting
+``fused`` with regimes refuses loudly here (same pattern as the
+ddpg/settlement_hook refusals) instead of producing silently-wrong fused
+output.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pmicrogrid_tpu.config import ExperimentConfig
+from p2pmicrogrid_tpu.envs.community import (
+    AgentRatings,
+    init_physical,
+    resolve_use_fused,
+)
+from p2pmicrogrid_tpu.regimes.engine import (
+    apply_weather_regimes,
+    init_ev_need,
+    rc_add,
+    rc_from_slot,
+    rc_zero,
+    regime_slot_batched,
+)
+from p2pmicrogrid_tpu.regimes.spec import (
+    RegimeParams,
+    RegimeSpec,
+    assign_regimes,
+    assignment_one_hot,
+    regime_assignment,
+    resolve_specs,
+    stack_regime_params,
+)
+
+
+class RegimePortfolio(NamedTuple):
+    """A resolved portfolio: R specs spread over S scenarios."""
+
+    specs: tuple                 # (RegimeSpec, ...) length R
+    names: tuple                 # regime names, length R
+    params: RegimeParams         # [R] leaves
+    scenario_params: RegimeParams  # [S] leaves (assigned)
+    assignment: np.ndarray       # [S] int32 scenario -> regime index
+    one_hot: jnp.ndarray         # [S, R] f32 segment matrix
+
+    @property
+    def n_regimes(self) -> int:
+        return len(self.specs)
+
+
+def build_portfolio(
+    regimes: Sequence, n_scenarios: int, assignment=None
+) -> RegimePortfolio:
+    """Resolve names/specs into a scenario-assigned portfolio (round-robin
+    by default, so every regime is covered as evenly as S allows)."""
+    specs = resolve_specs(regimes)
+    if assignment is None:
+        assignment = regime_assignment(n_scenarios, len(specs))
+    # host-sync: assignment is host metadata (one-time portfolio build).
+    assignment = np.asarray(assignment, dtype=np.int32)
+    if assignment.shape != (n_scenarios,):
+        raise ValueError(
+            f"assignment shape {assignment.shape} != ({n_scenarios},)"
+        )
+    params = stack_regime_params(specs)
+    return RegimePortfolio(
+        specs=tuple(specs),
+        names=tuple(s.name for s in specs),
+        params=params,
+        scenario_params=assign_regimes(params, assignment),
+        assignment=assignment,
+        one_hot=assignment_one_hot(assignment, len(specs)),
+    )
+
+
+def refuse_fused_regimes(specs: Optional[Sequence[RegimeSpec]] = None):
+    """The loud fused-path refusal (satellite of ISSUE 13): the megakernel
+    (ops/pallas_slot.py) stages the baseline world only — EV load,
+    islanding masks, price-spike windows and auction mechanisms do not
+    exist inside it, so a fused regime episode would be silently wrong,
+    not slow. Mirrors the ddpg/settlement_hook refusal pattern."""
+    features = None
+    if specs is not None:
+        found = []
+        for s in specs:
+            found.extend(
+                f for f in s.fused_unstageable_features() if f not in found
+            )
+        features = ", ".join(found) if found else None
+    raise ValueError(
+        "fused_slot=True / fused=True cannot stage regime features ("
+        + (features or "EV load, islanding masks, auction mechanisms")
+        + ") — the Pallas slot megakernel fuses the baseline world only. "
+        "Run regime episodes through the op chain: set fused=False and "
+        "leave SimConfig.fused_slot unset (None)."
+    )
+
+
+def make_regime_episode_fn(
+    cfg: ExperimentConfig,
+    policy,
+    ratings,
+    regimes: RegimeParams,
+    arrays_s=None,
+    arrays_fn: Optional[Callable] = None,
+    n_scenarios: Optional[int] = None,
+    mode: str = "shared",
+    record_only: bool = False,
+    collect_regime_metrics: bool = False,
+    one_hot: Optional[jnp.ndarray] = None,
+    donate: bool = False,
+    fused: Optional[bool] = None,
+    specs: Optional[Sequence[RegimeSpec]] = None,
+) -> Callable:
+    """One jitted mixed-regime training episode.
+
+    ``regimes`` carries [S] leaves (``build_portfolio(...).scenario_params``
+    or ``assign_regimes`` output). Episode inputs come from fixed
+    ``arrays_s`` ([S, T, ...], host-built) or a per-episode ``arrays_fn(key)
+    -> EpisodeArrays`` (``parallel.device_gen.device_episode_arrays`` — the
+    chunked transport); the WEATHER transform is applied inside the program
+    either way, so callers always pass baseline-family arrays.
+
+    ``collect_regime_metrics`` (needs ``one_hot`` [S, R]) threads
+    ``RegimeCounters`` through the scan and appends them to the ys tuple:
+    ``(rewards [S], losses [S], regime_counters [R]-leaves)``. Leave it off
+    for drop-in use with the chunked runner (which fixes its episode arity).
+
+    The returned callable has ``.jitted`` (the underlying jit — its
+    ``_cache_size()`` is the single-compile assertion) and
+    ``.with_regimes(rp)`` (same compiled program, different portfolio).
+    """
+    impl = cfg.train.implementation
+    if mode not in ("shared", "independent"):
+        raise ValueError(f"mode must be 'shared' or 'independent', got {mode!r}")
+    if fused is None:
+        fused = resolve_use_fused(cfg)
+    if fused:
+        refuse_fused_regimes(specs)
+    if mode == "independent" and impl == "ddpg":
+        raise ValueError(
+            "independent regime training supports tabular/dqn only (ddpg "
+            "advances OU state inside act, which the batched act hook "
+            "cannot thread per-learner); use mode='shared' for ddpg"
+        )
+    if record_only and (impl != "dqn" or mode != "shared"):
+        raise ValueError("record_only warmup applies to shared dqn only")
+    if (arrays_s is None) == (arrays_fn is None):
+        raise ValueError("pass exactly one of arrays_s or arrays_fn")
+    if arrays_fn is not None and n_scenarios is None:
+        raise ValueError("arrays_fn requires an explicit n_scenarios")
+    if arrays_s is not None:
+        n_scenarios = arrays_s.time.shape[0]
+    if regimes.temp_offset_c.shape[0] != n_scenarios:
+        raise ValueError(
+            f"regimes must carry [S]={n_scenarios} leaves (use "
+            "build_portfolio/assign_regimes), got "
+            f"[{regimes.temp_offset_c.shape[0]}]"
+        )
+    if collect_regime_metrics and one_hot is None:
+        raise ValueError("collect_regime_metrics requires one_hot [S, R]")
+
+    from p2pmicrogrid_tpu.parallel.scenarios import (
+        _ddpg_update_shared,
+        _dqn_update_shared,
+        _tabular_update_shared,
+        auto_scale_ddpg_lrs,
+    )
+    from p2pmicrogrid_tpu.models.replay import lockstep_replay_add
+
+    cfg = auto_scale_ddpg_lrs(cfg, n_scenarios)
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    S = n_scenarios
+    n_regimes = int(one_hot.shape[1]) if one_hot is not None else 0
+
+    act_fn = None
+    if mode == "shared" and impl == "ddpg":
+        from p2pmicrogrid_tpu.models.ddpg import ddpg_shared_act
+
+        def act_fn(params, obs_s, prev_frac_s, round_key, ou_s):
+            frac, q, ou_s = ddpg_shared_act(
+                cfg.ddpg, params, obs_s, ou_s, round_key
+            )
+            return frac, frac, q, ou_s
+
+    elif mode == "independent":
+
+        def act_fn(pol_state_s, obs_s, prev_frac_s, round_key, ex):
+            keys = jax.random.split(round_key, S)
+
+            def one(ps, o, f, k):
+                frac, aux, q, _ = policy.act(ps, o, f, k, True)
+                return frac, aux, q
+
+            frac, aux, q = jax.vmap(one)(pol_state_s, obs_s, prev_frac_s, keys)
+            return frac, aux, q, ex
+
+    def slot(rp, carry, xs_t):
+        (phys_s, ev_need, pol_state, scen_state, key), rc = carry
+        key, k_act, k_learn = jax.random.split(key, 3)
+        ex = scen_state.ou if (mode == "shared" and impl == "ddpg") else None
+        phys_s, _, outputs_s, tr_s, ex, ev_need, extras = regime_slot_batched(
+            cfg, policy, pol_state, phys_s, ev_need, xs_t, k_act, ratings_j,
+            rp, explore=True, act_fn=act_fn, explore_state=ex,
+        )
+        if mode == "independent":
+            keys = jax.random.split(k_learn, S)
+            pol_state, loss_sa = jax.vmap(policy.learn)(
+                pol_state, tr_s.obs, tr_s.aux, tr_s.reward, tr_s.next_obs,
+                keys,
+            )
+            loss = jnp.mean(loss_sa, axis=-1)
+        elif impl == "tabular":
+            pol_state, loss = _tabular_update_shared(cfg, pol_state, tr_s, k_learn)
+        elif impl == "dqn":
+            if record_only:
+                from p2pmicrogrid_tpu.models.dqn import ACTION_VALUES
+
+                act_frac = ACTION_VALUES[tr_s.aux.astype(jnp.int32)][..., None]
+                scen_state = lockstep_replay_add(
+                    scen_state, tr_s.obs, act_frac, tr_s.reward, tr_s.next_obs
+                )
+                loss = jnp.zeros((S,))
+            else:
+                pol_state, scen_state, loss = _dqn_update_shared(
+                    cfg, pol_state, scen_state, tr_s, k_learn
+                )
+        else:
+            scen_state = scen_state._replace(ou=ex)
+            pol_state, scen_state, loss = _ddpg_update_shared(
+                cfg, pol_state, scen_state, tr_s, k_learn
+            )
+        if collect_regime_metrics:
+            rc = rc_add(rc, rc_from_slot(cfg, outputs_s, extras, one_hot))
+        return ((phys_s, ev_need, pol_state, scen_state, key), rc), (
+            jnp.mean(outputs_s.reward, axis=-1),
+            loss,
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def _episode(carry, key, rp):
+        if mode == "shared":
+            pol_state, scen_state = carry
+        else:
+            pol_state, scen_state = carry, None
+        k_phys, k_scan, k_gen = jax.random.split(key, 3)
+        phys_s = jax.vmap(lambda k: init_physical(cfg, k))(
+            jax.random.split(k_phys, S)
+        )
+        arrs = arrays_s if arrays_fn is None else arrays_fn(k_gen)
+        arrs = apply_weather_regimes(arrs, rp)
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrs)
+        xs = (
+            xs.time, xs.t_out, xs.load_w, xs.pv_w,
+            xs.next_time, xs.next_load_w, xs.next_pv_w,
+        )
+        ev0 = init_ev_need(rp, cfg.sim.n_agents)
+        rc0 = rc_zero(n_regimes) if collect_regime_metrics else None
+        inner0 = (phys_s, ev0, pol_state, scen_state, k_scan)
+        ((phys_s, _, pol_state, scen_state, _), rc), (rewards, losses) = (
+            jax.lax.scan(
+                functools.partial(slot, rp), (inner0, rc0), xs,
+                unroll=cfg.sim.slot_unroll,
+            )
+        )
+        ys = (jnp.sum(rewards, axis=0), jnp.mean(losses, axis=0))
+        if collect_regime_metrics:
+            ys = ys + (rc,)
+        out_carry = (
+            (pol_state, scen_state) if mode == "shared" else pol_state
+        )
+        return out_carry, ys
+
+    def bind(rp):
+        def episode(carry, key):
+            return _episode(carry, key, rp)
+
+        episode.jitted = _episode
+        episode.regimes = rp
+        episode.with_regimes = bind
+        return episode
+
+    return bind(regimes)
+
+
+def train_regime_portfolio(
+    cfg: ExperimentConfig,
+    policy,
+    pol_state,
+    scen_state,
+    ratings,
+    portfolio: RegimePortfolio,
+    key: jax.Array,
+    n_episodes: int,
+    arrays_s=None,
+    arrays_fn=None,
+    n_scenarios: Optional[int] = None,
+    telemetry=None,
+    episode_cb: Optional[Callable] = None,
+    fused: Optional[bool] = None,
+):
+    """Portfolio trainer with per-regime attribution: a simple synchronous
+    driver over a collecting shared-mode episode program. Every episode
+    emits one ``regime_counters`` telemetry event (per-regime cost /
+    comfort / trade / curtailment / EV totals) — the training-side mirror
+    of the per-regime eval events. For the pipelined/donating production
+    paths, build a non-collecting episode fn and hand it to the existing
+    ``train_scenarios_*`` drivers instead.
+
+    Returns ``(pol_state, scen_state, rewards [E, S], losses [E, S],
+    regime_counters_per_episode: list of per-regime dict lists)``.
+    """
+    from p2pmicrogrid_tpu.regimes.engine import rc_to_dicts
+
+    episode_fn = make_regime_episode_fn(
+        cfg, policy, ratings, portfolio.scenario_params,
+        arrays_s=arrays_s, arrays_fn=arrays_fn, n_scenarios=n_scenarios,
+        mode="shared", collect_regime_metrics=True,
+        one_hot=portfolio.one_hot, fused=fused, specs=portfolio.specs,
+    )
+    from p2pmicrogrid_tpu.parallel.scenarios import _episode_key_schedule
+
+    keys = _episode_key_schedule(key, n_episodes)
+    decay_every = cfg.train.min_episodes_criterion
+    carry = (pol_state, scen_state)
+    rewards, losses, rc_all = [], [], []
+    for e in range(n_episodes):
+        carry, ys = episode_fn(carry, keys[e])
+        if decay_every and e % decay_every == 0:
+            carry = (policy.decay(carry[0]), carry[1])
+        r, l, rc = ys
+        # host-sync: synchronous attribution driver by design (the
+        # pipelined production path plugs a non-collecting episode fn
+        # into train_scenarios_* instead; see docstring).
+        rewards.append(np.asarray(r))
+        losses.append(np.asarray(l))  # host-sync: same (attribution driver)
+        dicts = rc_to_dicts(rc, list(portfolio.names))
+        rc_all.append(dicts)
+        if telemetry is not None:
+            telemetry.event(
+                "regime_counters", episode=e, phase="train",
+                regimes=[
+                    {k: (round(v, 4) if isinstance(v, float) else v)
+                     for k, v in d.items()}
+                    for d in dicts
+                ],
+            )
+        if episode_cb:
+            episode_cb(e, r, l, carry)
+    pol_state, scen_state = carry
+    return (
+        pol_state, scen_state, np.stack(rewards), np.stack(losses), rc_all
+    )

@@ -81,6 +81,14 @@ class GateBudgets:
     slo_p95_ms: float = 100.0
     slo_p99_ms: float = 250.0
     max_shed_rate: float = 0.05
+    # Per-regime no-regression rule (ISSUE 13): when the gate is given a
+    # held-out regime set (``run_promotion_gate(regime_specs=...)``), the
+    # candidate may not regress ANY regime's held-out eval cost by more
+    # than ``max(|incumbent|, 1) * max_regime_regression`` — a candidate
+    # that improves the MEAN by winning the easy worlds while losing a
+    # cold snap or an islanding event does not ship. 0.0 = any regression
+    # beyond float-noise scale blocks.
+    max_regime_regression: float = 0.0
     # Quantized-candidate error budget (serve/export.py's int8 contract):
     # a continuous int8 candidate's MEASURED max ulp (manifest
     # quant.error_bound.max_ulp) must stay within this budget; None defers
@@ -129,6 +137,10 @@ class GateVerdict:
     p99_ms: float
     shed_rate: float
     reasons: List[str] = field(default_factory=list)
+    # Per-regime held-out eval costs (regime name -> EUR), populated when
+    # the gate ran with a regime set; empty otherwise.
+    candidate_regime_costs: dict = field(default_factory=dict)
+    incumbent_regime_costs: dict = field(default_factory=dict)
 
     @property
     def verdict(self) -> str:
@@ -147,6 +159,14 @@ class GateVerdict:
             "p99_ms": round(self.p99_ms, 3),
             "shed_rate": round(self.shed_rate, 6),
             "reasons": list(self.reasons),
+            "candidate_regime_costs": {
+                k: _round_or_none(v)
+                for k, v in self.candidate_regime_costs.items()
+            },
+            "incumbent_regime_costs": {
+                k: _round_or_none(v)
+                for k, v in self.incumbent_regime_costs.items()
+            },
         }
 
 
@@ -196,6 +216,10 @@ def run_promotion_gate(
     service_time_fn: Optional[Callable[[int, int], float]] = None,
     device: str = "auto",
     incumbent_eval: Optional[Tuple[float, float]] = None,
+    regime_specs: Optional[Sequence] = None,
+    regime_s_per_regime: int = 4,
+    regime_eval_fn: Optional[Callable[[str], dict]] = None,
+    incumbent_regime_eval: Optional[dict] = None,
 ) -> GateVerdict:
     """The offline promotion gate (module docstring). ``service_time_fn``
     overrides the SLO bench's batch timing (the deterministic modeled
@@ -205,7 +229,16 @@ def run_promotion_gate(
     candidates against one. A candidate already condemned by the
     poison/eval checks skips the SLO bench entirely (engine compile +
     bench wall-clock buys nothing on a verdict that cannot flip); the
-    verdict's SLO fields read 0 in that case."""
+    verdict's SLO fields read 0 in that case.
+
+    ``regime_specs`` (regime names / ``regimes.RegimeSpec``s) turns on the
+    per-regime dimension: both bundles run the held-out mixed-regime eval
+    (``regimes.evaluate.evaluate_bundle_regimes`` — one compiled program
+    shared by both sides) and the candidate may not regress ANY regime
+    beyond ``budgets.max_regime_regression``, even when its mean cost
+    improves. ``regime_eval_fn(bundle_dir) -> {regime: cost}`` overrides
+    the evaluator (tests/harness); ``incumbent_regime_eval`` reuses a
+    prior incumbent result across many candidates."""
     from p2pmicrogrid_tpu.serve.engine import PolicyEngine
     from p2pmicrogrid_tpu.serve.export import load_policy_bundle
     from p2pmicrogrid_tpu.serve.loadgen import serve_bench
@@ -315,6 +348,76 @@ def run_promotion_gate(
                     "cost savings bought with comfort do not ship"
                 )
 
+    # Per-regime no-regression rule (ISSUE 13): runs only while the
+    # candidate is still in the running (a mean-eval failure cannot be
+    # flipped by regime wins), BEFORE the SLO bench (regime evals are the
+    # cheaper check and a regime regression makes the bench moot).
+    cand_regimes: dict = {}
+    inc_regimes: dict = {}
+    want_regimes = regime_specs is not None or regime_eval_fn is not None
+    if not reasons and want_regimes:
+        if regime_eval_fn is None:
+            from p2pmicrogrid_tpu.regimes import build_portfolio, make_regime_eval
+            from p2pmicrogrid_tpu.regimes.evaluate import (
+                evaluate_bundle_regimes,
+            )
+            from p2pmicrogrid_tpu.envs import make_ratings
+            from p2pmicrogrid_tpu.train import make_policy
+
+            specs = list(regime_specs)
+            shared_eval_fn = make_regime_eval(
+                cfg, make_policy(cfg),
+                make_ratings(cfg, np.random.default_rng(cfg.train.seed)),
+                build_portfolio(specs, len(specs)),
+                s_per_regime=regime_s_per_regime,
+            )
+
+            def regime_eval_fn(bundle_dir):
+                role = (
+                    "candidate" if bundle_dir == candidate_dir
+                    else "incumbent"
+                )
+                return evaluate_bundle_regimes(
+                    cfg, bundle_dir, specs,
+                    s_per_regime=regime_s_per_regime,
+                    eval_fn=shared_eval_fn, held_out=True,
+                    telemetry=telemetry, bundle_tag=role,
+                )
+
+        cand_regimes = {
+            k: v for k, v in regime_eval_fn(candidate_dir).items()
+            if isinstance(v, (int, float))
+        }
+        inc_regimes = {
+            k: v
+            for k, v in (
+                incumbent_regime_eval or regime_eval_fn(incumbent_dir)
+            ).items()
+            if isinstance(v, (int, float))
+        }
+        for name in sorted(inc_regimes):
+            if name not in cand_regimes:
+                reasons.append(
+                    f"candidate regime eval is missing held-out regime "
+                    f"{name!r}"
+                )
+                continue
+            c, i = cand_regimes[name], inc_regimes[name]
+            if not (math.isfinite(c) and math.isfinite(i)):
+                reasons.append(
+                    f"non-finite held-out eval on regime {name!r} "
+                    f"(candidate {c}, incumbent {i})"
+                )
+                continue
+            ceiling = i + max(abs(i), 1.0) * budgets.max_regime_regression
+            if c > ceiling:
+                reasons.append(
+                    f"candidate regresses held-out regime {name!r} "
+                    f"({c:.4f} vs incumbent {i:.4f}, ceiling "
+                    f"{ceiling:.4f}) — mean-cost wins do not excuse a "
+                    "worst-regime loss"
+                )
+
     p95 = p99 = shed_rate = 0.0
     if not reasons:
         # Only a candidate still in the running pays the SLO bench (the
@@ -360,6 +463,8 @@ def run_promotion_gate(
         p99_ms=p99,
         shed_rate=shed_rate,
         reasons=reasons,
+        candidate_regime_costs=cand_regimes,
+        incumbent_regime_costs=inc_regimes,
     )
     if telemetry is not None:
         telemetry.event("promotion", phase="gate", **verdict.to_fields())
@@ -1027,6 +1132,8 @@ def run_promotion_pipeline(
     max_batch: int = 16,
     gate_service_time_fn: Optional[Callable[[int, int], float]] = None,
     incumbent_eval: Optional[Tuple[float, float]] = None,
+    regime_specs: Optional[Sequence] = None,
+    regime_s_per_regime: int = 4,
 ) -> dict:
     """Gate + canary for ONE candidate against a live in-process gateway.
 
@@ -1061,6 +1168,8 @@ def run_promotion_pipeline(
             s_eval=s_eval, bench_seed=seed, max_batch=max_batch,
             service_time_fn=gate_service_time_fn,
             incumbent_eval=incumbent_eval,
+            regime_specs=regime_specs,
+            regime_s_per_regime=regime_s_per_regime,
         )
         gate_fields = verdict.to_fields()
         if not verdict.passed:

@@ -1,0 +1,812 @@
+"""Scenario regime engine (ISSUE 13): tier-1 acceptance.
+
+Market-mechanism equivalences (symmetric bids reduce bit-for-bit to the
+midpoint rule) and per-slot conservation across all mechanisms, islanding
+zero-grid clearing, EV deadline constraints, the neutral-regime bitwise
+identity with the plain shared episode program, the single-compile
+mixed-regime batch (no per-regime retrace), trainer integration
+(shared/independent/chunked), the fused-path loud refusal, the promotion
+gate's per-regime no-regression rule, the warehouse --regimes view, and
+the REGIME_*.jsonl capture schema. JAX_PLATFORMS=cpu-safe and fast.
+"""
+
+import json
+import os
+import sqlite3
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.envs import make_ratings
+from p2pmicrogrid_tpu.ops.auction import (
+    MECH_DOUBLE_AUCTION,
+    MECH_MIDPOINT,
+    MECH_UNIFORM,
+    double_auction_price,
+    mechanism_trade_price,
+    trade_volumes,
+    uniform_clearing_price,
+)
+from p2pmicrogrid_tpu.ops.tariff import p2p_price
+from p2pmicrogrid_tpu.parallel import (
+    init_shared_state,
+    make_scenario_traces,
+    stack_scenario_arrays,
+)
+from p2pmicrogrid_tpu.parallel.scenarios import make_shared_episode_fn
+from p2pmicrogrid_tpu.regimes import (
+    REGIME_LIBRARY,
+    RegimeSpec,
+    apply_weather_regimes,
+    build_portfolio,
+    ev_charge_step,
+    init_ev_need,
+    make_regime_episode_fn,
+    make_regime_eval,
+    regime_slot_batched,
+    resolve_specs,
+)
+from p2pmicrogrid_tpu.train import make_policy
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import check_artifacts_schema as schema  # noqa: E402
+
+
+def _cfg(n_agents=3, n_scenarios=4, impl="tabular", **sim_kw):
+    return default_config(
+        sim=SimConfig(n_agents=n_agents, n_scenarios=n_scenarios, **sim_kw),
+        train=TrainConfig(implementation=impl),
+    )
+
+
+@pytest.fixture(scope="module")
+def world():
+    """Shared cfg/ratings/arrays/policy for the episode-program tests."""
+    cfg = _cfg()
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    traces = make_scenario_traces(cfg)
+    arrays = stack_scenario_arrays(cfg, traces, ratings)
+    policy = make_policy(cfg)
+    return cfg, ratings, arrays, policy
+
+
+# -- market mechanisms ---------------------------------------------------------
+
+
+class TestMechanisms:
+    buy = jnp.asarray(np.linspace(0.08, 0.17, 7).astype(np.float32))
+    inj = jnp.full((7,), 0.07, dtype=jnp.float32)
+
+    def test_symmetric_bids_reduce_bitwise_to_midpoint(self):
+        """The satellite equivalence: a balanced book (symmetric bids) and
+        the symmetric spread split k=0.5 reproduce the midpoint rule
+        BIT-FOR-BIT, not just approximately."""
+        demand = jnp.full((7,), 1234.5, dtype=jnp.float32)
+        supply = jnp.full((7,), 1234.5, dtype=jnp.float32)
+        mid = p2p_price(self.buy, self.inj)
+        da = double_auction_price(self.buy, self.inj, demand, supply, k=0.5)
+        up = uniform_clearing_price(self.buy, self.inj, demand, supply)
+        assert np.asarray(da).tobytes() == np.asarray(mid).tobytes()
+        assert np.asarray(up).tobytes() == np.asarray(mid).tobytes()
+
+    def test_double_auction_k_extremes(self):
+        demand = jnp.ones((7,))
+        supply = jnp.ones((7,))
+        lo = double_auction_price(self.buy, self.inj, demand, supply, k=0.0)
+        hi = double_auction_price(self.buy, self.inj, demand, supply, k=1.0)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(self.inj), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(hi), np.asarray(self.buy), rtol=1e-6)
+
+    def test_uniform_price_tilts_toward_scarce_side(self):
+        mid = np.asarray(p2p_price(self.buy, self.inj))
+        heavy_demand = np.asarray(
+            uniform_clearing_price(self.buy, self.inj, 3000.0, 1000.0)
+        )
+        heavy_supply = np.asarray(
+            uniform_clearing_price(self.buy, self.inj, 1000.0, 3000.0)
+        )
+        assert (heavy_demand > mid).all()
+        assert (heavy_supply < mid).all()
+        assert (heavy_demand <= np.asarray(self.buy) + 1e-9).all()
+        assert (heavy_supply >= np.asarray(self.inj) - 1e-9).all()
+
+    def test_mixed_batch_dispatch_elementwise(self):
+        buy = jnp.asarray([0.15, 0.15, 0.15], dtype=jnp.float32)
+        inj = jnp.asarray([0.07, 0.07, 0.07], dtype=jnp.float32)
+        demand = jnp.asarray([900.0, 900.0, 900.0])
+        supply = jnp.asarray([300.0, 300.0, 300.0])
+        mech = jnp.asarray(
+            [MECH_MIDPOINT, MECH_DOUBLE_AUCTION, MECH_UNIFORM],
+            dtype=jnp.int32,
+        )
+        out = np.asarray(
+            mechanism_trade_price(mech, buy, inj, demand, supply, 0.8)
+        )
+        assert out[0] == np.asarray(p2p_price(buy, inj))[0]
+        assert out[1] == np.asarray(
+            double_auction_price(buy, inj, demand, supply, 0.8)
+        )[1]
+        assert out[2] == np.asarray(
+            uniform_clearing_price(buy, inj, demand, supply)
+        )[2]
+
+    def test_trade_volumes(self):
+        p2p = jnp.asarray([[100.0, -40.0, 0.0], [-10.0, 20.0, 30.0]])
+        d, s = trade_volumes(p2p)
+        np.testing.assert_allclose(np.asarray(d), [100.0, 50.0])
+        np.testing.assert_allclose(np.asarray(s), [40.0, 10.0])
+
+
+# -- regime slot physics -------------------------------------------------------
+
+
+def _tiled_arrays(cfg_one, ratings, n):
+    """One scenario draw tiled to n identical scenarios — isolates the
+    regime axis (every scenario sees the same physics)."""
+    traces = make_scenario_traces(cfg_one, n_scenarios=1)
+    arrays1 = stack_scenario_arrays(
+        cfg_one.replace(sim=SimConfig(
+            n_agents=cfg_one.sim.n_agents, n_scenarios=1
+        )), traces, ratings,
+    )
+    tile = lambda x: jnp.tile(x, (n,) + (1,) * (x.ndim - 1))
+    return jax.tree_util.tree_map(tile, arrays1)
+
+
+@pytest.fixture(scope="module")
+def slot_outputs():
+    """Per-slot outputs of one greedy episode over 5 IDENTICAL scenarios
+    assigned to: midpoint, double_auction, uniform_price, islanding_noon,
+    dr_spike. Shared by the conservation/islanding/event tests."""
+    # rounds=0 (single decision pass, equal-split book): the reference's
+    # proportional negotiation branch degenerates to zero matches for
+    # one-buyer/many-seller books at rounds>=1, and the price-
+    # differentiation assertion below needs actual trades.
+    cfg = _cfg(n_agents=3, n_scenarios=5, rounds=0)
+    ratings = make_ratings(cfg, np.random.default_rng(42))
+    arrays = _tiled_arrays(cfg, ratings, 5)
+    # Agent 0 loses its rooftop PV so the midday P2P book is two-sided —
+    # agents 1-2 run a solar surplus while agent 0 buys; the mask is
+    # identical across scenarios, so mechanism-independence still holds.
+    pv_mask = jnp.asarray([0.0, 1.0, 1.0], dtype=jnp.float32)
+    arrays = arrays._replace(
+        pv_w=arrays.pv_w * pv_mask, next_pv_w=arrays.next_pv_w * pv_mask
+    )
+    policy = make_policy(cfg)
+    ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+    pf = build_portfolio(
+        ["baseline", "double_auction", "uniform_price", "islanding_noon",
+         "dr_spike"],
+        5,
+        assignment=np.arange(5),
+    )
+    from p2pmicrogrid_tpu.envs.community import AgentRatings, init_physical
+
+    ratings_j = AgentRatings(*(jnp.asarray(a) for a in ratings))
+    rp = pf.scenario_params
+
+    @jax.jit
+    def greedy_episode(pol_state, key):
+        k_phys, k_scan = jax.random.split(key)
+        # One shared physical init tiled over scenarios: identical physics.
+        phys1 = init_physical(cfg, k_phys)
+        phys = jax.tree_util.tree_map(
+            lambda x: jnp.tile(x[None], (5, 1)), phys1
+        )
+        xs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), arrays)
+        xs = (xs.time, xs.t_out, xs.load_w, xs.pv_w,
+              xs.next_time, xs.next_load_w, xs.next_pv_w)
+        ev0 = init_ev_need(rp, cfg.sim.n_agents)
+
+        def slot(carry, xs_t):
+            phys_s, ev_need, kk = carry
+            kk, k_act = jax.random.split(kk)
+            phys_s, _, out, _, _, ev_need, extras = regime_slot_batched(
+                cfg, policy, pol_state, phys_s, ev_need, xs_t, k_act,
+                ratings_j, rp, explore=False,
+            )
+            return (phys_s, ev_need, kk), (out, extras["curtailed_w"])
+
+        _, (outs, curtailed) = jax.lax.scan(slot, (phys, ev0, k_scan), xs)
+        return outs, curtailed
+
+    outs, curtailed = greedy_episode(ps, jax.random.PRNGKey(3))
+    return cfg, pf, outs, np.asarray(curtailed)
+
+
+class TestConservation:
+    def test_matching_is_mechanism_independent(self, slot_outputs):
+        """Mechanisms set PRICES only: identical scenarios under midpoint /
+        double-auction / uniform clearing produce bit-identical physical
+        powers (p_grid, p_p2p) — conservation transfers across all three."""
+        _, _, outs, _ = slot_outputs
+        p_grid = np.asarray(outs.p_grid)   # [T, S, A]
+        p_p2p = np.asarray(outs.p_p2p)
+        for s in (1, 2):  # double_auction, uniform vs midpoint
+            assert np.array_equal(p_grid[:, 0], p_grid[:, s])
+            assert np.array_equal(p_p2p[:, 0], p_p2p[:, s])
+        # ... but the trade PRICES differ where trades exist.
+        tp = np.asarray(outs.trade_price)  # [T, S]
+        traded = np.abs(p_p2p).sum(axis=-1) > 0  # [T, S]
+        assert (tp[:, 1] != tp[:, 0])[traded[:, 1]].any()
+        # The uniform price tilts off midpoint too: it reads the PRE-
+        # clearing book (one buyer vs two sellers here — heavy supply), so
+        # its imbalance term is live, not pinned at zero by the balanced
+        # matched volumes.
+        assert (tp[:, 2] != tp[:, 0])[traded[:, 2]].any()
+
+    def test_per_slot_energy_conservation_all_mechanisms(self, slot_outputs):
+        """Matched P2P power nets to ~zero across agents every slot, for
+        every mechanism: every Watt bought peer-to-peer is a Watt sold."""
+        _, _, outs, _ = slot_outputs
+        p_p2p = np.asarray(outs.p_p2p)  # [T, S, A]
+        scale = np.abs(p_p2p).sum(axis=-1) + 1.0
+        np.testing.assert_allclose(
+            p_p2p.sum(axis=-1) / scale, 0.0, atol=1e-4
+        )
+
+    def test_islanding_clears_with_zero_grid_exchange(self, slot_outputs):
+        cfg, pf, outs, curtailed = slot_outputs
+        spec = REGIME_LIBRARY["islanding_noon"]
+        p_grid = np.asarray(outs.p_grid)  # [T, S, A]
+        window = np.arange(spec.outage_start_slot, spec.outage_end_slot)
+        outside = np.setdiff1d(np.arange(p_grid.shape[0]), window)
+        # Scenario 3 is the islanded one: zero grid exchange inside the
+        # window, EXACTLY (masked, not approximately).
+        assert (p_grid[window, 3] == 0.0).all()
+        # Outside the window it matches the baseline scenario bit-for-bit.
+        assert np.array_equal(p_grid[outside, 3], p_grid[outside, 0])
+        # The residual the grid would have carried is recorded curtailed
+        # (identical physics: the baseline scenario's grid power IS the
+        # islanded scenario's curtailment).
+        np.testing.assert_allclose(curtailed[window, 3], p_grid[window, 0])
+
+    def test_price_spike_multiplies_buy_price_in_window(self, slot_outputs):
+        _, _, outs, _ = slot_outputs
+        spec = REGIME_LIBRARY["dr_spike"]
+        buy = np.asarray(outs.buy_price)  # [T, S]
+        w = slice(spec.spike_start_slot, spec.spike_end_slot)
+        np.testing.assert_allclose(
+            buy[w, 4], buy[w, 0] * spec.spike_mult, rtol=1e-6
+        )
+        out_w = np.r_[0:spec.spike_start_slot, spec.spike_end_slot:96]
+        assert np.array_equal(buy[out_w, 4], buy[out_w, 0])
+        # Islanded scenario's cost >= baseline's (curtailment is billed).
+        cost = np.asarray(outs.cost).sum(axis=(0, 2))
+        assert cost[4] > cost[0]  # spike regime pays more
+
+
+class TestWeatherAndEv:
+    def test_weather_transform_and_neutral_identity(self, world):
+        cfg, ratings, arrays, _ = world
+        pf = build_portfolio(["winter", "summer", "baseline", "heatwave"], 4)
+        out = apply_weather_regimes(arrays, pf.scenario_params)
+        specs = {s.name: s for s in pf.specs}
+        np.testing.assert_allclose(
+            np.asarray(out.t_out[0]),
+            np.asarray(arrays.t_out[0]) + specs["winter"].temp_offset_c,
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.pv_w[1]),
+            np.asarray(arrays.pv_w[1]) * specs["summer"].pv_scale,
+            rtol=1e-6,
+        )
+        # Neutral regime (scenario 2: baseline) is the bitwise identity.
+        assert np.array_equal(np.asarray(out.t_out[2]), np.asarray(arrays.t_out[2]))
+        assert np.array_equal(np.asarray(out.load_w[2]), np.asarray(arrays.load_w[2]))
+        # next_* leaves stay the rolled counterparts of the scaled leaves.
+        np.testing.assert_allclose(
+            np.asarray(out.next_pv_w[3]),
+            np.roll(np.asarray(out.pv_w[3]), -1, axis=0),
+            rtol=1e-6,
+        )
+
+    def test_ev_floor_guarantees_feasible_delivery(self):
+        """An idle dial cannot strand the vehicle: stepping the whole
+        window at dial=0 still delivers the full need via the
+        deadline-feasibility floor."""
+        cfg = _cfg(n_agents=2, n_scenarios=1)
+        spec = RegimeSpec(
+            name="ev", ev_present=True, ev_arrival_slot=72,
+            ev_deadline_slot=96, ev_energy_kwh=8.0,
+        )
+        pf = build_portfolio([spec], 1)
+        rp = pf.scenario_params
+        need = init_ev_need(rp, 2)
+        np.testing.assert_allclose(np.asarray(need), 8.0 * 3.6e6)
+        dial = jnp.zeros((1, 2))
+        delivered = np.zeros((1, 2))
+        for slot in range(96):
+            ev_w, need, miss = ev_charge_step(
+                cfg, rp, need, jnp.asarray([slot], dtype=jnp.int32), dial
+            )
+            delivered += np.asarray(ev_w) * cfg.sim.dt_seconds
+            assert (np.asarray(ev_w) <= spec.ev_max_power_w + 1e-6).all()
+            if slot < 72:
+                assert (np.asarray(ev_w) == 0.0).all()
+            assert (np.asarray(miss) == 0.0).all()
+        np.testing.assert_allclose(delivered, 8.0 * 3.6e6, rtol=1e-5)
+        assert (np.asarray(need) == 0.0).all()
+
+    def test_ev_infeasible_window_bills_the_miss(self):
+        """A need the window cannot physically deliver surfaces as a
+        deadline miss, not silent under-delivery."""
+        cfg = _cfg(n_agents=1, n_scenarios=1)
+        spec = RegimeSpec(
+            name="tight", ev_present=True, ev_arrival_slot=90,
+            ev_deadline_slot=92, ev_energy_kwh=20.0,  # 20 kWh in 30 min
+        )
+        pf = build_portfolio([spec], 1)
+        rp = pf.scenario_params
+        need = init_ev_need(rp, 1)
+        dial = jnp.ones((1, 1))
+        total_miss = 0.0
+        for slot in range(88, 96):
+            ev_w, need, miss = ev_charge_step(
+                cfg, rp, need, jnp.asarray([slot], dtype=jnp.int32), dial
+            )
+            total_miss += float(np.asarray(miss).sum())
+        feasible_ws = spec.ev_max_power_w * 2 * cfg.sim.dt_seconds
+        np.testing.assert_allclose(
+            total_miss, 20.0 * 3.6e6 - feasible_ws, rtol=1e-5
+        )
+        assert (np.asarray(need) == 0.0).all()  # window closed
+
+
+# -- episode programs ----------------------------------------------------------
+
+
+class TestEpisodePrograms:
+    def test_neutral_regime_bit_exact_vs_plain_shared(self, world):
+        """An all-baseline portfolio reproduces the plain shared episode
+        program bit-for-bit (same key chain, same settlement arithmetic):
+        the regime engine costs nothing when no regime is active."""
+        cfg, ratings, arrays, policy = world
+        pf = build_portfolio(["baseline"], 4)
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        plain = make_shared_episode_fn(cfg, policy, arrays, ratings)
+        reg = make_regime_episode_fn(
+            cfg, policy, ratings, pf.scenario_params, arrays_s=arrays,
+            specs=pf.specs,
+        )
+        c1, ys1 = plain((ps, scen), jax.random.PRNGKey(7))
+        c2, ys2 = reg((ps, scen), jax.random.PRNGKey(7))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(c1), jax.tree_util.tree_leaves(c2)
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(ys1[0]), np.asarray(ys2[0]))
+        assert np.array_equal(np.asarray(ys1[1]), np.asarray(ys2[1]))
+
+    def test_single_compile_mixed_batch_and_portfolio_swap(self, world):
+        """The acceptance single-compile check: a 4-regime mixed batch
+        runs as ONE compiled program, and swapping to a different
+        portfolio of the same shape reuses it — regime fields are array
+        leaves, so no per-regime retrace can happen."""
+        cfg, ratings, arrays, policy = world
+        pf_a = build_portfolio(
+            ["winter", "ev_evening", "dr_spike", "double_auction"], 4
+        )
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        fn = make_regime_episode_fn(
+            cfg, policy, ratings, pf_a.scenario_params, arrays_s=arrays,
+            collect_regime_metrics=True, one_hot=pf_a.one_hot,
+            specs=pf_a.specs,
+        )
+        carry, ys_a = fn((ps, scen), jax.random.PRNGKey(7))
+        pf_b = build_portfolio(
+            ["summer", "islanding_noon", "uniform_price", "cold_snap"], 4
+        )
+        fn_b = fn.with_regimes(pf_b.scenario_params)
+        _, ys_b = fn_b((ps, scen), jax.random.PRNGKey(7))
+        assert fn.jitted._cache_size() == 1
+        assert not np.array_equal(np.asarray(ys_a[0]), np.asarray(ys_b[0]))
+        # Per-regime counters rode the scan: EV regime charged energy.
+        rc = ys_a[2]
+        ev_idx = list(pf_a.names).index("ev_evening")
+        assert float(np.asarray(rc.ev_charged_wh)[ev_idx]) > 0.0
+        assert float(np.asarray(rc.ev_charged_wh).sum()) == pytest.approx(
+            float(np.asarray(rc.ev_charged_wh)[ev_idx])
+        )
+
+    def test_regime_counters_match_episode_rewards(self, world):
+        """rc.reward is the segment-sum of the per-scenario episode
+        rewards — the counters attribute exactly what the episode saw."""
+        cfg, ratings, arrays, policy = world
+        pf = build_portfolio(["winter", "dr_spike"], 4)  # 2 scenarios each
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        fn = make_regime_episode_fn(
+            cfg, policy, ratings, pf.scenario_params, arrays_s=arrays,
+            collect_regime_metrics=True, one_hot=pf.one_hot, specs=pf.specs,
+        )
+        _, (rewards_s, _, rc) = fn((ps, scen), jax.random.PRNGKey(9))
+        rewards_s = np.asarray(rewards_s)
+        onehot = np.asarray(pf.one_hot)
+        np.testing.assert_allclose(
+            np.asarray(rc.reward), rewards_s @ onehot, rtol=1e-4
+        )
+
+    def test_independent_mode_trains_per_scenario_learners(self, world):
+        cfg, ratings, arrays, policy = world
+        pf = build_portfolio(["winter", "summer"], 4)
+        from p2pmicrogrid_tpu.train import init_policy_state
+
+        ps_s = jax.vmap(lambda k: init_policy_state(cfg, k))(
+            jax.random.split(jax.random.PRNGKey(0), 4)
+        )
+        fn = make_regime_episode_fn(
+            cfg, policy, ratings, pf.scenario_params, arrays_s=arrays,
+            mode="independent", specs=pf.specs,
+        )
+        carry, (r, l) = fn(ps_s, jax.random.PRNGKey(7))
+        assert r.shape == (4,) and np.isfinite(np.asarray(r)).all()
+        q = np.asarray(carry.q_table)  # [S, A, ...]
+        # Winter and summer learners saw different worlds: tables differ.
+        assert not np.array_equal(q[0], q[1])
+
+    def test_independent_ddpg_refused(self, world):
+        cfg, ratings, arrays, _ = world
+        cfg_ddpg = cfg.replace(train=TrainConfig(implementation="ddpg"))
+        pf = build_portfolio(["baseline"], 4)
+        with pytest.raises(ValueError, match="independent regime"):
+            make_regime_episode_fn(
+                cfg_ddpg, make_policy(cfg_ddpg), ratings,
+                pf.scenario_params, arrays_s=arrays, mode="independent",
+            )
+
+    def test_shared_trainer_integration(self, world):
+        cfg, ratings, arrays, policy = world
+        from p2pmicrogrid_tpu.parallel.scenarios import train_scenarios_shared
+
+        pf = build_portfolio(["winter", "ev_evening"], 4)
+        ps, scen = init_shared_state(cfg, jax.random.PRNGKey(0))
+        fn = make_regime_episode_fn(
+            cfg, policy, ratings, pf.scenario_params, arrays_s=arrays,
+            specs=pf.specs,
+        )
+        ps2, scen2, rewards, losses, _ = train_scenarios_shared(
+            cfg, policy, ps, arrays, ratings, jax.random.PRNGKey(1), 2,
+            replay_s=scen, episode_fn=fn, donate=False,
+        )
+        assert rewards.shape == (2, 4)
+        assert np.isfinite(rewards).all()
+        assert not np.array_equal(
+            np.asarray(ps.q_table), np.asarray(ps2.q_table)
+        )
+
+    def test_chunked_trainer_integration_device_gen(self, world):
+        """The chunked driver runs regime episodes over DEVICE-generated
+        arrays: weather scaling composes with on-device synthesis inside
+        one compiled chunk program."""
+        cfg, ratings, _, policy = world
+        from p2pmicrogrid_tpu.parallel.device_gen import device_episode_arrays
+        from p2pmicrogrid_tpu.parallel.scenarios import (
+            train_scenarios_chunked,
+        )
+
+        pf = build_portfolio(
+            ["winter", "summer", "dr_spike", "uniform_price"], 4
+        )
+        from p2pmicrogrid_tpu.parallel import init_shared_pol_state
+
+        ps = init_shared_pol_state(cfg, jax.random.PRNGKey(0))
+        fn = make_regime_episode_fn(
+            cfg, policy, ratings, pf.scenario_params,
+            arrays_fn=lambda k: device_episode_arrays(cfg, k, ratings, 4),
+            n_scenarios=4, specs=pf.specs,
+        )
+        ps2, rewards, losses, _ = train_scenarios_chunked(
+            cfg, policy, ps, ratings, jax.random.PRNGKey(1),
+            n_episodes=2, n_chunks=2, episode_fn=fn, donate=False,
+        )
+        assert rewards.shape == (2, 8)  # K*S
+        assert np.isfinite(rewards).all()
+
+    def test_fused_refusal_is_loud_and_actionable(self, world):
+        cfg, ratings, arrays, policy = world
+        pf = build_portfolio(["ev_evening", "islanding_noon"], 4)
+        with pytest.raises(ValueError) as err:
+            make_regime_episode_fn(
+                cfg, policy, ratings, pf.scenario_params, arrays_s=arrays,
+                fused=True, specs=pf.specs,
+            )
+        msg = str(err.value)
+        assert "EV load" in msg and "islanding masks" in msg
+        assert "fused" in msg and "baseline world" in msg
+
+    def test_fused_slot_config_refused_too(self, world):
+        """SimConfig.fused_slot=True must refuse through the same path —
+        the config knob cannot reach silently-wrong fused output."""
+        cfg, ratings, arrays, policy = world
+        cfg_fused = cfg.replace(
+            sim=SimConfig(n_agents=3, n_scenarios=4, fused_slot=True)
+        )
+        pf = build_portfolio(["double_auction"], 4)
+        with pytest.raises(ValueError, match="auction mechanism"):
+            make_regime_episode_fn(
+                cfg_fused, policy, ratings, pf.scenario_params,
+                arrays_s=arrays, specs=pf.specs,
+            )
+
+
+# -- per-regime eval + promotion gate -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def crafted_regime_bundles(tmp_path_factory):
+    from p2pmicrogrid_tpu.regimes.bench import make_regime_crafted_bundle
+
+    root = tmp_path_factory.mktemp("regime-bundles")
+    cfg = default_config(
+        sim=SimConfig(n_agents=3),
+        train=TrainConfig(implementation="tabular"),
+    )
+    inc = make_regime_crafted_bundle(cfg, "thermostat", str(root / "inc"))
+    cand = make_regime_crafted_bundle(cfg, "siesta", str(root / "cand"))
+    return cfg, inc, cand
+
+
+class TestRegimeEval:
+    def test_eval_table_fields_and_events(self, world, tmp_path):
+        cfg, ratings, _, policy = world
+        from p2pmicrogrid_tpu.regimes import evaluate_regimes
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        ps, _ = init_shared_state(cfg, jax.random.PRNGKey(0))
+        db = str(tmp_path / "regimes.db")
+        tel = Telemetry(
+            run_id="regime-eval-test",
+            sinks=[SqliteSink(db)],
+            manifest={"run_id": "regime-eval-test", "created": 0.0,
+                      "config_hash": "cfgRE", "git_rev": "t",
+                      "setting": "s", "backend": "cpu"},
+        )
+        rows = evaluate_regimes(
+            cfg, policy, ps, ratings, ["winter", "ev_evening"],
+            s_per_regime=2, telemetry=tel, held_out=True,
+        )
+        tel.close()
+        assert [r["regime"] for r in rows] == ["winter", "ev_evening"]
+        for r in rows:
+            assert r["held_out"] is True
+            assert np.isfinite(r["cost_eur"])
+            assert "comfort_violations" in r and "trade_wh" in r
+        assert rows[1]["ev_charged_wh"] > 0.0
+
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+
+        store = ResultsStore(db)
+        view = store.query_regime_view()
+        store.close()
+        assert {v["regime"] for v in view} == {"winter", "ev_evening"}
+        row = {v["regime"]: v for v in view}["ev_evening"]
+        assert row["config_hash"] == "cfgRE"
+        assert row["n_held_out_evals"] == 1
+        assert row["mean_ev_charged_wh"] > 0.0
+
+    def test_gate_blocks_held_out_regime_regression(
+        self, crafted_regime_bundles
+    ):
+        """The acceptance case: the siesta candidate BEATS the incumbent
+        thermostat on mean held-out cost (the plain gate passes it) but
+        back-loads heating into the evening spike — the regime-aware gate
+        must block it, naming the regressed regime."""
+        from p2pmicrogrid_tpu.serve.promotion import (
+            GateBudgets,
+            run_promotion_gate,
+        )
+
+        cfg, inc, cand = crafted_regime_bundles
+        service = lambda batch, padded: 1e-3
+        plain = run_promotion_gate(
+            cfg, cand, inc, budgets=GateBudgets(),
+            service_time_fn=service,
+        )
+        assert plain.passed, plain.reasons
+        assert plain.candidate_cost < plain.incumbent_cost
+        gated = run_promotion_gate(
+            cfg, cand, inc, budgets=GateBudgets(),
+            service_time_fn=service,
+            regime_specs=["dr_spike", "islanding_noon"],
+            regime_s_per_regime=2,
+        )
+        assert not gated.passed
+        assert any("dr_spike" in r for r in gated.reasons)
+        assert gated.candidate_regime_costs["dr_spike"] > (
+            gated.incumbent_regime_costs["dr_spike"]
+        )
+        # The verdict's warehouse fields carry the per-regime evidence.
+        fields = gated.to_fields()
+        assert set(fields["candidate_regime_costs"]) == {
+            "dr_spike", "islanding_noon"
+        }
+
+    def test_gate_regime_rule_pass_and_injection(
+        self, crafted_regime_bundles
+    ):
+        """Injected per-regime evals: no regression -> pass; regression
+        within the tolerance -> pass; the incumbent_regime_eval reuse
+        path works (the harness gates many candidates against one)."""
+        from p2pmicrogrid_tpu.serve.promotion import (
+            GateBudgets,
+            run_promotion_gate,
+        )
+
+        cfg, inc, cand = crafted_regime_bundles
+        service = lambda batch, padded: 1e-3
+        evals = {
+            cand: {"cold_snap": 9.0, "dr_spike": 5.0},
+            inc: {"cold_snap": 10.0, "dr_spike": 4.9},
+        }
+        fake = lambda d: dict(evals[d])
+        ok = run_promotion_gate(
+            cfg, cand, inc,
+            budgets=GateBudgets(max_regime_regression=0.05),
+            service_time_fn=service, regime_eval_fn=fake,
+            incumbent_regime_eval=evals[inc],
+        )
+        # dr_spike 5.0 vs 4.9: within the 5% scale-free tolerance.
+        assert ok.passed, ok.reasons
+        strict = run_promotion_gate(
+            cfg, cand, inc, budgets=GateBudgets(),
+            service_time_fn=service, regime_eval_fn=fake,
+        )
+        assert not strict.passed
+        assert any("dr_spike" in r for r in strict.reasons)
+
+
+# -- CLI + schema --------------------------------------------------------------
+
+
+class TestRegimeCli:
+    def test_telemetry_query_regimes_view_and_watch_refusal(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        db = str(tmp_path / "w.db")
+        tel = Telemetry(
+            run_id="r1", sinks=[SqliteSink(db)],
+            manifest={"run_id": "r1", "created": 0.0,
+                      "config_hash": "cfgX", "git_rev": "t",
+                      "setting": "s", "backend": "cpu"},
+        )
+        tel.event(
+            "regime_eval", regime="winter", held_out=True, cost_eur=3.5,
+            reward=-2.0, comfort_violations=1.0, trade_wh=10.0,
+            grid_wh=100.0, curtailed_wh=0.0, ev_charged_wh=0.0,
+            ev_missed_wh=0.0, n_scenarios=2,
+        )
+        tel.close()
+        rc = main(["telemetry-query", "--results-db", db, "--regimes"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert rc == 0
+        rows = [json.loads(l) for l in out]
+        assert rows and rows[0]["regime"] == "winter"
+        assert rows[0]["config_hash"] == "cfgX"
+        assert rows[0]["mean_cost_eur"] == pytest.approx(3.5)
+
+        rc = main([
+            "telemetry-query", "--results-db", db, "--regimes", "--watch",
+        ])
+        assert rc == 2
+        assert "--regimes" in capsys.readouterr().err
+
+
+class TestRegimeSchema:
+    GOOD_EVAL = {
+        "metric": "regime_eval", "value": 3.2, "unit": "eur/scenario-day",
+        "vs_baseline": 1.0, "regime": "winter", "held_out": True,
+        "cost_eur": 3.2,
+    }
+    GOOD_GATE = {
+        "metric": "regime_gate_case", "value": 1.0, "unit": "blocked",
+        "vs_baseline": 1.0, "blocked": True, "mean_improved": True,
+        "regressed_regime": "dr_spike",
+    }
+    GOOD_HEAD = {
+        "metric": "regime_generalization_tabular_2train_2held_out",
+        "value": 4.0, "unit": "eur/scenario-day", "vs_baseline": 1.0,
+        "held_out": True, "single_compile": True,
+        "train_cost_eur": 3.0, "held_out_cost_eur": 4.0,
+        "generalization_gap": 1.0,
+        "train_regimes": ["baseline", "winter"],
+        "held_out_regimes": ["dr_spike", "cold_snap"],
+        "per_regime_cost": {"baseline": 2.9, "dr_spike": 4.5},
+    }
+
+    def _write(self, path, rows):
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    def test_good_capture_passes(self, tmp_path):
+        p = str(tmp_path / "REGIME_t.jsonl")
+        self._write(p, [self.GOOD_EVAL, self.GOOD_GATE, self.GOOD_HEAD])
+        problems = []
+        schema.check_regime_jsonl(p, problems)
+        assert problems == []
+
+    @pytest.mark.parametrize(
+        "mutate, needle",
+        [
+            (lambda rows: rows[0].pop("cost_eur"), "cost_eur"),
+            (lambda rows: rows[0].pop("regime"), "regime"),
+            (lambda rows: rows[1].pop("blocked"), "blocked"),
+            (lambda rows: rows[2].pop("per_regime_cost"), "per_regime_cost"),
+            (
+                lambda rows: rows[2].__setitem__("held_out_regimes", []),
+                "held_out_regimes",
+            ),
+            (
+                lambda rows: rows[2].__setitem__("single_compile", "yes"),
+                "single_compile",
+            ),
+            (lambda rows: rows.reverse(), "last row"),
+            (lambda rows: rows.pop(2), "headline"),
+        ],
+    )
+    def test_bad_captures_flagged(self, tmp_path, mutate, needle):
+        rows = [
+            json.loads(json.dumps(r))
+            for r in (self.GOOD_EVAL, self.GOOD_GATE, self.GOOD_HEAD)
+        ]
+        mutate(rows)
+        p = str(tmp_path / "REGIME_bad.jsonl")
+        self._write(p, rows)
+        problems = []
+        schema.check_regime_jsonl(p, problems)
+        assert problems, f"expected a problem mentioning {needle!r}"
+        assert any(needle in pr for pr in problems), problems
+
+    def test_check_all_sweeps_regime_captures(self, tmp_path):
+        art = tmp_path / "artifacts"
+        art.mkdir()
+        self._write(
+            str(art / "REGIME_x.jsonl"),
+            [self.GOOD_EVAL, self.GOOD_GATE],  # headline missing
+        )
+        problems = schema.check_all(str(tmp_path))
+        assert any("regime_generalization headline" in p for p in problems)
+
+    def test_committed_capture_validates(self):
+        path = os.path.join(REPO_ROOT, "artifacts", "REGIME_r13.jsonl")
+        assert os.path.exists(path), "committed REGIME_r13.jsonl missing"
+        problems = []
+        schema.check_regime_jsonl(path, problems)
+        assert problems == []
+        rows = [json.loads(l) for l in open(path) if l.strip()]
+        head = rows[-1]
+        assert head["single_compile"] is True
+        assert head["gate_blocked_regime_regression"] is True
+        gate = [r for r in rows if r["metric"] == "regime_gate_case"][0]
+        assert gate["blocked"] and gate["mean_improved"]
+        assert gate["passed_without_regime_gate"]
+
+
+class TestSpecs:
+    def test_library_and_resolve(self):
+        specs = resolve_specs(["winter", RegimeSpec(name="custom")])
+        assert specs[0].temp_offset_c < 0
+        assert specs[1].name == "custom"
+        with pytest.raises(ValueError, match="unknown regime"):
+            resolve_specs(["no_such_regime"])
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="mechanism"):
+            RegimeSpec(mechanism="vickrey")
+        with pytest.raises(ValueError, match="EV window"):
+            RegimeSpec(ev_arrival_slot=90, ev_deadline_slot=80)
+
+    def test_fused_unstageable_features(self):
+        assert REGIME_LIBRARY["baseline"].fused_unstageable_features() == []
+        feats = REGIME_LIBRARY["ev_evening"].fused_unstageable_features()
+        assert feats == ["EV load"]
+        assert REGIME_LIBRARY["baseline"].is_baseline
+        assert not REGIME_LIBRARY["winter"].is_baseline

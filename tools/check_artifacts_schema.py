@@ -62,6 +62,16 @@ the contracts executable:
   ``sha256:`` digest, a non-empty tree spec (shape/dtype per leaf) and
   ``payload_keys`` including ``pol_state``, next to actual payload files.
 
+* Regime captures (``artifacts/REGIME_*.jsonl``, `regime-bench` —
+  p2pmicrogrid_tpu/regimes/): metric rows; every ``regime_eval`` row must
+  carry a string ``regime``, numeric ``cost_eur`` and boolean
+  ``held_out``; ``regime_gate_case`` rows boolean ``blocked``/
+  ``mean_improved`` + string ``regressed_regime``; any
+  ``regime_generalization`` row (here or in any bench sweep) numeric
+  train/held-out costs + gap, non-empty string regime-id lists, boolean
+  ``held_out``/``single_compile`` and a numeric ``per_regime_cost``
+  object — and the capture's LAST row must be that headline.
+
 * Results databases (``*.db``/``*.sqlite`` at the root and under
   ``artifacts/``): when a DB carries telemetry warehouse tables
   (``data/results.py``), its ``PRAGMA user_version`` must match the
@@ -164,6 +174,7 @@ def check_metric_jsonl(path: str, problems: list) -> None:
     for row, where in _iter_jsonl_rows(path, problems):
         check_metric_row(row, where, problems)
         check_rawspeed_row(row, where, problems)
+        check_regime_row(row, where, problems)
 
 
 # Raw-speed rows (ISSUE 12): the three bench families the megakernel /
@@ -232,6 +243,96 @@ def check_rawspeed_row(row: dict, where: str, problems: list) -> None:
             ("speedup", "depth_1_env_steps_per_sec",
              "depth_2_env_steps_per_sec", "depth_4_env_steps_per_sec"),
             where, problems, "pipeline_depth",
+        )
+
+
+# Regime rows (ISSUE 13, p2pmicrogrid_tpu/regimes/): the scenario-regime
+# engine's three row families. Validated in every metric jsonl sweep — a
+# regime_generalization row without its per-regime costs or single-compile
+# verdict, or a gate-case row without its blocked/mean_improved verdicts,
+# measured nothing the regime engine promises.
+
+
+def check_regime_row(row: dict, where: str, problems: list) -> None:
+    """One row's regime contract (no-op for rows of other metrics)."""
+    if not isinstance(row, dict):
+        return
+    metric = row.get("metric")
+    if not isinstance(metric, str):
+        return
+    if metric.startswith("regime_generalization"):
+        _require_numeric(
+            row,
+            ("train_cost_eur", "held_out_cost_eur", "generalization_gap"),
+            where, problems, "regime_generalization",
+        )
+        _require_bool(
+            row, ("held_out", "single_compile"),
+            where, problems, "regime_generalization",
+        )
+        for key in ("train_regimes", "held_out_regimes"):
+            v = row.get(key)
+            if not isinstance(v, list) or not v or not all(
+                isinstance(r, str) for r in v
+            ):
+                problems.append(
+                    f"{where}: regime_generalization row needs a non-empty "
+                    f"string list {key!r}"
+                )
+        prc = row.get("per_regime_cost")
+        if not isinstance(prc, dict) or not prc or not all(
+            isinstance(k, str)
+            and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+            for k, v in prc.items()
+        ):
+            problems.append(
+                f"{where}: regime_generalization row needs per_regime_cost "
+                "as a non-empty {regime: numeric cost} object"
+            )
+    elif metric == "regime_eval":
+        _require_numeric(row, ("cost_eur",), where, problems, "regime_eval")
+        _require_bool(row, ("held_out",), where, problems, "regime_eval")
+        if not isinstance(row.get("regime"), str) or not row.get("regime"):
+            problems.append(
+                f"{where}: regime_eval row missing string 'regime'"
+            )
+    elif metric == "regime_gate_case":
+        _require_bool(
+            row, ("blocked", "mean_improved"),
+            where, problems, "regime_gate_case",
+        )
+        if not isinstance(row.get("regressed_regime"), str):
+            problems.append(
+                f"{where}: regime_gate_case row missing string "
+                "'regressed_regime'"
+            )
+
+
+def check_regime_jsonl(path: str, problems: list) -> None:
+    """REGIME_*.jsonl: metric rows + the capture contract — at least one
+    per-regime eval row, and the ``regime_generalization`` headline as the
+    LAST row (the driver parses the final stdout line)."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    rows = [row for row, _ in _iter_jsonl_rows(path, [])]
+    evals = [
+        r for r in rows
+        if isinstance(r, dict) and r.get("metric") == "regime_eval"
+    ]
+    if not evals:
+        problems.append(f"{where}: no regime_eval row (per-regime table)")
+    headlines = [
+        (i, r) for i, r in enumerate(rows)
+        if isinstance(r, dict)
+        and isinstance(r.get("metric"), str)
+        and r["metric"].startswith("regime_generalization")
+    ]
+    if not headlines:
+        problems.append(f"{where}: no regime_generalization headline row")
+    elif headlines[-1][0] != len(rows) - 1:
+        problems.append(
+            f"{where}: regime_generalization headline must be the last row"
         )
 
 
@@ -1048,6 +1149,10 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
         glob.glob(os.path.join(repo_root, "artifacts", "AUTOPILOT_*.jsonl"))
     ):
         check_autopilot_jsonl(path, problems)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "REGIME_*.jsonl"))
+    ):
+        check_regime_jsonl(path, problems)
     for pattern in (
         os.path.join("artifacts", "AUTOPILOT_JOURNAL_*.json"),
         os.path.join("artifacts", "autopilot*", "cycle_journal.json"),

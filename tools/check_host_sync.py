@@ -84,6 +84,13 @@ HOT_PATH_FILES = (
     # live fleet traffic: a stray blocking readback in its cycle loop
     # stalls the canary cadence and the recovery path alike.
     os.path.join("p2pmicrogrid_tpu", "serve", "autopilot.py"),
+    # The regime engine (ISSUE 13) wraps every regime episode's slot scan
+    # and the per-regime eval/training drivers — a blocking readback in
+    # the slot wrapper or the episode closures would serialize every
+    # mixed-regime training dispatch per slot.
+    os.path.join("p2pmicrogrid_tpu", "regimes", "engine.py"),
+    os.path.join("p2pmicrogrid_tpu", "regimes", "train.py"),
+    os.path.join("p2pmicrogrid_tpu", "regimes", "evaluate.py"),
     os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
 )
 
