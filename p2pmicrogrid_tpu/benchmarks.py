@@ -1469,6 +1469,59 @@ def bench_convergence_fast() -> dict:
     }
 
 
+def bench_serve_continuous() -> dict:
+    """Continuous batching vs the microbatch queue (ISSUE 14): the SAME
+    bursty (Markov-modulated on/off Poisson) open-loop schedule fired over
+    the persistent mux wire through two gateways of one bundle in one
+    process — full-batch ``MicroBatchQueue`` vs slot-level
+    ``ContinuousBatcher``. The per-arm percentile rows emit as siblings;
+    the ``serve_continuous`` headline carries both arms' percentiles, the
+    micro/continuous p99 ratio (``vs_microbatch`` — the SLO claim), the
+    ``bit_exact_stateless`` verdict (arms compared to each other AND to a
+    direct engine act) and the continuous arm's occupancy/slot-wait
+    distributions."""
+    import tempfile
+
+    import jax
+
+    from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+    from p2pmicrogrid_tpu.serve.continuous import serve_bench_continuous_compare
+    from p2pmicrogrid_tpu.serve.export import export_policy_bundle
+    from p2pmicrogrid_tpu.train import init_policy_state
+
+    A = 16
+    cfg = default_config(
+        sim=SimConfig(n_agents=A), train=TrainConfig(implementation="tabular")
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    ps = ps._replace(
+        q_table=rng.standard_normal(ps.q_table.shape).astype(np.float32) * 0.1
+    )
+    tmp = tempfile.mkdtemp(prefix="p2p-cbbench-")
+    try:
+        bundle = export_policy_bundle(cfg, ps, os.path.join(tmp, "b"))
+        # Sink-less telemetry around the wire runs: the gateways' trace
+        # events must not leak into the bench suite's metric stdout.
+        from p2pmicrogrid_tpu.telemetry import Telemetry, current, set_current
+
+        prev_tel = current()
+        set_current(Telemetry(run_id="serve-continuous-bench"))
+        try:
+            rows = serve_bench_continuous_compare(
+                bundle, rate_hz=384.0, n_requests=768, n_households=32,
+                seed=0, burst_factor=8.0, burst_dwell_s=0.2,
+                max_batch=64, max_wait_s=0.005, device="default",
+            )
+        finally:
+            set_current(prev_tel)
+        for row in rows[:-1]:
+            _emit_row(row)
+        return rows[-1]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 BENCHES = {
     "cfg1": bench_cfg1,
     "cfg2": bench_cfg2,
@@ -1481,6 +1534,7 @@ BENCHES = {
     "chunked_pipeline": bench_chunked_pipeline,
     "slot_fused": bench_slot_fused,
     "serve_quantized": bench_serve_quantized,
+    "serve_continuous": bench_serve_continuous,
     "pipeline_depth": bench_pipeline_depth,
     "regime_generalization": bench_regime_generalization,
     # North star last: the driver parses the final JSON line, and the
@@ -1495,8 +1549,8 @@ BENCHES = {
 # the error row they'd otherwise produce.
 CPU_RETRYABLE = {
     "cfg1", "cfg2", "cfg3", "cfg5", "convergence", "convergence_fast",
-    "chunked_pipeline", "slot_fused", "serve_quantized", "pipeline_depth",
-    "regime_generalization",
+    "chunked_pipeline", "slot_fused", "serve_quantized", "serve_continuous",
+    "pipeline_depth", "regime_generalization",
 }
 
 

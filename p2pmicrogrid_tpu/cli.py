@@ -1597,7 +1597,17 @@ def cmd_export_bundle(args) -> int:
 
     cfg = _build_cfg(args)
     key = jax.random.PRNGKey(cfg.train.seed)
-    if (
+    if cfg.train.implementation == "ddpg_recurrent":
+        # The recurrent day-granular actor (train-recurrent): no learner
+        # template exists in init_policy_state, so the checkpoint is read
+        # structure-free (restore_raw) — the export touches only the
+        # actor subtree anyway.
+        from p2pmicrogrid_tpu.train.checkpoint import restore_raw
+        from p2pmicrogrid_tpu.train.recurrent import recurrent_checkpoint_dir
+
+        ckpt_dir = recurrent_checkpoint_dir(args.model_dir, cfg.setting)
+        pol_state, episode, _step = restore_raw(ckpt_dir)
+    elif (
         cfg.train.implementation == "ddpg"
         and getattr(args, "share_agents", False)
         and getattr(args, "scenarios", 1) > 1
@@ -1653,6 +1663,46 @@ def cmd_export_bundle(args) -> int:
     return 0
 
 
+def cmd_train_recurrent(args) -> int:
+    """Train the recurrent day-granular LSTM DDPG actor (train/recurrent.py)
+    and checkpoint it under ``models_ddpg_recurrent/<setting>`` so
+    ``export-bundle --implementation ddpg_recurrent`` can freeze it into a
+    servable bundle. One episode = one day on the community physics;
+    deterministic under --seed."""
+    import jax
+
+    from p2pmicrogrid_tpu.train.recurrent import (
+        save_recurrent_checkpoint,
+        train_recurrent_community,
+    )
+
+    args.implementation = "ddpg_recurrent"
+    cfg = _build_cfg(args)
+    res = train_recurrent_community(
+        cfg, episodes=args.episodes, key=jax.random.PRNGKey(cfg.train.seed)
+    )
+    path = save_recurrent_checkpoint(
+        args.model_dir, cfg, res.state, episode=args.episodes
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "train_recurrent",
+                "value": round(float(res.day_rewards[-1]), 4),
+                "unit": "day_reward",
+                "vs_baseline": 1.0,
+                "episodes": args.episodes,
+                "first_day_reward": round(float(res.day_rewards[0]), 4),
+                "last_day_reward": round(float(res.day_rewards[-1]), 4),
+                "last_day_cost_eur": round(float(res.day_costs[-1]), 4),
+                "checkpoint": path,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
 def cmd_serve_bench(args) -> int:
     """Open-loop serving benchmark against a policy bundle.
 
@@ -1701,6 +1751,39 @@ def cmd_serve_bench(args) -> int:
                 file=sys.stderr,
                 flush=True,
             )
+        # --burst-factor: None = mode default (plain Poisson everywhere;
+        # the continuous-compare exists to exercise the bursty pathology,
+        # so IT defaults to 8). An explicit value — including 1.0, plain
+        # Poisson — is always honored.
+        burst_factor = args.burst_factor if args.burst_factor is not None \
+            else 1.0
+        if getattr(args, "continuous_compare", False):
+            # One-process continuous-vs-microbatch comparison at the mux
+            # wire (serve/continuous.py): same bundle, same (bursty)
+            # schedule, two gateways — the committed SERVE_CB_*.jsonl
+            # captures come from here.
+            from p2pmicrogrid_tpu.serve import serve_bench_continuous_compare
+
+            serve_bench_continuous_compare(
+                bundle,
+                rate_hz=args.rate,
+                n_requests=args.requests,
+                n_households=args.households,
+                seed=args.bench_seed,
+                slo_ms=args.slo_ms,
+                burst_factor=(
+                    args.burst_factor if args.burst_factor is not None
+                    else 8.0
+                ),
+                burst_dwell_s=args.burst_dwell_s,
+                max_batch=args.max_batch,
+                max_wait_s=args.max_wait_ms / 1e3,
+                max_slots=getattr(args, "max_sessions", 256),
+                device=getattr(args, "serve_device", "auto"),
+                results_db=args.results_db,
+                emit=sink.emit,
+            )
+            return 0
         if getattr(args, "fleet", False):
             # Fleet mode: N gateway replicas behind the consistent-hash
             # router, the open-loop schedule fired THROUGH the router
@@ -1825,6 +1908,8 @@ def cmd_serve_bench(args) -> int:
                     fault_plan_file=plan_file,
                     results_db=args.results_db,
                     serve_device=getattr(args, "serve_device", "auto"),
+                    batching=getattr(args, "batching", "micro"),
+                    max_slots=getattr(args, "max_sessions", 256),
                 )
                 fleet.start()
                 # The bit-exactness comparator lives in THIS process: the
@@ -1850,6 +1935,8 @@ def cmd_serve_bench(args) -> int:
                     mux=(transport != "http"),
                     tls=server_ctx,
                     authenticator=authenticator,
+                    batching=getattr(args, "batching", "micro"),
+                    max_slots=getattr(args, "max_sessions", 256),
                 )
                 fleet.start()
                 reference = fleet.reference_engine()
@@ -1939,12 +2026,21 @@ def cmd_serve_bench(args) -> int:
                     n_agents=reference.n_agents,
                     fleet=fleet,
                     fault_plan=plan,
-                    reference_engine=reference,
+                    # A recurrent bundle's answers depend on engine-side
+                    # hidden state: a stateless direct-act replay is not a
+                    # valid comparator, so the bit-exact verdict is
+                    # omitted (hidden-state continuity is regression-
+                    # tested in tests/test_continuous.py instead).
+                    reference_engine=(
+                        None if reference.is_recurrent else reference
+                    ),
                     rate_hz=args.rate,
                     n_requests=args.requests,
                     n_households=args.households,
                     seed=args.bench_seed,
                     slo_ms=args.slo_ms,
+                    burst_factor=burst_factor,
+                    burst_dwell_s=args.burst_dwell_s,
                     probe_interval_s=0.05,
                     emit=lambda row: (sink.emit(row), router_tel.emit(row)),
                     unauth_router=unauth_router,
@@ -1965,6 +2061,7 @@ def cmd_serve_bench(args) -> int:
                         "max_batch": args.max_batch,
                         "max_wait_ms": round(args.max_wait_ms, 3),
                         "process_mode": process_mode,
+                        "batching": getattr(args, "batching", "micro"),
                     },
                 )
             finally:
@@ -2004,6 +2101,8 @@ def cmd_serve_bench(args) -> int:
                     wait_budget_ms=args.wait_budget_ms,
                 ),
                 run_name="serve-bench-net",
+                batching=getattr(args, "batching", "micro"),
+                max_slots=getattr(args, "max_sessions", 256),
             )
             server = GatewayServer(gateway)
             try:
@@ -2029,6 +2128,8 @@ def cmd_serve_bench(args) -> int:
                     n_households=args.households,
                     seed=args.bench_seed,
                     slo_ms=args.slo_ms,
+                    burst_factor=burst_factor,
+                    burst_dwell_s=args.burst_dwell_s,
                     retry=(
                         RetryPolicy(
                             max_attempts=args.retry_attempts,
@@ -2043,6 +2144,7 @@ def cmd_serve_bench(args) -> int:
                         "n_agents": default.engine.n_agents,
                         "max_batch": args.max_batch,
                         "max_wait_ms": round(args.max_wait_ms, 3),
+                        "batching": getattr(args, "batching", "micro"),
                     },
                 )
             finally:
@@ -2086,6 +2188,8 @@ def cmd_serve_bench(args) -> int:
                 max_wait_s=args.max_wait_ms / 1e3,
                 seed=args.bench_seed,
                 slo_ms=args.slo_ms,
+                burst_factor=burst_factor,
+                burst_dwell_s=args.burst_dwell_s,
                 emit=lambda row: (sink.emit(row), tel.emit(row)),
             )
         finally:
@@ -2181,6 +2285,8 @@ def cmd_serve_gateway(args) -> int:
             wait_budget_ms=args.wait_budget_ms,
             retry_after_s=args.retry_after_s,
         ),
+        batching=getattr(args, "batching", "micro"),
+        max_slots=getattr(args, "max_sessions", 256),
         host=args.host,
         port=args.port,
         mux_port=getattr(args, "mux_port", None),
@@ -3125,15 +3231,17 @@ def cmd_telemetry_query(args) -> int:
             or getattr(args, "rollbacks", False)
             or getattr(args, "promotions", False)
             or getattr(args, "regimes", False)
+            or getattr(args, "continuous", False)
         ):
             # Silently tailing the EVAL join when the user asked for the
-            # fleet/rollback/promotion/regime view would stream unrelated
-            # rows; refuse loudly.
+            # fleet/rollback/promotion/regime/continuous view would stream
+            # unrelated rows; refuse loudly.
             which = (
                 "--fleet" if getattr(args, "fleet", False)
                 else "--rollbacks" if getattr(args, "rollbacks", False)
                 else "--promotions" if getattr(args, "promotions", False)
-                else "--regimes"
+                else "--regimes" if getattr(args, "regimes", False)
+                else "--continuous"
             )
             print(
                 f"{which} and --watch cannot combine (the watch tails the "
@@ -3161,6 +3269,10 @@ def cmd_telemetry_query(args) -> int:
             from p2pmicrogrid_tpu.data.results import REGIME_VIEW_SQL
 
             rows = select(REGIME_VIEW_SQL)
+        elif getattr(args, "continuous", False):
+            from p2pmicrogrid_tpu.data.results import CONTINUOUS_VIEW_SQL
+
+            rows = select(CONTINUOUS_VIEW_SQL)
         elif getattr(args, "promotions", False):
             from p2pmicrogrid_tpu.data.results import (
                 PROMOTION_VIEW_SQL,
@@ -3370,7 +3482,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no-trading", action="store_true", dest="no_trading",
                    help="no-com community: no P2P negotiation or trading")
     p.add_argument("--battery", action="store_true")
-    p.add_argument("--implementation", choices=["tabular", "dqn", "ddpg"], default="tabular")
+    p.add_argument("--implementation",
+                   choices=["tabular", "dqn", "ddpg", "ddpg_recurrent"],
+                   default="tabular",
+                   help="policy class; ddpg_recurrent (the day-granular "
+                        "LSTM actor) trains via train-recurrent and serves "
+                        "only through session-carrying continuous batching")
     p.add_argument("--episodes", type=int, default=1000)
     p.add_argument("--save-episodes", type=int, default=None,
                    dest="save_episodes",
@@ -3682,6 +3799,17 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_export_bundle)
 
     p = sub.add_parser(
+        "train-recurrent",
+        help="train the recurrent day-granular LSTM DDPG actor on the "
+             "community physics and checkpoint it (export with "
+             "export-bundle --implementation ddpg_recurrent; serves only "
+             "through continuous batching with sessions)",
+    )
+    _add_common(p)
+    p.set_defaults(fn=cmd_train_recurrent, implementation="ddpg_recurrent",
+                   episodes=8)
+
+    p = sub.add_parser(
         "serve-bench",
         help="open-loop Poisson load against the batched inference engine; "
              "prints p50/p95/p99 latency, throughput and padding-waste as "
@@ -3796,6 +3924,35 @@ def main(argv=None) -> int:
                    help="--fleet: emit a wire_comparison row first — the "
                         "same open-loop schedule through per-request HTTP "
                         "vs the persistent mux wire against replica-0")
+    p.add_argument("--batching", choices=["micro", "continuous"],
+                   default="micro",
+                   help="--network/--fleet: queue front per bundle "
+                        "('continuous' = slot-level join/leave sessions; "
+                        "required for recurrent bundles)")
+    p.add_argument("--max-sessions", type=int, default=256,
+                   dest="max_sessions",
+                   help="--batching continuous: resident session slots "
+                        "per bundle (default 256)")
+    p.add_argument("--burst-factor", type=float, default=None,
+                   dest="burst_factor",
+                   help="bursty arrivals: Markov-modulated on/off Poisson "
+                        "with the on-state rate this many times the "
+                        "off-state rate, mean rate preserved (1 = plain "
+                        "Poisson; default 1, except --continuous-compare "
+                        "which defaults to 8 — pass an explicit value to "
+                        "override either)")
+    p.add_argument("--burst-dwell-s", type=float, default=0.25,
+                   dest="burst_dwell_s",
+                   help="bursty arrivals: mean dwell in each on/off state, "
+                        "seconds (default 0.25)")
+    p.add_argument("--continuous-compare", action="store_true",
+                   dest="continuous_compare",
+                   help="one-process continuous-vs-microbatch comparison: "
+                        "the SAME (bursty) open-loop schedule over the "
+                        "persistent mux wire through a microbatch gateway "
+                        "and a continuous-batching gateway; emits per-arm "
+                        "percentile rows and the serve_continuous "
+                        "headline (SERVE_CB_*.jsonl captures)")
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser(
@@ -3872,6 +4029,18 @@ def main(argv=None) -> int:
     p.add_argument("--chaos-plan", dest="chaos_plan",
                    help="fault-plan JSON (serve/faults.py) for this "
                         "replica's deterministic request-fault injector")
+    p.add_argument("--batching", choices=["micro", "continuous"],
+                   default="micro",
+                   help="queue front per bundle: 'micro' (full-batch "
+                        "coalescing; the committed-capture default) or "
+                        "'continuous' (slot-level join/leave with "
+                        "per-household session slots — required for "
+                        "recurrent bundles)")
+    p.add_argument("--max-sessions", type=int, default=256,
+                   dest="max_sessions",
+                   help="--batching continuous: resident session slots "
+                        "per bundle (LRU eviction + deterministic re-init "
+                        "past it; default 256)")
     p.set_defaults(fn=cmd_serve_gateway)
 
     p = sub.add_parser(
@@ -4304,6 +4473,13 @@ def main(argv=None) -> int:
                         "cost/comfort/trade-energy breakdown per "
                         "config_hash out of the regime_eval events "
                         "(p2pmicrogrid_tpu/regimes/)")
+    p.add_argument("--continuous", action="store_true",
+                   help="continuous-batching view instead of the eval "
+                        "join: per-(config_hash, batching) request/wait "
+                        "totals plus the engine-step occupancy and "
+                        "slot-wait distribution stats — the warehouse "
+                        "side of the continuous-vs-microbatch comparison "
+                        "(serve/continuous.py)")
     p.add_argument("--watch", action="store_true",
                    help="tail mode: poll the warehouse join and stream "
                         "new/updated rows as JSON lines until interrupted "

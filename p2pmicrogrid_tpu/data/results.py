@@ -764,6 +764,47 @@ ORDER BY t.config_hash, bundle, regime
 """
 
 
+# The continuous-batching view (ISSUE 14, serve/continuous.py): every
+# serving bundle's telemetry run is tagged ``serve_batching`` ("micro" |
+# "continuous") in its manifest, each engine step emits
+# ``serve.batch_occupancy`` + ``serve.slot_wait_ms`` histograms, and the
+# queue fronts stream the same per-request ``serve_request`` traces — so
+# one grouped pass renders the continuous-vs-microbatch comparison PER
+# CONFIG out of the warehouse itself: request counts, mean wait/latency
+# from the traces, and the close-time occupancy/slot-wait distribution
+# stats, keyed by (config_hash, batching). ``telemetry-query --continuous``
+# prints it.
+CONTINUOUS_VIEW_SQL = """
+SELECT t.config_hash,
+       json_extract(t.manifest_json, '$.serve_batching') AS batching,
+       COUNT(DISTINCT t.run_id) AS n_runs,
+       COUNT(CASE WHEN p.kind = 'serve_request' THEN 1 END) AS n_requests,
+       AVG(CASE WHEN p.kind = 'serve_request'
+           THEN json_extract(p.attrs_json, '$.wait_ms') END) AS mean_wait_ms,
+       AVG(CASE WHEN p.kind = 'serve_request'
+           THEN json_extract(p.attrs_json, '$.latency_ms') END)
+           AS mean_latency_ms,
+       AVG(CASE WHEN p.kind = 'histogram'
+           AND p.name = 'serve.batch_occupancy'
+           THEN json_extract(p.attrs_json, '$.mean') END) AS occupancy_mean,
+       AVG(CASE WHEN p.kind = 'histogram'
+           AND p.name = 'serve.batch_occupancy'
+           THEN json_extract(p.attrs_json, '$.p95') END) AS occupancy_p95,
+       AVG(CASE WHEN p.kind = 'histogram'
+           AND p.name = 'serve.slot_wait_ms'
+           THEN json_extract(p.attrs_json, '$.p50') END) AS slot_wait_p50_ms,
+       AVG(CASE WHEN p.kind = 'histogram'
+           AND p.name = 'serve.slot_wait_ms'
+           THEN json_extract(p.attrs_json, '$.p95') END) AS slot_wait_p95_ms,
+       MAX(p.ts) AS last_ts
+FROM telemetry_runs t
+JOIN telemetry_points p ON p.run_id = t.run_id
+WHERE json_extract(t.manifest_json, '$.serve_batching') IS NOT NULL
+GROUP BY t.config_hash, batching
+ORDER BY t.config_hash, batching
+"""
+
+
 # The default telemetry-query join (cli.py `telemetry-query`): one row per
 # (telemetry run, eval run) pair sharing a config_hash, with the run's gauge
 # points aggregated alongside the eval cost.
@@ -1109,6 +1150,15 @@ class ResultsStore:
                 except json.JSONDecodeError:
                     pass
         return rows
+
+    def query_continuous_view(self) -> list:
+        """Continuous-vs-microbatch serving attribution per config_hash
+        (``CONTINUOUS_VIEW_SQL``): per-batching request/wait/latency totals
+        from the ``serve_request`` traces plus the engine-step
+        occupancy/slot-wait distribution stats, as dicts."""
+        cur = self.con.execute(CONTINUOUS_VIEW_SQL)
+        cols = [d[0] for d in cur.description]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
 
     def query_promotion_view(self) -> list:
         """Candidate bundles aggregated into one deployment-safety view

@@ -24,7 +24,18 @@ slots). This module carries the recurrent ARCHITECTURE itself, working:
 
 Opt-in and standalone: nothing in the slot-level trainers routes here; use
 ``recurrent_ddpg_init/act/learn`` directly (tests/test_models.py drives a
-learning loop).
+learning loop; train/recurrent.py drives day-granular episodes on the real
+thermal/tariff physics and exports a servable bundle).
+
+Serving (ISSUE 14): the actor also runs SLOT-WISE. ``recurrent_actor_step``
+is the per-slot forward — the same Dense/LSTM/Dense math as one scan step
+of the full-sequence ``RecurrentActor``, with the two shared-weight LSTM
+passes' carries threaded explicitly as ONE flat hidden vector
+``[..., HIDDEN_MULT * lstm_features]`` (layout ``HIDDEN_LAYOUT``). That
+flat vector is what the serving engine carries per household in the donated
+device session ring (serve/engine.py ``Sessions.hidden``,
+serve/continuous.py); zeros are the deterministic fresh-session init,
+matching the full-sequence model's implicit zero carry.
 """
 
 from __future__ import annotations
@@ -93,6 +104,65 @@ class RecurrentCritic(nn.Module):
         h = nn.relu(nn.Dense(self.hidden_post)(h))
         h = nn.relu(nn.Dense(self.hidden_post)(h))
         return jnp.sum(nn.Dense(1)(h), axis=(-2, -1))
+
+
+# Flat per-agent hidden-state layout for slot-wise serving: the double
+# shared-weight LSTM pass needs two (cell, hidden) carries; they ride as one
+# [..., HIDDEN_MULT * lstm_features] vector so the serving ring is a single
+# donated array leaf. Zeros = fresh session (the full-sequence model's
+# implicit initial carry).
+HIDDEN_LAYOUT = ("pass1_c", "pass1_h", "pass2_c", "pass2_h")
+HIDDEN_MULT = len(HIDDEN_LAYOUT)
+
+
+def actor_hidden_dim(lstm_features: int = 100) -> int:
+    """Per-agent flat hidden width the serving carry needs."""
+    return HIDDEN_MULT * lstm_features
+
+
+def recurrent_actor_init_hidden(
+    batch_shape: Tuple[int, ...], lstm_features: int = 100
+) -> jnp.ndarray:
+    """Deterministic fresh-session hidden state (zeros), shape
+    ``batch_shape + (HIDDEN_MULT * lstm_features,)``."""
+    return jnp.zeros(tuple(batch_shape) + (actor_hidden_dim(lstm_features),))
+
+
+def recurrent_actor_step(
+    params: dict,
+    obs: jnp.ndarray,
+    hidden: jnp.ndarray,
+    lstm_features: int = 100,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One SLOT through the recurrent actor: the per-step body of the
+    full-sequence ``RecurrentActor`` scan.
+
+    ``params`` is the actor subtree exactly as ``RecurrentActor.init``
+    names it (``Dense_0/1/2/3`` + the shared ``OptimizedLSTMCell_0``);
+    ``obs`` is ``[..., OBS_DIM]``, ``hidden`` the flat
+    ``[..., HIDDEN_MULT * lstm_features]`` carry (``HIDDEN_LAYOUT`` order).
+    Returns ``(action [...], hidden')`` with the action squeezed off its
+    trailing unit axis. Feeding a zero carry and scanning this step over a
+    day reproduces ``RecurrentActor.apply`` on the whole sequence (the
+    serving-side continuity contract, asserted in tests/test_continuous.py
+    to the same ~1-ulp program-retiling tolerance the feedforward DDPG
+    actor carries).
+    """
+    cell = nn.OptimizedLSTMCell(lstm_features)
+    cp = params["OptimizedLSTMCell_0"]
+
+    def dense(name, x):
+        w = params[name]
+        return x @ w["kernel"] + w["bias"]
+
+    c1, h1, c2, h2 = jnp.split(hidden, HIDDEN_MULT, axis=-1)
+    h = nn.relu(dense("Dense_0", obs))
+    h = nn.relu(dense("Dense_1", h))
+    (c1, h1), y1 = cell.apply({"params": cp}, (c1, h1), h)
+    (c2, h2), y2 = cell.apply({"params": cp}, (c2, h2), y1)
+    h = nn.relu(dense("Dense_2", y2))
+    action = nn.sigmoid(dense("Dense_3", h))[..., 0]
+    return action, jnp.concatenate([c1, h1, c2, h2], axis=-1)
 
 
 class RecurrentDDPGState(NamedTuple):

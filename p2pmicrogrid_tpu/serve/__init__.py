@@ -41,6 +41,10 @@ trained policy given its observation:
                client library.
 """
 
+from p2pmicrogrid_tpu.serve.continuous import (
+    ContinuousBatcher,
+    serve_bench_continuous_compare,
+)
 from p2pmicrogrid_tpu.serve.engine import (
     MicroBatchQueue,
     PolicyEngine,
@@ -69,6 +73,8 @@ from p2pmicrogrid_tpu.serve.export import (
 from p2pmicrogrid_tpu.serve.loadgen import (
     RetryBudget,
     RetryPolicy,
+    bursty_arrivals,
+    make_arrivals,
     plan_open_loop,
     poisson_arrivals,
     run_network_loadgen,
@@ -134,6 +140,7 @@ __all__ = [
     "CanaryController",
     "CanaryResult",
     "ConsistentHashRing",
+    "ContinuousBatcher",
     "GateBudgets",
     "GateVerdict",
     "StageTraffic",
@@ -165,6 +172,7 @@ __all__ = [
     "WireProtocolError",
     "build_gateway",
     "build_registry",
+    "bursty_arrivals",
     "client_ssl_context",
     "encode_frame",
     "ensure_test_certs",
@@ -180,6 +188,7 @@ __all__ = [
     "load_policy_bundle",
     "load_secret",
     "load_secret_chain",
+    "make_arrivals",
     "mint_token",
     "rotate_secret",
     "plan_open_loop",
@@ -188,6 +197,7 @@ __all__ = [
     "run_fleet_loadgen",
     "run_network_loadgen",
     "serve_bench",
+    "serve_bench_continuous_compare",
     "serve_bench_fleet",
     "serve_bench_network",
     "serve_bench_wire_compare",

@@ -1,0 +1,818 @@
+"""Slot-level continuous batching: join/leave serving with a hidden-state ring.
+
+``MicroBatchQueue`` (serve/engine.py) dispatches a microbatch as ONE unit:
+the coalescing window holds early arrivals back up to ``max_wait_s``, and a
+request that misses a dispatch waits out the whole in-flight batch before
+its own batch even forms. Under bursty open-loop load those batch-boundary
+waits — not compute — set the p99 (ROADMAP open item 1). Orca-style
+iteration-level scheduling removes exactly that wait class: the engine
+steps continuously, and requests JOIN the padded in-flight batch between
+steps while completed rows RETIRE between steps, so nobody ever waits on a
+coalescing window or on somebody else's full batch.
+
+``ContinuousBatcher`` is that front, duck-typing ``MicroBatchQueue``
+(``submit``/``depth``/``recent_wait_ms``/``close``) so the gateway,
+registry stats and admission control work unchanged:
+
+* **Step loop.** A worker thread runs engine steps back-to-back whenever
+  work is pending. Each step takes up to ``max_batch`` queued requests
+  (FIFO), pads to the engine's power-of-two bucket, executes, and delivers
+  — then immediately composes the next step from whatever arrived in the
+  meantime. No window, no barrier: the worst join wait is the remaining
+  service of the CURRENT step.
+* **Row slots + household affinity.** With ``sessions`` on, each household
+  owns a row slot carrying its cross-slot session (served-action /
+  slot-count metadata; for recurrent bundles the policy's hidden state).
+  The household-affinity routing from the gateway/fleet tiers keeps a
+  household on one replica, so its slot — and therefore its hidden state —
+  is engine-side stable across its request stream.
+* **Generation counters.** Every slot carries a generation, bumped on
+  every retire/evict/reassign. A request's slot resolution is tagged
+  ``(slot, gen)`` when it joins a step, and state is only read/written
+  under a matching generation — a late joiner can never read a RETIRED
+  row's state: eviction re-allocates under a fresh generation with a
+  deterministic re-init (zero carry), never a stale buffer.
+* **Donated hidden-state ring.** For recurrent bundles the per-household
+  flat LSTM carry lives in ONE device array ``[S + 1, A, H]`` (row ``S``
+  is the scratch row pad rows gather from and scatter to). Each padding
+  bucket gets its own compiled step program — gather rows, zero the
+  fresh-session rows, step the actor, scatter the new carries back — with
+  the ring DONATED, so the carry updates in place instead of copying
+  ``S * A * H`` floats per step.
+* **Stateless bit-exactness.** Feedforward bundles execute through the
+  SAME per-bucket engine executables the microbatch path uses
+  (``engine.act``), so continuous serving is bit-exact vs the microbatch
+  queue for every stateless policy — only the queueing schedule moves,
+  never the math (asserted end-to-end through the gateway in
+  tests/test_continuous.py and by the committed ``SERVE_CB_*`` capture's
+  ``bit_exact_stateless`` verdict).
+* **Observability.** Every step emits ``serve.batch_occupancy`` (live
+  rows / padded bucket) and per-request ``serve.slot_wait_ms`` histograms
+  plus the same ``serve_request`` trace events the microbatch queue
+  streams (``source="continuous"``), so the continuous-vs-microbatch win
+  is attributable in the SQLite warehouse (``telemetry-query
+  --continuous``), not just in a capture file.
+
+Anonymous requests (no household id) and ``sessions=False`` serving run
+each request from a fresh deterministic zero carry on the scratch row —
+recurrent bundles stay servable for smoke traffic, but only a household id
+buys continuity. A recurrent bundle with ``sessions=False`` is REFUSED at
+construction, and under slot exhaustion a recurrent household's request is
+DEFERRED (FIFO position kept; it joins once a resident household goes
+idle) rather than silently served from a zero carry — serving a
+hidden-state policy without its state would be a different policy.
+Stateless households do overflow to the scratch row (their actions depend
+only on the observation; only session metadata is lost).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class _SlotMeta:
+    """Host-side bookkeeping for one row slot."""
+
+    household: Optional[str] = None
+    gen: int = 0
+    last_used: int = -1       # step counter, for deterministic LRU
+    fresh: bool = True        # next read must re-init (zero carry)
+    served: int = 0           # session slot counter (Sessions.slots mirror)
+    hp_frac: Optional[np.ndarray] = None  # [A] last served action
+
+
+@dataclass
+class _Request:
+    obs: np.ndarray
+    future: Future
+    t_enq: float
+    household: Optional[str]
+    slot: int = -1
+    gen: int = -1
+    fresh: bool = True
+
+
+class ContinuousBatcher:
+    """Slot-level continuous batching front over a ``PolicyEngine``.
+
+    Duck-types ``MicroBatchQueue`` for the gateway/registry. ``max_slots``
+    bounds resident sessions (LRU eviction past it, deterministic re-init
+    on return); ``sessions=False`` disables per-household state entirely
+    (stateless bundles only). ``max_wait_s`` is accepted for interface
+    compatibility and ignored — continuous batching has no coalescing
+    window, which is the point.
+    """
+
+    SCRATCH = -1  # sentinel: request runs from the scratch row, no session
+
+    def __init__(
+        self,
+        engine,
+        max_batch: Optional[int] = None,
+        max_wait_s: float = 0.0,
+        max_slots: int = 256,
+        sessions: bool = True,
+        autostart: bool = True,
+        slot_wait_timeout_s: float = 5.0,
+    ):
+        del max_wait_s  # no coalescing window by design
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if getattr(engine, "is_recurrent", False) and not sessions:
+            raise ValueError(
+                "recurrent bundle needs sessions: serving a hidden-state "
+                "policy with sessions disabled would silently act from a "
+                "zero carry every slot — a different policy. Enable "
+                "sessions (the default) or export a feedforward bundle."
+            )
+        self.engine = engine
+        self.max_batch = min(max_batch or engine.max_batch, engine.max_batch)
+        self.sessions_enabled = sessions
+        self.max_slots = max_slots
+        # How long a recurrent household's request may wait for a session
+        # slot under exhaustion before it FAILS LOUDLY naming the fix
+        # (raise max_slots) — unbounded deferral would starve un-slotted
+        # households invisibly once resident households saturate the ring.
+        self.slot_wait_timeout_s = slot_wait_timeout_s
+        self._pending: List[_Request] = []
+        self._cv = threading.Condition()
+        self._closed = False
+        # Admission signal window, same shape as MicroBatchQueue's:
+        # (monotonic dispatch instant, enqueue->dispatch wait ms).
+        self.recent_wait_ms: deque = deque(maxlen=512)
+        # Host-side slot table. Device state (the recurrent hidden ring)
+        # lives separately in _ring; the table is the source of truth for
+        # WHO owns a row and under which generation.
+        self._slots: List[_SlotMeta] = [_SlotMeta() for _ in range(max_slots)]
+        self._by_household: Dict[str, int] = {}
+        self._free: deque = deque(range(max_slots))
+        self._step_counter = 0
+        self.stats = {
+            "steps": 0, "joins": 0, "evictions": 0, "retired": 0,
+            "scratch_rows": 0, "stale_generation_drops": 0,
+            "slot_deferrals": 0, "slot_wait_expired": 0,
+            "cancelled_drops": 0,
+        }
+        self._ring = None
+        self._ring_step = None
+        if engine.is_recurrent:
+            self._ring = self._init_ring()
+            self._ring_step = self._make_ring_step()
+        # ``autostart=False`` is the manual-stepping mode: no worker
+        # thread; the owner drives ``step_once()`` itself — an external
+        # control loop embedding the batcher, and the deterministic unit
+        # tests (step composition becomes timing-independent).
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+
+    # -- device ring ---------------------------------------------------------
+
+    def _init_ring(self):
+        import jax
+        import jax.numpy as jnp
+
+        ring = jnp.zeros(
+            (self.max_slots + 1, self.engine.n_agents, self.engine.hidden_dim),
+            jnp.float32,
+        )
+        if self.engine.device is not None:
+            ring = jax.device_put(ring, self.engine.device)
+        return ring
+
+    def _make_ring_step(self):
+        """The per-bucket compiled step program: gather the stepping rows'
+        carries out of the ring, zero the fresh-session rows, run the
+        recurrent actor one slot, scatter the new carries back. The ring is
+        DONATED — the previous step's buffer is consumed in place. One
+        jitted callable; XLA caches one executable per bucket shape."""
+        import jax
+
+        act_raw = self.engine._act_raw
+
+        def step(params, ring, obs, rows, fresh):
+            h = ring[rows]                                   # [b, A, H]
+            h = h * (1.0 - fresh)[:, None, None]             # re-init rows
+            actions, h2 = act_raw(params, obs, h)
+            ring = ring.at[rows].set(h2)                     # pads -> scratch
+            return ring, actions
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    def warmup(self, buckets=None) -> List[int]:
+        """Pre-compile the step program per padding bucket (recurrent) or
+        the engine's act buckets (stateless) so the first request of each
+        size never pays an XLA compile in-slot."""
+        import jax
+
+        if not self.engine.is_recurrent:
+            return self.engine.warmup(buckets, include_step=False)
+        warmed = []
+        for b in buckets if buckets is not None else self.engine.buckets:
+            if b > self.max_batch:
+                continue
+            obs = np.zeros((b, self.engine.n_agents, 4), np.float32)
+            rows = np.full((b,), self.max_slots, np.int32)  # scratch only
+            fresh = np.ones((b,), np.float32)
+            self._ring, _ = self._ring_step(
+                self.engine.params, self._ring, obs, rows, fresh
+            )
+            # host-sync: warmup compile boundary (pre-traffic).
+            jax.block_until_ready(self._ring)
+            warmed.append(b)
+        return warmed
+
+    # -- public queue interface ----------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        """Requests queued but not yet joined to a step (admission
+        signal)."""
+        with self._cv:
+            return len(self._pending)
+
+    def submit(self, obs_row, household: Optional[str] = None) -> Future:
+        """Queue one community observation row; resolves to actions [A].
+
+        ``household`` pins the request to its session slot (hidden-state
+        continuity for recurrent bundles); ``None`` serves from a fresh
+        deterministic zero carry on the scratch row."""
+        # host-sync: caller-supplied host observation row.
+        obs_row = np.asarray(obs_row, dtype=np.float32)
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            self._pending.append(
+                _Request(
+                    obs=obs_row, future=fut, t_enq=time.monotonic(),
+                    household=household if self.sessions_enabled else None,
+                )
+            )
+            self._cv.notify()
+        return fut
+
+    def step_once(self) -> int:
+        """Compose and execute ONE engine step synchronously; returns the
+        number of rows stepped (0 = nothing pending). Manual-stepping
+        companion to ``autostart=False`` — never call it with the worker
+        thread running."""
+        with self._cv:
+            batch = self._compose_locked()
+        if batch:
+            try:
+                self._execute(batch)
+            except Exception as err:  # noqa: BLE001 — fail waiters too
+                for req in batch:
+                    if not req.future.done():
+                        try:
+                            req.future.set_exception(err)
+                        except InvalidStateError:
+                            pass
+                raise
+        return len(batch)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- session lifecycle ----------------------------------------------------
+
+    def session_info(self, household: str) -> Optional[dict]:
+        """Test/observability hook: the household's live slot state, or
+        None when it holds no slot."""
+        with self._cv:
+            slot = self._by_household.get(household)
+            if slot is None:
+                return None
+            m = self._slots[slot]
+            return {
+                "slot": slot,
+                "gen": m.gen,
+                "served": m.served,
+                "hp_frac": None if m.hp_frac is None else m.hp_frac.copy(),
+            }
+
+    def end_session(self, household: str) -> bool:
+        """Retire a household's slot NOW (gen bump + free-list return).
+        Its next request re-initializes deterministically. Returns whether
+        a session existed."""
+        with self._cv:
+            slot = self._by_household.pop(household, None)
+            if slot is None:
+                return False
+            self._retire_locked(slot)
+            self.stats["retired"] += 1
+            return True
+
+    def _retire_locked(self, slot: int) -> None:
+        m = self._slots[slot]
+        m.household = None
+        m.gen += 1
+        m.fresh = True
+        m.served = 0
+        m.hp_frac = None
+        self._free.append(slot)
+
+    @property
+    def occupancy(self) -> int:
+        """Resident sessions (slots owned by a household)."""
+        with self._cv:
+            return self.max_slots - len(self._free)
+
+    # -- slot resolution (lock held) ------------------------------------------
+
+    def _resolve_slot_locked(self, household: str, pending_households) -> int:
+        """The household's slot, allocating (and LRU-evicting an idle slot
+        of a household with no queued work) when needed. Returns
+        ``SCRATCH`` when every slot is unavailable this step."""
+        slot = self._by_household.get(household)
+        if slot is not None:
+            return slot
+        if self._free:
+            slot = self._free.popleft()
+        else:
+            # Deterministic LRU eviction among slots whose household has
+            # nothing queued: same arrival schedule, same victim. Slots of
+            # households WITH queued requests are kept — evicting one
+            # would break a continuity the very next step re-pays.
+            candidates = [
+                (m.last_used, i) for i, m in enumerate(self._slots)
+                if m.household is not None
+                and m.household not in pending_households
+            ]
+            if not candidates:
+                return self.SCRATCH
+            _, slot = min(candidates)
+            self._by_household.pop(self._slots[slot].household, None)
+            self._retire_locked(slot)
+            self._free.remove(slot)
+            self.stats["evictions"] += 1
+        m = self._slots[slot]
+        m.household = household
+        m.fresh = True
+        m.served = 0
+        m.hp_frac = None
+        self._by_household[household] = slot
+        self.stats["joins"] += 1
+        return slot
+
+    # -- the step loop --------------------------------------------------------
+
+    def _compose_locked(self):
+        """Pop the next step's requests off the FIFO queue, resolving each
+        to a (slot, gen) under the current generations. For RECURRENT
+        engines, at most one request per slot per step — a household's
+        back-to-back requests serialize through consecutive steps (each
+        must read the carry the previous one writes); later households may
+        overtake an earlier one's SECOND request, never its first
+        (per-household order is preserved). Stateless engines skip the
+        serialization: their rows are order-independent, so a household's
+        burst rides one step. Cancelled requests are dropped; recurrent
+        requests that out-waited ``slot_wait_timeout_s`` for a slot fail
+        loudly naming the ``max_slots`` fix."""
+        batch: List[_Request] = []
+        expired: List[_Request] = []
+        taken: set = set()
+        deferred: set = set()
+        recurrent = self.engine.is_recurrent
+        now = time.monotonic()
+        pending_households = {
+            r.household for r in self._pending if r.household is not None
+        }
+        remaining: List[_Request] = []
+        for req in self._pending:
+            if req.future.cancelled():
+                # The caller gave up (gateway request timeout): dropping
+                # the corpse here keeps the admission depth honest, and —
+                # for recurrent sessions — never advances a household's
+                # carry for a request nobody is waiting on.
+                self.stats["cancelled_drops"] += 1
+                continue
+            if len(batch) >= self.max_batch:
+                remaining.append(req)
+                continue
+            if req.household is None:
+                req.slot, req.gen, req.fresh = self.SCRATCH, -1, True
+                batch.append(req)
+                continue
+            if req.household in deferred:
+                remaining.append(req)
+                continue
+            slot = self._by_household.get(req.household)
+            if recurrent and slot is not None and slot in taken:
+                # This household already steps this round: its next
+                # request rides the NEXT step, reading the carry this
+                # step is about to write. Recurrent-only — a stateless
+                # household's rows are order-independent (actions depend
+                # on the obs alone), so serializing them would pay K step
+                # latencies for bookkeeping metadata.
+                deferred.add(req.household)
+                remaining.append(req)
+                continue
+            if slot is None:
+                slot = self._resolve_slot_locked(
+                    req.household, pending_households
+                )
+            if slot == self.SCRATCH:
+                if recurrent:
+                    # Slot exhaustion: a hidden-state household must NEVER
+                    # silently serve from the scratch row's zero carry —
+                    # that is the different-policy class the micro-queue
+                    # and sessions=False refusals exist for. Defer: the
+                    # request keeps its FIFO position and joins as soon as
+                    # a resident household goes idle (its slot becomes the
+                    # LRU eviction candidate). Bounded: past
+                    # slot_wait_timeout_s the request FAILS loudly naming
+                    # the fix instead of starving invisibly. Stateless
+                    # households DO fall through to scratch — their
+                    # actions depend on the observation only; all that is
+                    # lost is session metadata, and latency beats a stall.
+                    if now - req.t_enq > self.slot_wait_timeout_s:
+                        expired.append(req)
+                        continue
+                    self.stats["slot_deferrals"] += 1
+                    deferred.add(req.household)
+                    remaining.append(req)
+                    continue
+                req.slot, req.gen, req.fresh = self.SCRATCH, -1, True
+                batch.append(req)
+                continue
+            m = self._slots[slot]
+            req.slot, req.gen, req.fresh = slot, m.gen, m.fresh
+            taken.add(slot)
+            batch.append(req)
+        self._pending = remaining
+        for req in expired:
+            self.stats["slot_wait_expired"] += 1
+            if not req.future.done():
+                try:
+                    req.future.set_exception(
+                        RuntimeError(
+                            "no session slot freed within "
+                            f"{self.slot_wait_timeout_s:g}s: max_slots="
+                            f"{self.max_slots} is below this replica's "
+                            "concurrent recurrent household count — raise "
+                            "--max-sessions (or spread households over "
+                            "more replicas)"
+                        )
+                    )
+                except InvalidStateError:
+                    pass
+        return batch
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending and self._closed:
+                    return
+                batch = self._compose_locked()
+            if not batch:
+                # Defensive only — compose always joins >= 1 request when
+                # work is pending today (resident households' requests are
+                # joinable, and a fully-idle-occupied slot table is
+                # evictable). Kept so a future composition rule that CAN
+                # defer everything parks on the condition briefly instead
+                # of hot-spinning this lock.
+                with self._cv:
+                    self._cv.wait(timeout=0.001)
+                continue
+            try:
+                self._execute(batch)
+            except Exception as err:  # noqa: BLE001 — fail waiters, not loop
+                for req in batch:
+                    if not req.future.done():
+                        try:
+                            req.future.set_exception(err)
+                        except InvalidStateError:
+                            pass
+
+    def _execute(self, batch: List[_Request]) -> None:
+        import jax
+
+        b = len(batch)
+        bucket = self.engine.bucket_for(b)
+        obs = np.stack([r.obs for r in batch])
+        dispatch_t = time.monotonic()
+        for req in batch:
+            self.recent_wait_ms.append(
+                (dispatch_t, (dispatch_t - req.t_enq) * 1e3)
+            )
+        if self.engine.is_recurrent:
+            if bucket > b:
+                obs = np.concatenate(
+                    [obs, np.zeros((bucket - b,) + obs.shape[1:], obs.dtype)]
+                )
+            rows = np.full((bucket,), self.max_slots, np.int32)
+            fresh = np.ones((bucket,), np.float32)
+            for i, req in enumerate(batch):
+                if req.slot != self.SCRATCH:
+                    rows[i] = req.slot
+                    fresh[i] = 1.0 if req.fresh else 0.0
+            self._ring, actions = self._ring_step(
+                self.engine.params, self._ring, obs, rows, fresh
+            )
+            # host-sync: the per-step serving latency boundary — the
+            # batch's waiters need their actions NOW.
+            actions = np.asarray(jax.block_until_ready(actions))[:b]
+            self.engine.stats["rows"] += b
+            self.engine.stats["batches"] += 1
+            self.engine.stats["padded_rows"] += bucket - b
+            tel = self.engine.telemetry
+            if tel is not None:
+                tel.counter("serve.requests", b)
+                tel.counter("serve.batches")
+                tel.counter("serve.padded_rows", bucket - b)
+        else:
+            # The SAME per-bucket executables the microbatch path runs —
+            # continuous serving is bit-exact vs MicroBatchQueue for every
+            # stateless policy by construction.
+            actions = self.engine.act(obs)
+        service_s = time.monotonic() - dispatch_t
+
+        with self._cv:
+            self._step_counter += 1
+            self.stats["steps"] += 1
+            for i, req in enumerate(batch):
+                if req.slot == self.SCRATCH:
+                    self.stats["scratch_rows"] += 1
+                    continue
+                m = self._slots[req.slot]
+                if m.gen != req.gen or m.household != req.household:
+                    # The slot was retired/reassigned between composition
+                    # and delivery (end_session racing the step): the
+                    # answer is still correct — it was computed under the
+                    # request's own generation — but the RETIRED slot's
+                    # state must not be touched under a stale generation.
+                    self.stats["stale_generation_drops"] += 1
+                    continue
+                m.fresh = False
+                m.served += 1
+                m.last_used = self._step_counter
+                m.hp_frac = actions[i].copy()
+        for i, req in enumerate(batch):
+            if req.future.cancelled():
+                continue
+            try:
+                # host-sync: result delivery to the waiting future.
+                req.future.set_result(np.asarray(actions[i]))
+            except InvalidStateError:
+                pass  # cancelled between the check and delivery
+        try:
+            self._trace(batch, b, bucket, dispatch_t, service_s)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+
+    def _trace(
+        self, batch, b: int, bucket: int, dispatch_t: float, service_s: float
+    ) -> None:
+        """Per-step occupancy + per-request slot-wait records through the
+        engine's telemetry: the queueing story the warehouse attributes the
+        continuous-vs-microbatch win with."""
+        tel = self.engine.telemetry
+        if tel is None:
+            return
+        tel.counter("serve.steps")
+        tel.histogram("serve.batch_occupancy", b / bucket)
+        for row_i, req in enumerate(batch):
+            wait_ms = (dispatch_t - req.t_enq) * 1e3
+            tel.histogram("serve.slot_wait_ms", wait_ms)
+            tel.event(
+                "serve_request",
+                source="continuous",
+                row=row_i,
+                batch_size=b,
+                bucket=bucket,
+                padded_rows=bucket - b,
+                slot=None if req.slot == self.SCRATCH else req.slot,
+                wait_ms=round(wait_ms, 3),
+                service_ms=round(service_s * 1e3, 3),
+                latency_ms=round(wait_ms + service_s * 1e3, 3),
+            )
+
+
+# -- the acceptance measurement -----------------------------------------------
+#
+# serve-bench --continuous-compare / benchmarks.py bench_serve_continuous:
+# the SAME bursty open-loop schedule fired over the persistent mux wire
+# through a microbatch gateway and a continuous-batching gateway in ONE
+# process, same bundle, same observations — per-arm wire percentiles, a
+# bit-exactness verdict across the arms AND against a direct engine, and
+# the continuous arm's occupancy/slot-wait distributions. The committed
+# ``artifacts/SERVE_CB_*.jsonl`` captures come from here and
+# ``tools/check_artifacts_schema.py`` validates their contract.
+
+
+def serve_bench_continuous_compare(
+    bundle_dir: str,
+    rate_hz: float = 256.0,
+    n_requests: int = 1024,
+    n_households: int = 32,
+    seed: int = 0,
+    slo_ms: float = 100.0,
+    burst_factor: float = 8.0,
+    burst_dwell_s: float = 0.25,
+    max_batch: int = 64,
+    max_wait_s: float = 0.002,
+    max_slots: int = 256,
+    device: str = "auto",
+    results_db: Optional[str] = None,
+    timeout_s: float = 30.0,
+    emit=None,
+) -> List[dict]:
+    """Continuous vs microbatch at the mux wire, one process, one bundle.
+
+    Emits (and returns) metric rows; the LAST row is the ``serve_continuous``
+    headline carrying both arms' percentiles, ``vs_microbatch`` (microbatch
+    p99 / continuous p99 — > 1 means continuous wins), the
+    ``bit_exact_stateless`` verdict, the continuous arm's
+    occupancy/slot-wait stats and the generating ``burst_config``.
+    Stateless bundles only: the microbatch arm cannot serve a recurrent
+    bundle at all, so there is nothing to compare (refused loudly)."""
+    from p2pmicrogrid_tpu.serve.engine import PolicyEngine
+    from p2pmicrogrid_tpu.serve.gateway import (
+        AdmissionConfig,
+        GatewayServer,
+        build_gateway,
+    )
+    from p2pmicrogrid_tpu.serve.loadgen import (
+        make_arrivals,
+        run_network_loadgen,
+        synthetic_obs,
+    )
+
+    reference = PolicyEngine(
+        bundle_dir=bundle_dir, max_batch=max_batch, device=device
+    )
+    if reference.is_recurrent:
+        raise ValueError(
+            "--continuous-compare needs a stateless bundle: the microbatch "
+            "arm refuses recurrent bundles, so there is no baseline to "
+            "beat — bench a recurrent bundle through serve-bench --fleet "
+            "--batching continuous instead"
+        )
+    arrivals, burst_config = make_arrivals(
+        rate_hz, n_requests, seed=seed,
+        burst_factor=burst_factor, burst_dwell_s=burst_dwell_s,
+    )
+    obs = synthetic_obs(n_requests, reference.n_agents, seed=seed)
+    households = [f"house-{i:04d}" for i in range(n_households)]
+    # Admission wide open: the comparison measures queueing discipline, not
+    # shedding — a shed request would vanish from exactly the tail this
+    # capture exists to show.
+    admission = AdmissionConfig(max_queue_depth=1 << 16, wait_budget_ms=1e9)
+
+    results, arm_tel = {}, {}
+    for batching in ("micro", "continuous"):
+        gateway = build_gateway(
+            [bundle_dir],
+            max_batch=max_batch,
+            max_wait_s=max_wait_s,
+            results_db=results_db,
+            device=device,
+            admission=admission,
+            run_name=f"serve-cb-{batching}",
+            mux_port=0,
+            batching=batching,
+            max_slots=max_slots,
+        )
+        server = GatewayServer(gateway)
+        try:
+            host, _port = server.start()
+            results[batching] = run_network_loadgen(
+                host, gateway.mux_port, obs, arrivals, households,
+                timeout_s=timeout_s, transport="mux",
+                record_actions=True,
+            )
+            default = gateway.registry.get(gateway.registry.default_hash)
+            arm_tel[batching] = (
+                default.telemetry.summary() if default.telemetry else {}
+            )
+        finally:
+            server.stop()
+
+    micro, cont = results["micro"], results["continuous"]
+    # Bit-exactness across the arms AND against the direct engine, on every
+    # request both arms answered.
+    ok = [
+        i for i in range(n_requests)
+        if micro.statuses[i] == 200 and cont.statuses[i] == 200
+        and micro.actions[i] is not None and cont.actions[i] is not None
+    ]
+    if not ok:
+        # A verdict over zero compared requests would be indistinguishable
+        # from a real bit-exactness failure in the schema-checked capture —
+        # refuse to produce a meaningless acceptance row.
+        raise RuntimeError(
+            "continuous compare: no request succeeded on BOTH arms "
+            f"(micro ok={micro.n_ok}, continuous ok={cont.n_ok} of "
+            f"{n_requests}) — nothing compared; raise timeout_s or loosen "
+            "the schedule before trusting any capture from this host"
+        )
+    got_m = np.asarray(  # host-sync: wire responses, host data
+        [micro.actions[i] for i in ok], np.float32
+    )
+    got_c = np.asarray(  # host-sync: wire responses, host data
+        [cont.actions[i] for i in ok], np.float32
+    )
+    want = reference.act(obs[ok])
+    mismatches = int(
+        ((got_m != want) | (got_c != want)).any(axis=-1).sum()
+    )
+    bit_exact = mismatches == 0
+
+    p50_c, p95_c, p99_c = (cont.latency_ms(q) for q in (50, 95, 99))
+    p50_m, p95_m, p99_m = (micro.latency_ms(q) for q in (50, 95, 99))
+    vs_microbatch = round(p99_m / p99_c, 3) if p99_c > 0 else 0.0
+    hists = arm_tel.get("continuous", {}).get("histograms", {})
+    occupancy = hists.get("serve.batch_occupancy", {})
+    slot_wait = hists.get("serve.slot_wait_ms", {})
+
+    rows = [
+        {
+            "metric": f"serve_continuous_latency_ms_p{q}",
+            "value": round(v, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / v, 2) if v > 0 else 0.0,
+        }
+        for q, v in (("50", p50_c), ("95", p95_c), ("99", p99_c))
+    ]
+    rows.append(
+        {
+            "metric": "serve_microbatch_latency_ms_p99",
+            "value": round(p99_m, 3),
+            "unit": "ms",
+            "vs_baseline": round(slo_ms / p99_m, 2) if p99_m > 0 else 0.0,
+        }
+    )
+    rows.append(
+        {
+            "metric": "serve_continuous",
+            "value": vs_microbatch,
+            "unit": "x_p99_speedup",
+            # >= 1.0 means slot-level continuous batching beats the
+            # full-batch microbatch queue on p99 under this schedule —
+            # the acceptance bar for the committed bursty captures.
+            "vs_baseline": vs_microbatch,
+            "p50_ms": round(p50_c, 3),
+            "p95_ms": round(p95_c, 3),
+            "p99_ms": round(p99_c, 3),
+            "micro_p50_ms": round(p50_m, 3),
+            "micro_p95_ms": round(p95_m, 3),
+            "micro_p99_ms": round(p99_m, 3),
+            "vs_microbatch": vs_microbatch,
+            "bit_exact_stateless": bit_exact,
+            "bit_exact_mismatches": mismatches,
+            "n_compared": len(ok),
+            "occupancy_mean": round(float(occupancy.get("mean", 0.0)), 4),
+            "occupancy_p50": round(float(occupancy.get("p50", 0.0)), 4),
+            "occupancy_p95": round(float(occupancy.get("p95", 0.0)), 4),
+            "slot_wait_p50_ms": round(float(slot_wait.get("p50", 0.0)), 3),
+            "slot_wait_p95_ms": round(float(slot_wait.get("p95", 0.0)), 3),
+            "engine_steps": int(
+                arm_tel.get("continuous", {}).get("counters", {}).get(
+                    "serve.steps", 0
+                )
+            ),
+            "throughput_rps": round(cont.throughput_rps, 1),
+            "micro_throughput_rps": round(micro.throughput_rps, 1),
+            "n_requests": n_requests,
+            "n_ok": cont.n_ok,
+            "micro_n_ok": micro.n_ok,
+            "n_households": n_households,
+            "offered_rate_rps": rate_hz,
+            "slo_ms": slo_ms,
+            "transport": "mux",
+            "max_batch": max_batch,
+            "max_wait_ms": round(max_wait_s * 1e3, 3),
+            "max_sessions": max_slots,
+            "burst_config": burst_config,
+            "implementation": reference.manifest.get("implementation"),
+            "n_agents": reference.n_agents,
+            "config_hash": reference.manifest.get("config_hash"),
+        }
+    )
+    if emit is not None:
+        for row in rows:
+            emit(row)
+    return rows

@@ -33,14 +33,19 @@ Design points:
 * **Sessions.** ``init_sessions``/``step`` carry per-household cross-slot
   state (previous served action — the env's round-0 ``hp_frac`` carry — and
   a served-slot counter) through a donated-buffer jitted step, so a
-  controller loop holds one live array instead of re-shipping state. The
-  shipped greedy policies are feedforward (actions depend on the observation
-  only); the session carry is the contract a recurrent policy (e.g.
-  models/ddpg_recurrent.py) would extend with its hidden state.
+  controller loop holds one live array instead of re-shipping state.
+  Recurrent bundles (manifest ``hidden_state``, models/ddpg_recurrent.py)
+  extend the carry with their per-agent flat LSTM hidden state:
+  ``act(obs, hidden)`` threads it explicitly, ``Sessions.hidden`` rides the
+  donated step, and a recurrent bundle REFUSES to act without a carry — a
+  hidden-state policy served statelessly is a different policy.
 
 * **Microbatching.** ``MicroBatchQueue`` fronts the engine for concurrent
   callers: single-community requests coalesce until ``max_batch`` or
-  ``max_wait_s``, then execute as one padded batch.
+  ``max_wait_s``, then execute as one padded batch. It refuses recurrent
+  bundles (sessions are disabled on the full-batch path); the slot-level
+  continuous batcher (serve/continuous.py) is the session-carrying front
+  — and the lower-p99 one under bursty load.
 """
 
 from __future__ import annotations
@@ -55,10 +60,16 @@ import numpy as np
 
 
 class Sessions(NamedTuple):
-    """Per-community serving sessions (leaves [N, ...])."""
+    """Per-community serving sessions (leaves [N, ...]).
+
+    ``hidden`` is ``None`` for the feedforward policies; a recurrent bundle
+    (manifest ``hidden_state``) carries its per-household flat LSTM carry
+    ``[N, A, H]`` here — state the POLICY reads, not just bookkeeping, so it
+    must ride the same donated device step as the rest of the session."""
 
     hp_frac: object  # [N, A] last served action fraction
     slots: object    # [N] int32 slots served
+    hidden: object = None  # [N, A, H] recurrent carry (None: feedforward)
 
 
 # Process-wide AOT executable cache for the padding-bucket act programs,
@@ -106,6 +117,11 @@ def _arch_signature(manifest: dict) -> tuple:
         return ("tabular",) + tuple(sorted((k, v) for k, v in q.items()))
     if impl == "dqn":
         return ("dqn", model.get("hidden"))
+    if impl == "ddpg_recurrent":
+        return (
+            "ddpg_recurrent", model.get("hidden_pre"),
+            model.get("lstm_features"), model.get("hidden_post"),
+        )
     return (
         "ddpg", model.get("actor_hidden"), bool(model.get("share_across_agents"))
     )
@@ -158,6 +174,14 @@ class PolicyEngine:
         self.telemetry = telemetry
         self.n_agents = int(manifest["n_agents"])
         self._impl = manifest["implementation"]
+        # Recurrent bundles (manifest ``hidden_state``) thread a per-agent
+        # flat carry through every act: the serving contract sizes the
+        # session ring from the manifest block, never the arch fields.
+        hidden_spec = manifest.get("hidden_state")
+        self.is_recurrent = hidden_spec is not None
+        self.hidden_dim = (
+            int(hidden_spec["shape"][-1]) if self.is_recurrent else 0
+        )
         # Crossover-driven placement (train/placement.py): tiny communities'
         # greedy passes are dispatch-bound and measured faster on host
         # XLA-CPU — 'auto' serves them from there the way training places
@@ -259,6 +283,27 @@ class PolicyEngine:
 
             return act
 
+        if impl == "ddpg_recurrent":
+            from p2pmicrogrid_tpu.models.ddpg_recurrent import (
+                recurrent_actor_step,
+            )
+
+            lstm_features = model["lstm_features"]
+
+            # One shared actor across agents AND batch rows: flatten [B, A]
+            # into the leading axis, step the LSTM cell once, restore.
+            def act(params, obs, hidden):  # [B,A,4], [B,A,H] -> ([B,A], ')
+                B, A, F = obs.shape
+                a, h = recurrent_actor_step(
+                    params,
+                    obs.reshape(B * A, F),
+                    hidden.reshape(B * A, hidden.shape[-1]),
+                    lstm_features=lstm_features,
+                )
+                return a.reshape(B, A), h.reshape(B, A, h.shape[-1])
+
+            return act
+
         if impl == "ddpg":
             from p2pmicrogrid_tpu.models.networks import Actor
 
@@ -331,6 +376,14 @@ class PolicyEngine:
         cache = _aot_cache_for(self._aot_key)
         for b in buckets if buckets is not None else self.buckets:
             obs = np.zeros((b, self.n_agents, 4), dtype=np.float32)
+            # Recurrent programs take the hidden carry as a third operand;
+            # the AOT cache key's arch signature already separates them
+            # from same-shape feedforward programs.
+            operands = (
+                (obs, np.zeros((b, self.n_agents, self.hidden_dim),
+                               np.float32))
+                if self.is_recurrent else (obs,)
+            )
             cached = cache.get(b)
             if cached is not None and not profile:
                 # AOT hit: a same-architecture bucket program was already
@@ -348,7 +401,7 @@ class PolicyEngine:
                 # jit-call caches are separate, so profiling via the jit
                 # wrapper would compile each bucket twice.
                 compiled, _ = profile_and_compile(
-                    self._act_jit, self.params, obs,
+                    self._act_jit, self.params, *operands,
                     label=f"serve_bucket_{b}", telemetry=self.telemetry,
                     extra={"bucket": b, "n_agents": self.n_agents},
                 )
@@ -357,19 +410,21 @@ class PolicyEngine:
                     cache[b] = compiled
                 self.stats["aot_compiles"] += 1
                 # host-sync: warmup compile boundary (pre-traffic).
-                jax.block_until_ready(compiled(self.params, obs))
+                jax.block_until_ready(compiled(self.params, *operands))
             else:
                 # AOT-compile the bucket program explicitly
                 # (jit(...).lower().compile()) so later same-arch engines
                 # hit the cache instead of recompiling.
-                compiled = self._act_jit.lower(self.params, obs).compile()
+                compiled = self._act_jit.lower(
+                    self.params, *operands
+                ).compile()
                 self._compiled[b] = compiled
                 cache[b] = compiled
                 self.stats["aot_compiles"] += 1
                 if self.telemetry is not None:
                     self.telemetry.counter("serve.aot_compile")
                 # host-sync: warmup compile boundary (pre-traffic).
-                jax.block_until_ready(compiled(self.params, obs))
+                jax.block_until_ready(compiled(self.params, *operands))
             if include_step:
                 # host-sync: warmup compile boundary (pre-traffic).
                 jax.block_until_ready(
@@ -388,22 +443,72 @@ class PolicyEngine:
             )
         return obs
 
-    def act(self, obs) -> np.ndarray:
+    def act(self, obs, hidden=None):
         """Greedy actions for a batch of community observations.
 
         obs [B, A, 4] -> hp fraction [B, A]. B may exceed ``max_batch``
         (the batch is split); sub-bucket batches are zero-padded and the pad
         rows discarded.
+
+        Recurrent bundles THREAD the carry: pass ``hidden`` [B, A, H]
+        (``init_hidden`` for fresh sessions) and get ``(actions [B, A],
+        hidden' [B, A, H])`` back. Calling a recurrent bundle without
+        ``hidden`` is refused loudly — a hidden-state policy served
+        statelessly is a different (wrong) policy, not a degraded one.
+        Feedforward bundles refuse a ``hidden`` argument symmetrically.
         """
         obs = self._check_obs(obs)
+        if self.is_recurrent and hidden is None:
+            raise ValueError(
+                "recurrent bundle: act() needs the hidden carry "
+                "([B, A, H]; init_hidden() for fresh sessions) — serve it "
+                "through session-carrying paths (ContinuousBatcher with "
+                "sessions on), not the stateless act/microbatch path"
+            )
+        if not self.is_recurrent and hidden is not None:
+            raise ValueError(
+                f"{self._impl!r} bundle is feedforward — it takes no "
+                "hidden carry"
+            )
+        if hidden is not None:
+            hidden = self._check_hidden(hidden, obs.shape[0])
         if obs.shape[0] == 0:
-            return np.zeros((0, self.n_agents), dtype=np.float32)
-        outs = []
+            empty = np.zeros((0, self.n_agents), dtype=np.float32)
+            if self.is_recurrent:
+                return empty, np.zeros(
+                    (0, self.n_agents, self.hidden_dim), np.float32
+                )
+            return empty
+        outs, hiddens = [], []
         for i in range(0, obs.shape[0], self.max_batch):
-            outs.append(self._act_one_batch(obs[i : i + self.max_batch]))
+            out = self._act_one_batch(
+                obs[i : i + self.max_batch],
+                hidden[i : i + self.max_batch] if hidden is not None else None,
+            )
+            if self.is_recurrent:
+                outs.append(out[0])
+                hiddens.append(out[1])
+            else:
+                outs.append(out)
+        if self.is_recurrent:
+            return (
+                np.concatenate(outs, axis=0),
+                np.concatenate(hiddens, axis=0),
+            )
         return np.concatenate(outs, axis=0)
 
-    def _act_one_batch(self, obs: np.ndarray) -> np.ndarray:
+    def _check_hidden(self, hidden, n_rows: int) -> np.ndarray:
+        # host-sync: caller-supplied host carry, not device values.
+        hidden = np.asarray(hidden, dtype=np.float32)
+        want = (n_rows, self.n_agents, self.hidden_dim)
+        if hidden.shape != want:
+            raise ValueError(
+                f"hidden carry must be {list(want)} for this bundle, "
+                f"got {list(hidden.shape)}"
+            )
+        return hidden
+
+    def _act_one_batch(self, obs: np.ndarray, hidden=None):
         import jax
 
         b = obs.shape[0]
@@ -411,11 +516,18 @@ class PolicyEngine:
         if bucket > b:
             pad = np.zeros((bucket - b,) + obs.shape[1:], dtype=obs.dtype)
             obs = np.concatenate([obs, pad], axis=0)
+            if hidden is not None:
+                hidden = np.concatenate(
+                    [hidden,
+                     np.zeros((bucket - b,) + hidden.shape[1:], hidden.dtype)],
+                    axis=0,
+                )
         t0 = time.perf_counter()
         # Prefer the bucket's AOT executable from a profiled warmup (same
         # program; avoids a cold jit-cache compile next to it).
         act = self._compiled.get(bucket, self._act_jit)
-        out = act(self.params, obs)
+        operands = (obs,) if hidden is None else (obs, hidden)
+        out = act(self.params, *operands)
         # host-sync: the per-batch serving latency boundary — requests
         # need their answers NOW; serve latency IS this sync.
         jax.block_until_ready(out)
@@ -428,6 +540,10 @@ class PolicyEngine:
             self.telemetry.counter("serve.batches")
             self.telemetry.counter("serve.padded_rows", bucket - b)
             self.telemetry.histogram("serve.batch_ms", secs * 1e3)
+        if self.is_recurrent:
+            actions, new_hidden = out
+            # host-sync: result delivery
+            return np.asarray(actions[:b]), np.asarray(new_hidden[:b])
         return np.asarray(out[:b])  # host-sync: result delivery
 
     @property
@@ -441,8 +557,27 @@ class PolicyEngine:
     def _step_fn(self, params, sessions: Sessions, obs):
         import jax.numpy as jnp
 
+        if self.is_recurrent:
+            hp, hidden = self._act_raw(params, obs, sessions.hidden)
+            return Sessions(
+                hp_frac=hp, slots=sessions.slots + jnp.int32(1), hidden=hidden
+            ), hp
         hp = self._act_raw(params, obs)
-        return Sessions(hp_frac=hp, slots=sessions.slots + jnp.int32(1)), hp
+        return Sessions(
+            hp_frac=hp, slots=sessions.slots + jnp.int32(1),
+            hidden=sessions.hidden,
+        ), hp
+
+    def init_hidden(self, n: int):
+        """Deterministic fresh-session hidden carry [n, A, H] (zeros) —
+        what a session re-init after eviction resets to."""
+        import jax.numpy as jnp
+
+        if not self.is_recurrent:
+            raise ValueError(
+                f"{self._impl!r} bundle is feedforward — no hidden carry"
+            )
+        return jnp.zeros((n, self.n_agents, self.hidden_dim), jnp.float32)
 
     def init_sessions(self, n: int) -> Sessions:
         import jax
@@ -451,6 +586,7 @@ class PolicyEngine:
         sessions = Sessions(
             hp_frac=jnp.zeros((n, self.n_agents), jnp.float32),
             slots=jnp.zeros((n,), jnp.int32),
+            hidden=self.init_hidden(n) if self.is_recurrent else None,
         )
         if self.device is not None:
             # Sessions ride the donated step next to the committed params —
@@ -492,9 +628,17 @@ class PolicyEngine:
                 slots=jnp.concatenate(
                     [sessions.slots, jnp.zeros((pad,), jnp.int32)], axis=0
                 ),
+                hidden=(
+                    jnp.concatenate(
+                        [sessions.hidden, self.init_hidden(pad)], axis=0
+                    ) if sessions.hidden is not None else None
+                ),
             )
         new, hp = self._step_jit(self.params, sessions, obs)
-        new = Sessions(hp_frac=new.hp_frac[:n], slots=new.slots[:n])
+        new = Sessions(
+            hp_frac=new.hp_frac[:n], slots=new.slots[:n],
+            hidden=new.hidden[:n] if new.hidden is not None else None,
+        )
         return new, np.asarray(hp[:n])  # host-sync: result delivery
 
 
@@ -509,6 +653,17 @@ class MicroBatchQueue:
     """
 
     def __init__(self, engine: PolicyEngine, max_batch=None, max_wait_s=0.002):
+        if getattr(engine, "is_recurrent", False):
+            # A hidden-state policy served through the stateless full-batch
+            # queue would silently act from a zero carry every slot — a
+            # DIFFERENT policy. Refuse at construction, loudly, with the
+            # fix: the session-carrying continuous batcher.
+            raise ValueError(
+                "recurrent bundle cannot serve through MicroBatchQueue "
+                "(sessions are disabled on the stateless full-batch path) "
+                "— serve it through serve.continuous.ContinuousBatcher "
+                "with sessions enabled (gateway: batching='continuous')"
+            )
         self.engine = engine
         self.max_batch = min(max_batch or engine.max_batch, engine.max_batch)
         self.max_wait_s = max_wait_s
@@ -533,7 +688,11 @@ class MicroBatchQueue:
         with self._cv:
             return len(self._pending)
 
-    def submit(self, obs_row) -> Future:
+    def submit(self, obs_row, household=None) -> Future:
+        # ``household`` is accepted (and ignored) so the gateway submits
+        # through one interface: the continuous batcher uses it for slot
+        # affinity; the stateless microbatch path has no sessions to pin.
+        del household
         # host-sync: caller-supplied host observation row.
         obs_row = np.asarray(obs_row, dtype=np.float32)
         fut: Future = Future()

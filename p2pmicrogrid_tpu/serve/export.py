@@ -37,8 +37,20 @@ MANIFEST_FILE = "manifest.json"
 PARAMS_FILE = "params.npz"
 
 # The one parameter subtree each implementation's GREEDY path reads
-# (tabular_act -> q_table; dqn_act -> online; ddpg greedy -> actor).
-GREEDY_FIELD = {"tabular": "q_table", "dqn": "online", "ddpg": "actor"}
+# (tabular_act -> q_table; dqn_act -> online; ddpg / recurrent ddpg
+# greedy -> actor).
+GREEDY_FIELD = {
+    "tabular": "q_table",
+    "dqn": "online",
+    "ddpg": "actor",
+    "ddpg_recurrent": "actor",
+}
+
+# Implementations whose greedy decision READS cross-slot hidden state. Their
+# bundles carry a ``hidden_state`` manifest block (per-agent flat shape,
+# dtype, carry layout) and can only serve through session-carrying paths
+# (serve/continuous.py) — the stateless microbatch queue refuses them.
+RECURRENT_IMPLEMENTATIONS = ("ddpg_recurrent",)
 
 # On-disk dtypes for floating leaves. bfloat16 is deliberately absent: numpy
 # cannot persist it natively and a bit-punned encoding would make bundles
@@ -168,6 +180,17 @@ def _model_spec(cfg, implementation: str, flat_params: dict) -> dict:
         return {"qlearning": dataclasses.asdict(cfg.qlearning)}
     if implementation == "dqn":
         return {"hidden": cfg.dqn.hidden}
+    if implementation == "ddpg_recurrent":
+        # Arch read off the exported params themselves (the recurrent actor
+        # is not cfg-parameterized): the shared LSTM cell's gate bias width
+        # IS lstm_features, and the Dense widths pin the trunk/head.
+        lstm_features = int(flat_params["OptimizedLSTMCell_0/hf/bias"].shape[0])
+        return {
+            "actor": "recurrent_lstm",
+            "hidden_pre": int(flat_params["Dense_0/bias"].shape[0]),
+            "lstm_features": lstm_features,
+            "hidden_post": int(flat_params["Dense_2/bias"].shape[0]),
+        }
     # ddpg: a per-agent actor stacks a leading [A] axis on every Dense
     # kernel (ndim 3); the agent-shared actor is unbatched (ndim 2). Detect
     # from the exported params, not cfg — an eval-path restore may have
@@ -315,6 +338,26 @@ def _measure_quant_error(
     return bound
 
 
+def _hidden_state_spec(model: dict) -> dict:
+    """The manifest ``hidden_state`` block a recurrent bundle carries: the
+    per-agent flat carry shape/dtype the engine's session ring allocates,
+    and the layout documenting what lives where. Serving code sizes buffers
+    from THIS block, never from the architecture fields — a future
+    recurrent kind with a different carry only has to write a new block."""
+    from p2pmicrogrid_tpu.models.ddpg_recurrent import (
+        HIDDEN_LAYOUT,
+        actor_hidden_dim,
+    )
+
+    return {
+        "shape": [actor_hidden_dim(model["lstm_features"])],
+        "dtype": "float32",
+        "layout": list(HIDDEN_LAYOUT),
+        "init": "zeros",
+        "semantics": "per-agent flat LSTM carry (double shared-weight pass)",
+    }
+
+
 def _action_spec(implementation: str) -> dict:
     if implementation in ("tabular", "dqn"):
         return {
@@ -375,6 +418,13 @@ def export_policy_bundle(
     if dtype not in EXPORT_DTYPES:
         raise ValueError(f"dtype must be one of {EXPORT_DTYPES}, got {dtype!r}")
     impl = cfg.train.implementation
+    if dtype == "int8" and impl in RECURRENT_IMPLEMENTATIONS:
+        raise ValueError(
+            "int8 export is not defined for recurrent actors: the ulp "
+            "error-bound contract is measured on a stateless calibration "
+            "capture, and quantization error COMPOUNDS through the hidden "
+            "carry across a session — use float32 or float16"
+        )
     params = greedy_params(impl, pol_state)
     flat_src = _flatten_tree(params)
 
@@ -449,6 +499,11 @@ def export_policy_bundle(
     }
     if quant is not None:
         manifest["quant"] = quant
+    if impl in RECURRENT_IMPLEMENTATIONS:
+        # The serving contract for session-carrying policies: engines size
+        # their hidden ring from this block, and the stateless microbatch
+        # path refuses any bundle that carries one.
+        manifest["hidden_state"] = _hidden_state_spec(manifest["model"])
     if aot_buckets:
         manifest["aot"] = aot_compile_bundle(manifest, flat, aot_buckets)
     with open(os.path.join(out_dir, MANIFEST_FILE), "w") as f:

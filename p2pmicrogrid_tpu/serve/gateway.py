@@ -743,7 +743,12 @@ class ServeGateway:
         self._inflight += 1
         self._idle.clear()
         try:
-            futures = [bundle.queue.submit(row) for row in obs]
+            # The household id rides into the queue: the continuous
+            # batcher pins it to its session slot (hidden-state
+            # continuity); the microbatch queue ignores it.
+            futures = [
+                bundle.queue.submit(row, household=household) for row in obs
+            ]
             rows = await asyncio.wait_for(
                 asyncio.gather(*(asyncio.wrap_future(f) for f in futures)),
                 timeout=self.request_timeout_s,
@@ -1042,12 +1047,23 @@ def build_bundle(
     warmup: bool = True,
     run_name: str = "gateway",
     serve_role: str = "candidate",
+    batching: str = "micro",
+    max_slots: int = 256,
 ):
     """Load ONE bundle dir into ``(engine, queue, telemetry)`` — the unit
     ``build_registry`` loops over at startup and ``/admin/register`` runs
     at runtime (``make_bundle_factory``). The telemetry run is keyed by
     THIS bundle's config_hash so warehouse rows attribute to the config
-    that answered, exactly like startup-registered bundles."""
+    that answered, exactly like startup-registered bundles.
+
+    ``batching`` selects the queue front: ``"micro"`` (the full-batch
+    coalescing ``MicroBatchQueue`` every committed capture before
+    ``SERVE_CB_*`` was measured under) or ``"continuous"`` (slot-level
+    join/leave ``ContinuousBatcher`` with per-household session slots —
+    REQUIRED for recurrent bundles, whose hidden state lives engine-side;
+    ``max_slots`` bounds resident sessions per bundle). A recurrent bundle
+    under ``"micro"`` is refused loudly at construction."""
+    from p2pmicrogrid_tpu.serve.continuous import ContinuousBatcher
     from p2pmicrogrid_tpu.serve.engine import MicroBatchQueue, PolicyEngine
     from p2pmicrogrid_tpu.serve.export import load_policy_bundle
     from p2pmicrogrid_tpu.telemetry import (
@@ -1059,6 +1075,10 @@ def build_bundle(
 
     import uuid
 
+    if batching not in ("micro", "continuous"):
+        raise ValueError(
+            f"batching must be 'micro' or 'continuous', got {batching!r}"
+        )
     manifest, params = load_policy_bundle(bundle_dir)
     config_hash = manifest.get("config_hash")
     telemetry = Telemetry(
@@ -1073,6 +1093,9 @@ def build_bundle(
                 "setting": manifest.get("setting"),
                 "serve_bundle": bundle_dir,
                 "serve_role": serve_role,
+                # The warehouse's continuous-vs-microbatch attribution
+                # axis (telemetry-query --continuous).
+                "serve_batching": batching,
             }
         ),
     )
@@ -1081,9 +1104,14 @@ def build_bundle(
             manifest=manifest, params=params, max_batch=max_batch,
             telemetry=telemetry, device=device,
         )
-        if warmup:
-            engine.warmup(include_step=False)
-        queue = MicroBatchQueue(engine, max_wait_s=max_wait_s)
+        if batching == "continuous":
+            queue = ContinuousBatcher(engine, max_slots=max_slots)
+            if warmup:
+                queue.warmup()
+        else:
+            if warmup:
+                engine.warmup(include_step=False)
+            queue = MicroBatchQueue(engine, max_wait_s=max_wait_s)
     except BaseException:
         telemetry.close()
         raise
@@ -1097,6 +1125,8 @@ def make_bundle_factory(
     device: str = "auto",
     warmup: bool = True,
     run_name: str = "gateway",
+    batching: str = "micro",
+    max_slots: int = 256,
 ):
     """The ``/admin/register`` hook: a closure over this gateway's engine
     settings building one runtime-registered bundle per call."""
@@ -1110,6 +1140,8 @@ def make_bundle_factory(
             warmup=warmup,
             run_name=run_name,
             serve_role="candidate",
+            batching=batching,
+            max_slots=max_slots,
         )
 
     return factory
@@ -1123,6 +1155,8 @@ def build_registry(
     device: str = "auto",
     warmup: bool = True,
     run_name: str = "gateway",
+    batching: str = "micro",
+    max_slots: int = 256,
 ) -> BundleRegistry:
     """Load each bundle dir into an engine + queue + per-bundle telemetry
     registered in a fresh ``BundleRegistry`` (first bundle = default).
@@ -1155,6 +1189,8 @@ def build_registry(
                 warmup=warmup,
                 run_name=run_name,
                 serve_role="default" if i == 0 else "candidate",
+                batching=batching,
+                max_slots=max_slots,
             )
             registry.register(
                 engine, pending_queue, telemetry=pending_tel,
@@ -1191,6 +1227,8 @@ def build_gateway(
     tls=None,
     authenticator=None,
     restarts: int = 0,
+    batching: str = "micro",
+    max_slots: int = 256,
 ) -> ServeGateway:
     """``build_registry`` + a gateway owning the result (the one-process
     serving entry point; the fleet harness composes the pieces itself).
@@ -1205,6 +1243,8 @@ def build_gateway(
         device=device,
         warmup=warmup,
         run_name=run_name,
+        batching=batching,
+        max_slots=max_slots,
     )
     return ServeGateway(
         registry, admission=admission, host=host, port=port, own_bundles=True,
@@ -1218,6 +1258,8 @@ def build_gateway(
             device=device,
             warmup=warmup,
             run_name=run_name,
+            batching=batching,
+            max_slots=max_slots,
         ),
     )
 

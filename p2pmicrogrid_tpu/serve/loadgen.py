@@ -142,6 +142,89 @@ def poisson_arrivals(rate_hz: float, n: int, seed: int = 0) -> np.ndarray:
     return np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
 
 
+def bursty_arrivals(
+    rate_hz: float,
+    n: int,
+    burst_factor: float = 8.0,
+    burst_dwell_s: float = 0.25,
+    seed: int = 0,
+) -> np.ndarray:
+    """Markov-modulated on/off Poisson arrivals (MMPP-2): ``n`` arrival
+    times under a two-state process that alternates exponential dwells
+    (mean ``burst_dwell_s``) between an ON rate ``burst_factor`` times the
+    OFF rate, scaled so the MEAN offered rate stays ``rate_hz``:
+
+        rate_on  = rate_hz * 2 * f / (1 + f)
+        rate_off = rate_hz * 2     / (1 + f)        (f = burst_factor)
+
+    This is the arrival family that exposes the batch-boundary-wait
+    pathology the continuous batcher removes: every burst onset lands a
+    clump of requests behind whatever the microbatch queue has in flight
+    plus its coalescing window, so the p99 is set by waits, not compute.
+    ``burst_factor=1`` degenerates to plain Poisson. Deterministic given
+    the seed (one ``np.random.default_rng`` stream drives dwells and gaps
+    in a fixed order).
+    """
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
+    if burst_factor < 1.0:
+        raise ValueError(f"burst_factor must be >= 1, got {burst_factor}")
+    if burst_factor == 1.0:
+        return poisson_arrivals(rate_hz, n, seed=seed)
+    if burst_dwell_s <= 0:
+        raise ValueError(f"burst_dwell_s must be > 0, got {burst_dwell_s}")
+    rng = np.random.default_rng(seed)
+    rate_on = rate_hz * 2.0 * burst_factor / (1.0 + burst_factor)
+    rate_off = rate_hz * 2.0 / (1.0 + burst_factor)
+    arrivals: List[float] = []
+    t = 0.0
+    on = True  # start in a burst: the first dispatch already sees a clump
+    while len(arrivals) < n:
+        dwell = rng.exponential(burst_dwell_s)
+        rate = rate_on if on else rate_off
+        tt = t
+        while len(arrivals) < n:
+            tt += rng.exponential(1.0 / rate)
+            if tt >= t + dwell:
+                break
+            arrivals.append(tt)
+        t += dwell
+        on = not on
+    return np.asarray(arrivals[:n], dtype=float)
+
+
+def make_arrivals(
+    rate_hz: float,
+    n: int,
+    seed: int = 0,
+    burst_factor: float = 1.0,
+    burst_dwell_s: float = 0.25,
+):
+    """(arrivals, burst_config) — the one place the bench entry points
+    resolve their arrival mode, so every headline reports the SAME
+    ``burst_config`` block the schedule was actually generated under.
+    ``burst_factor != 1`` routes through ``bursty_arrivals``, so an
+    out-of-range value (< 1) fails ITS loud validation instead of being
+    silently benched as plain Poisson."""
+    if burst_factor != 1.0:
+        return (
+            bursty_arrivals(
+                rate_hz, n, burst_factor=burst_factor,
+                burst_dwell_s=burst_dwell_s, seed=seed,
+            ),
+            {
+                "mode": "bursty",
+                "burst_factor": burst_factor,
+                "burst_dwell_s": burst_dwell_s,
+                "seed": seed,
+            },
+        )
+    return (
+        poisson_arrivals(rate_hz, n, seed=seed),
+        {"mode": "poisson", "seed": seed},
+    )
+
+
 @dataclass
 class LoadgenResult:
     """Per-request latencies plus the batch schedule that produced them."""
@@ -301,6 +384,11 @@ class NetworkLoadgenResult:
     wire_connects: int = 0
     wire_reconnects: int = 0
     wire_replays: int = 0
+    # Per-request served actions (lists, None when shed/failed) — recorded
+    # only when the loadgen ran with record_actions=True (the
+    # continuous-vs-microbatch bit-exactness comparison needs the payloads,
+    # not just the latencies).
+    actions: Optional[List] = None
 
     def __post_init__(self):
         n = int(self.statuses.shape[0])
@@ -457,6 +545,7 @@ def run_network_loadgen(
     token_fn=None,
     mux_pool_size: int = 2,
     mux_max_frame_bytes: Optional[int] = None,
+    record_actions: bool = False,
 ) -> NetworkLoadgenResult:
     """Fire ``obs[i]`` at the gateway at ``arrivals[i]`` seconds (open loop:
     send times never wait on completions) and measure wire latencies.
@@ -496,6 +585,7 @@ def run_network_loadgen(
     retries = np.zeros(n, dtype=np.int64)
     gave_up = np.zeros(n, dtype=bool)
     hashes: List = [None] * n
+    actions_out: Optional[List] = [None] * n if record_actions else None
     pool_box: List = [None]  # MuxPool, created inside the event loop
 
     async def attempt(
@@ -589,6 +679,8 @@ def run_network_loadgen(
         latencies[i] = time.perf_counter() - t_send
         statuses[i] = status
         hashes[i] = (doc or {}).get("config_hash")
+        if actions_out is not None:
+            actions_out[i] = (doc or {}).get("actions")
 
     async def run() -> float:
         t0 = time.perf_counter()
@@ -612,6 +704,7 @@ def run_network_loadgen(
         wire_connects=pool.connects if pool is not None else 0,
         wire_reconnects=pool.reconnects if pool is not None else 0,
         wire_replays=pool.replays if pool is not None else 0,
+        actions=actions_out,
     )
 
 
@@ -631,6 +724,8 @@ def serve_bench_network(
     transport: str = "http",
     ssl=None,
     token_fn=None,
+    burst_factor: float = 1.0,
+    burst_dwell_s: float = 0.25,
 ) -> List[dict]:
     """Wire-level SLO benchmark: the serve-bench schedule over real sockets.
 
@@ -643,7 +738,10 @@ def serve_bench_network(
     ``token_fn`` select the wire (see ``run_network_loadgen``); with
     ``transport="mux"``, ``port`` is the gateway's MUX port.
     """
-    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    arrivals, burst_config = make_arrivals(
+        rate_hz, n_requests, seed=seed,
+        burst_factor=burst_factor, burst_dwell_s=burst_dwell_s,
+    )
     obs = synthetic_obs(n_requests, n_agents, seed=seed)
     households = [f"house-{i:04d}" for i in range(n_households)]
     result = run_network_loadgen(
@@ -706,6 +804,7 @@ def serve_bench_network(
             "n_households": n_households,
             "offered_rate_rps": rate_hz,
             "slo_ms": slo_ms,
+            "burst_config": burst_config,
             "served_config_hashes": served_hashes,
             **(extra_headline or {}),
         }
@@ -782,6 +881,8 @@ def serve_bench(
     slo_ms: float = 100.0,
     emit: Optional[Callable[[dict], None]] = None,
     service_time_fn: Optional[Callable[[int, int], float]] = None,
+    burst_factor: float = 1.0,
+    burst_dwell_s: float = 0.25,
 ) -> List[dict]:
     """Drive ``engine`` with an open-loop Poisson stream; report SLO metrics.
 
@@ -794,7 +895,10 @@ def serve_bench(
     from p2pmicrogrid_tpu.telemetry import current, phase_timings
 
     max_batch = min(max_batch or engine.max_batch, engine.max_batch)
-    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    arrivals, burst_config = make_arrivals(
+        rate_hz, n_requests, seed=seed,
+        burst_factor=burst_factor, burst_dwell_s=burst_dwell_s,
+    )
     obs = synthetic_obs(n_requests, engine.n_agents, seed=seed)
 
     tel = current()
@@ -880,6 +984,7 @@ def serve_bench(
             "max_wait_ms": round(max_wait_s * 1e3, 3),
             "slo_ms": slo_ms,
             "n_batches": len(result.batch_sizes),
+            "burst_config": burst_config,
             "implementation": engine.manifest.get("implementation"),
             "n_agents": engine.n_agents,
             "config_hash": engine.manifest.get("config_hash"),

@@ -71,6 +71,8 @@ class ProcessFleet:
         fault_plan_file: Optional[str] = None,
         results_db: Optional[str] = None,
         serve_device: str = "auto",
+        batching: str = "micro",
+        max_slots: int = 256,
         supervise: bool = True,
         backoff_s: float = 0.25,
         backoff_cap_s: float = 4.0,
@@ -96,6 +98,8 @@ class ProcessFleet:
         self.fault_plan_file = fault_plan_file
         self.results_db = results_db
         self.serve_device = serve_device
+        self.batching = batching
+        self.max_slots = max_slots
         self.supervise = supervise
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
@@ -126,6 +130,8 @@ class ProcessFleet:
             "--max-queue-depth", str(self.max_queue_depth),
             "--wait-budget-ms", str(self.wait_budget_ms),
             "--serve-device", self.serve_device,
+            "--batching", self.batching,
+            "--max-sessions", str(self.max_slots),
             "--replica-id", rid,
             "--restarts", str(restarts),
         ]

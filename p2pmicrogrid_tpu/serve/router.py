@@ -79,7 +79,7 @@ from p2pmicrogrid_tpu.serve.loadgen import (
     _http_post_json,
     _http_request_json,
     _retry_after_s,
-    poisson_arrivals,
+    make_arrivals,
     synthetic_obs,
 )
 from p2pmicrogrid_tpu.serve.wire import (
@@ -1285,6 +1285,8 @@ class LocalFleet:
         mux: bool = False,
         tls=None,
         authenticator=None,
+        batching: str = "micro",
+        max_slots: int = 256,
     ):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -1306,6 +1308,11 @@ class LocalFleet:
         self.mux = mux
         self.tls = tls
         self.authenticator = authenticator
+        # Queue front per replica bundle: "continuous" (slot-level
+        # join/leave sessions — required for recurrent bundles) or the
+        # classic "micro" coalescing queue.
+        self.batching = batching
+        self.max_slots = max_slots
         self._lock = threading.Lock()
         self._entries: Dict[str, dict] = {}
         self.kills: List[str] = []
@@ -1336,6 +1343,8 @@ class LocalFleet:
                     device=self.device,
                     warmup=self.warmup,
                     run_name=f"{self.run_name}-{rid}",
+                    batching=self.batching,
+                    max_slots=self.max_slots,
                 )
                 factory = make_bundle_factory(
                     max_batch=self.max_batch,
@@ -1344,6 +1353,8 @@ class LocalFleet:
                     device=self.device,
                     warmup=self.warmup,
                     run_name=f"{self.run_name}-{rid}",
+                    batching=self.batching,
+                    max_slots=self.max_slots,
                 )
                 gateway = ServeGateway(
                     registry, admission=self.admission, host=self.host,
@@ -1631,6 +1642,8 @@ def serve_bench_fleet(
     chaos_join_grace_s: float = 10.0,
     recover_wait_s: float = 0.0,
     gateway_baseline: Optional[dict] = None,
+    burst_factor: float = 1.0,
+    burst_dwell_s: float = 0.25,
 ) -> List[dict]:
     """Fleet-level SLO benchmark: the serve-bench open-loop schedule
     through the router over a live fleet, optionally with a fault plan
@@ -1656,7 +1669,10 @@ def serve_bench_fleet(
     and request attribution — the baseline is subtracted from the totals
     this run reports.
     """
-    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    arrivals, burst_config = make_arrivals(
+        rate_hz, n_requests, seed=seed,
+        burst_factor=burst_factor, burst_dwell_s=burst_dwell_s,
+    )
     obs = synthetic_obs(n_requests, n_agents, seed=seed)
     households = [f"house-{i:04d}" for i in range(n_households)]
     schedule = None
@@ -1845,6 +1861,7 @@ def serve_bench_fleet(
             "n_households": n_households,
             "offered_rate_rps": rate_hz,
             "slo_ms": slo_ms,
+            "burst_config": burst_config,
             **(extra_headline or {}),
         }
     )

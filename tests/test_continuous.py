@@ -1,0 +1,880 @@
+"""Continuous batching + recurrent sessions (ISSUE 14): slot lifecycle
+(join/leave determinism, generation-counter staleness protection, eviction
+re-init), stateless continuous == microbatch bit-exactness end-to-end
+through the gateway across two padding buckets, recurrent hidden-state
+continuity across a household's request sequence, mid-flight hot-swap with
+zero drops, the recurrent train -> export -> serve -> fleet chain, bursty
+arrivals, the serve_continuous capture contract and the warehouse view.
+Fast and JAX_PLATFORMS=cpu-safe by design (tier-1)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import (
+    DDPGConfig,
+    SimConfig,
+    TrainConfig,
+    default_config,
+)
+from p2pmicrogrid_tpu.models.ddpg_recurrent import (
+    RecurrentActor,
+    recurrent_ddpg_init,
+)
+from p2pmicrogrid_tpu.serve import (
+    ContinuousBatcher,
+    MicroBatchQueue,
+    PolicyEngine,
+    bursty_arrivals,
+    export_policy_bundle,
+    load_policy_bundle,
+    serve_bench,
+    serve_bench_continuous_compare,
+)
+from p2pmicrogrid_tpu.train import init_policy_state
+
+A = 3  # community size for the stateless tests
+AR = 2  # community size for the (heavier) recurrent tests
+
+
+def _cfg(impl, n_agents=A):
+    return default_config(
+        sim=SimConfig(n_agents=n_agents),
+        train=TrainConfig(implementation=impl),
+        ddpg=DDPGConfig(buffer_size=16, batch_size=2),
+    )
+
+
+def _obs(n, n_agents=A, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = np.empty((n, n_agents, 4), dtype=np.float32)
+    obs[..., 0] = rng.uniform(0, 1, (n, n_agents))
+    obs[..., 1:] = rng.uniform(-1, 1, (n, n_agents, 3))
+    return obs
+
+
+def _tabular_bundle(tmp_path, name="b", seed=0):
+    cfg = _cfg("tabular")
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
+    ps = ps._replace(
+        q_table=jax.random.normal(
+            jax.random.PRNGKey(seed + 1), ps.q_table.shape
+        )
+    )
+    return export_policy_bundle(cfg, ps, str(tmp_path / name)), cfg, ps
+
+
+@pytest.fixture(scope="module")
+def recurrent_bundle(tmp_path_factory):
+    """One recurrent bundle + engine shared by the recurrent tests (the
+    LSTM bucket compiles are the expensive part)."""
+    cfg = _cfg("ddpg_recurrent", n_agents=AR)
+    st = recurrent_ddpg_init(cfg.ddpg, jax.random.PRNGKey(0), seq_len=8)
+    bundle = export_policy_bundle(
+        cfg, st, str(tmp_path_factory.mktemp("rb") / "b")
+    )
+    engine = PolicyEngine(bundle_dir=bundle, max_batch=4, device="default")
+    return bundle, engine
+
+
+class TestRecurrentBundle:
+    def test_manifest_records_hidden_state(self, recurrent_bundle):
+        bundle, engine = recurrent_bundle
+        manifest, _params = load_policy_bundle(bundle)
+        hs = manifest["hidden_state"]
+        assert hs["shape"] == [400]  # 4 carries x 100 lstm features
+        assert hs["dtype"] == "float32"
+        assert hs["init"] == "zeros"
+        assert engine.is_recurrent and engine.hidden_dim == 400
+
+    def test_act_threads_hidden_and_matches_full_sequence(
+        self, recurrent_bundle
+    ):
+        _bundle, engine = recurrent_bundle
+        _m, params = load_policy_bundle(_bundle)
+        T = 3
+        seq = _obs(T, n_agents=AR, seed=3)
+        h = np.zeros((1, AR, 400), np.float32)
+        acts = []
+        for t in range(T):
+            a, h = engine.act(seq[t][None], h)
+            acts.append(a[0])
+        # Reference: the full-sequence RecurrentActor over each agent's day.
+        xs = np.transpose(seq, (1, 0, 2))  # [A, T, 4]
+        ref = np.asarray(
+            RecurrentActor().apply({"params": params}, xs)[..., 0]
+        ).T  # [T, A]
+        np.testing.assert_allclose(np.stack(acts), ref, atol=1e-6)
+
+    def test_act_without_hidden_refused(self, recurrent_bundle):
+        _bundle, engine = recurrent_bundle
+        with pytest.raises(ValueError, match="hidden carry"):
+            engine.act(_obs(1, n_agents=AR))
+
+    def test_feedforward_refuses_hidden(self, tmp_path):
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+        with pytest.raises(ValueError, match="feedforward"):
+            engine.act(_obs(1), hidden=np.zeros((1, A, 4), np.float32))
+
+    def test_microbatch_queue_refuses_recurrent(self, recurrent_bundle):
+        _bundle, engine = recurrent_bundle
+        with pytest.raises(ValueError, match="ContinuousBatcher"):
+            MicroBatchQueue(engine)
+
+    def test_sessions_off_refused_for_recurrent(self, recurrent_bundle):
+        _bundle, engine = recurrent_bundle
+        with pytest.raises(ValueError, match="sessions"):
+            ContinuousBatcher(engine, sessions=False)
+
+    def test_int8_export_refused(self):
+        cfg = _cfg("ddpg_recurrent", n_agents=AR)
+        st = recurrent_ddpg_init(cfg.ddpg, jax.random.PRNGKey(0), seq_len=8)
+        with pytest.raises(ValueError, match="int8"):
+            export_policy_bundle(cfg, st, "/tmp/never-written", dtype="int8")
+
+    def test_sessions_carry_hidden_through_donated_step(
+        self, recurrent_bundle
+    ):
+        _bundle, engine = recurrent_bundle
+        sessions = engine.init_sessions(2)
+        assert sessions.hidden.shape == (2, AR, 400)
+        obs = _obs(2, n_agents=AR, seed=5)
+        sessions, a1 = engine.step(sessions, obs)
+        sessions, a2 = engine.step(sessions, obs)
+        # Same obs, evolved carry: a recurrent policy must answer
+        # differently — and the session hidden must be live.
+        assert not np.array_equal(a1, a2)
+        assert float(np.abs(np.asarray(sessions.hidden)).max()) > 0
+
+
+class TestSlotLifecycle:
+    def test_stateless_continuous_bit_exact_vs_direct(self, tmp_path):
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        engine.warmup(include_step=False)
+        obs = _obs(12, seed=7)
+        want = engine.act(obs)
+        with ContinuousBatcher(engine, max_slots=8) as cb:
+            futs = [
+                cb.submit(obs[i], household=f"h{i % 5}") for i in range(12)
+            ]
+            got = np.stack([f.result(timeout=30) for f in futs])
+        np.testing.assert_array_equal(got, want)
+
+    def test_two_bucket_coverage_bit_exact_manual_stepping(self, tmp_path):
+        """Deterministic two-bucket proof (autostart=False removes worker
+        timing): a 3-row step pads to bucket 4, a 1-row step hits bucket 1
+        — two distinct compiled programs, both bit-exact vs direct act."""
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        engine.warmup(include_step=False)
+        obs = _obs(4, seed=43)
+        want = engine.act(obs)
+        base = dict(engine.stats)
+        with ContinuousBatcher(
+            engine, max_slots=8, autostart=False
+        ) as cb:
+            futs3 = [cb.submit(obs[i], household=f"h{i}") for i in range(3)]
+            assert cb.step_once() == 3      # one step, bucket 4 (1 pad row)
+            fut1 = cb.submit(obs[3], household="h3")
+            assert cb.step_once() == 1      # one step, bucket 1 (no pad)
+            for i, f in enumerate(futs3):
+                np.testing.assert_array_equal(f.result(1), want[i])
+            np.testing.assert_array_equal(fut1.result(1), want[3])
+        assert engine.stats["batches"] - base["batches"] == 2
+        assert engine.stats["padded_rows"] - base["padded_rows"] == 1
+        assert engine.stats["rows"] - base["rows"] == 4
+
+    def test_join_leave_determinism_under_interleaved_arrivals(
+        self, tmp_path
+    ):
+        """The same interleaved arrival order twice -> identical answers,
+        identical slot assignments, identical generations."""
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        obs = _obs(16, seed=11)
+        hh = [f"h{i % 3}" for i in range(16)]
+
+        def run():
+            engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+            engine.warmup(include_step=False)
+            with ContinuousBatcher(engine, max_slots=2) as cb:
+                futs = [
+                    cb.submit(obs[i], household=hh[i]) for i in range(16)
+                ]
+                got = np.stack([f.result(timeout=30) for f in futs])
+                info = {
+                    h: cb.session_info(h)
+                    for h in set(hh)
+                    if cb.session_info(h) is not None
+                }
+                stats = dict(cb.stats)
+            return got, {
+                h: (i["slot"], i["gen"], i["served"]) for h, i in info.items()
+            }, stats
+
+        got1, info1, stats1 = run()
+        got2, info2, stats2 = run()
+        np.testing.assert_array_equal(got1, got2)
+        assert info1 == info2
+        assert stats1["evictions"] == stats2["evictions"]
+        assert stats1["joins"] == stats2["joins"]
+
+    def test_generation_counter_protects_retired_row(self, recurrent_bundle):
+        """A household whose slot was retired and reassigned must come back
+        under a FRESH generation with a deterministic zero-carry re-init —
+        never the new owner's (or its own stale) hidden state."""
+        _bundle, engine = recurrent_bundle
+        obs = _obs(4, n_agents=AR, seed=13)
+        with ContinuousBatcher(engine, max_slots=1) as cb:
+            a_first = cb.submit(obs[0], household="alice").result(30)
+            info0 = cb.session_info("alice")
+            assert cb.end_session("alice")
+            # bob takes the (only) slot under a bumped generation.
+            cb.submit(obs[1], household="bob").result(30)
+            info_bob = cb.session_info("bob")
+            assert info_bob["slot"] == info0["slot"]
+            assert info_bob["gen"] > info0["gen"]
+            # alice returns: bob has nothing queued, so the LRU evicts him
+            # and alice re-inits deterministically — her answer equals a
+            # fresh-carry answer, NOT a continuation of anyone's state.
+            a_again = cb.submit(obs[0], household="alice").result(30)
+            info1 = cb.session_info("alice")
+        np.testing.assert_array_equal(a_again, a_first)
+        assert info1["gen"] > info0["gen"]
+        assert info1["served"] == 1
+
+    def test_eviction_reinit_bit_exact(self, recurrent_bundle):
+        """Two households thrash one slot: every request re-inits, and each
+        answer is bit-exact with the fresh-carry engine reference."""
+        _bundle, engine = recurrent_bundle
+        obs = _obs(2, n_agents=AR, seed=17)
+        want = [
+            engine.act(obs[i][None], engine.init_hidden(1))[0][0]
+            for i in range(2)
+        ]
+        with ContinuousBatcher(engine, max_slots=1) as cb:
+            for round_ in range(2):
+                for i, h in enumerate(("a", "b")):
+                    got = cb.submit(obs[i], household=h).result(30)
+                    np.testing.assert_array_equal(got, want[i])
+            assert cb.stats["evictions"] >= 3
+
+    def test_recurrent_continuity_across_request_sequence(
+        self, recurrent_bundle
+    ):
+        """A household's interleaved request stream sees ONE continuous
+        hidden trajectory — equal to a stateful engine replay — while other
+        households' traffic shares the same steps."""
+        _bundle, engine = recurrent_bundle
+        T = 4
+        seq = _obs(T, n_agents=AR, seed=19)
+        noise = _obs(T, n_agents=AR, seed=23)
+        with ContinuousBatcher(engine, max_slots=4) as cb:
+            got = []
+            for t in range(T):
+                f_main = cb.submit(seq[t], household="main")
+                f_other = cb.submit(noise[t], household=f"other-{t % 2}")
+                got.append(f_main.result(30))
+                f_other.result(30)
+            info = cb.session_info("main")
+        h = np.asarray(engine.init_hidden(1))
+        want = []
+        for t in range(T):
+            a, h = engine.act(seq[t][None], h)
+            want.append(a[0])
+        np.testing.assert_allclose(np.stack(got), np.stack(want), atol=1e-6)
+        assert info["served"] == T and info["gen"] == 0
+
+    def test_same_household_requests_serialize_in_order(
+        self, recurrent_bundle
+    ):
+        """Back-to-back requests of ONE household submitted before any step
+        runs still step in submission order through consecutive steps."""
+        _bundle, engine = recurrent_bundle
+        T = 3
+        seq = _obs(T, n_agents=AR, seed=29)
+        with ContinuousBatcher(engine, max_slots=2) as cb:
+            futs = [cb.submit(seq[t], household="hh") for t in range(T)]
+            got = [f.result(30) for f in futs]
+            assert cb.session_info("hh")["served"] == T
+        h = np.asarray(engine.init_hidden(1))
+        for t in range(T):
+            a, h = engine.act(seq[t][None], h)
+            np.testing.assert_allclose(got[t], a[0], atol=1e-6)
+
+    def test_recurrent_slot_exhaustion_defers_never_scratches(
+        self, recurrent_bundle
+    ):
+        """Under slot exhaustion a recurrent HOUSEHOLD request is deferred
+        (FIFO kept, joins when a resident goes idle) — never silently
+        answered from the scratch row's zero carry (manual stepping makes
+        the contention deterministic)."""
+        _bundle, engine = recurrent_bundle
+        obs = _obs(2, n_agents=AR, seed=61)
+        with ContinuousBatcher(
+            engine, max_slots=1, autostart=False
+        ) as cb:
+            fa = cb.submit(obs[0], household="a")
+            fb = cb.submit(obs[1], household="b")
+            # Step 1: a takes the only slot; b (recurrent, slotless, a is
+            # still pending at compose time) is DEFERRED, not scratched.
+            assert cb.step_once() == 1
+            assert cb.stats["slot_deferrals"] == 1
+            assert cb.stats["scratch_rows"] == 0
+            a1 = fa.result(1)
+            assert not fb.done()
+            # Step 2: a is idle now — evicted; b joins under a fresh slot.
+            assert cb.step_once() == 1
+            b1 = fb.result(1)
+            assert cb.stats["evictions"] == 1
+            assert cb.session_info("b")["served"] == 1
+        # Both answers equal the fresh-carry reference (each household's
+        # FIRST slot), proving neither was polluted by the other's state.
+        want = engine.act(obs, np.asarray(engine.init_hidden(2)))[0]
+        np.testing.assert_array_equal(a1, want[0])
+        np.testing.assert_array_equal(b1, want[1])
+
+    def test_slot_wait_timeout_fails_loudly_naming_the_fix(
+        self, recurrent_bundle
+    ):
+        """A recurrent household that cannot get a slot does not starve
+        invisibly: past slot_wait_timeout_s its request fails with an
+        error naming --max-sessions."""
+        _bundle, engine = recurrent_bundle
+        obs = _obs(2, n_agents=AR, seed=67)
+        with ContinuousBatcher(
+            engine, max_slots=1, autostart=False, slot_wait_timeout_s=0.0
+        ) as cb:
+            fa = cb.submit(obs[0], household="a")
+            fb = cb.submit(obs[1], household="b")
+            # a takes the slot and stays "pending-busy" this compose;
+            # b's wait (timeout 0) is already expired -> loud failure.
+            assert cb.step_once() == 1
+            fa.result(1)
+            with pytest.raises(RuntimeError, match="max-sessions"):
+                fb.result(1)
+            assert cb.stats["slot_wait_expired"] == 1
+            assert cb.depth == 0  # the expired request left the queue
+
+    def test_cancelled_requests_are_pruned_not_stepped(
+        self, recurrent_bundle
+    ):
+        """A cancelled request is dropped at compose time — it neither
+        occupies the queue nor advances its household's hidden carry."""
+        _bundle, engine = recurrent_bundle
+        obs = _obs(2, n_agents=AR, seed=71)
+        with ContinuousBatcher(
+            engine, max_slots=2, autostart=False
+        ) as cb:
+            f1 = cb.submit(obs[0], household="h")
+            f2 = cb.submit(obs[1], household="h")
+            assert f2.cancel()
+            assert cb.step_once() == 1  # only the live request steps
+            f1.result(1)
+            assert cb.stats["cancelled_drops"] == 1
+            assert cb.depth == 0
+            assert cb.session_info("h")["served"] == 1  # carry advanced once
+
+    def test_stateless_household_burst_rides_one_step(self, tmp_path):
+        """Stateless engines do NOT serialize a household's rows: a burst
+        of K same-household requests composes into ONE step (actions
+        depend only on the obs — K step latencies would buy nothing)."""
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        engine.warmup(include_step=False)
+        obs = _obs(3, seed=73)
+        want = engine.act(obs)
+        with ContinuousBatcher(
+            engine, max_slots=4, autostart=False
+        ) as cb:
+            futs = [cb.submit(obs[i], household="same") for i in range(3)]
+            assert cb.step_once() == 3  # one step, not three
+            for i, f in enumerate(futs):
+                np.testing.assert_array_equal(f.result(1), want[i])
+            assert cb.session_info("same")["served"] == 3
+
+    def test_anonymous_requests_serve_fresh_carry(self, recurrent_bundle):
+        _bundle, engine = recurrent_bundle
+        obs = _obs(1, n_agents=AR, seed=31)
+        want = engine.act(obs, engine.init_hidden(1))[0]
+        with ContinuousBatcher(engine, max_slots=2) as cb:
+            a1 = cb.submit(obs[0]).result(30)
+            a2 = cb.submit(obs[0]).result(30)
+            assert cb.stats["scratch_rows"] == 2
+        np.testing.assert_array_equal(a1, want[0])
+        np.testing.assert_array_equal(a2, want[0])  # no carry, no drift
+
+    def test_occupancy_and_slot_wait_histograms(self, tmp_path):
+        from p2pmicrogrid_tpu.telemetry import Telemetry
+
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        tel = Telemetry(run_id="t")
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4, telemetry=tel)
+        engine.warmup(include_step=False)
+        obs = _obs(6, seed=37)
+        with ContinuousBatcher(engine, max_slots=4) as cb:
+            futs = [cb.submit(obs[i], household=f"h{i}") for i in range(6)]
+            for f in futs:
+                f.result(30)
+        s = tel.summary()
+        assert s["histograms"]["serve.batch_occupancy"]["count"] >= 1
+        assert s["histograms"]["serve.batch_occupancy"]["max"] <= 1.0
+        assert s["histograms"]["serve.slot_wait_ms"]["count"] == 6
+        assert s["counters"]["serve.steps"] >= 1
+
+
+class TestGatewayContinuous:
+    def _gateway(self, bundle, batching, max_batch=8):
+        from p2pmicrogrid_tpu.serve import (
+            AdmissionConfig,
+            GatewayServer,
+            build_gateway,
+        )
+
+        gateway = build_gateway(
+            [bundle],
+            max_batch=max_batch,
+            admission=AdmissionConfig(max_queue_depth=4096),
+            batching=batching,
+        )
+        server = GatewayServer(gateway)
+        return gateway, server
+
+    def test_stateless_gateway_bit_exact_vs_microbatch_two_buckets(
+        self, tmp_path
+    ):
+        """Acceptance: the SAME requests through a microbatch gateway and a
+        continuous gateway answer bit-identically, across two padding
+        buckets, end-to-end over the wire."""
+        import urllib.request
+
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        # Mixed request sizes, sent sequentially (blocking): a 3-row
+        # request executes as one 3-row step/batch (pads to bucket 4), a
+        # 1-row request as bucket 1 — BOTH arms provably serve through two
+        # distinct compiled bucket programs.
+        sizes = [3, 1, 3, 1]
+        obs = _obs(sum(sizes), seed=41)
+        answers = {}
+        for batching in ("micro", "continuous"):
+            gateway, server = self._gateway(bundle, batching)
+            try:
+                host, port = server.start()
+                got = []
+                start = 0
+                for i, size in enumerate(sizes):
+                    rows = obs[start : start + size]
+                    start += size
+                    body = json.dumps({
+                        "household": f"h{i}",
+                        "obs": (
+                            rows.tolist() if size > 1 else rows[0].tolist()
+                        ),
+                    }).encode()
+                    req = urllib.request.Request(
+                        f"http://{host}:{port}/v1/act", data=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        doc = json.loads(resp.read())
+                    if size > 1:
+                        got.extend(doc["actions"])
+                    else:
+                        got.append(doc["actions"])
+                default = gateway.registry.get(gateway.registry.default_hash)
+                stats = dict(default.engine.stats)
+                answers[batching] = np.asarray(got, np.float32)
+            finally:
+                server.stop()
+            assert stats["rows"] == sum(sizes)
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=8)
+        want = engine.act(obs)
+        np.testing.assert_array_equal(answers["micro"], want)
+        np.testing.assert_array_equal(answers["continuous"], want)
+
+    def test_hot_swap_mid_flight_zero_drops(self, tmp_path):
+        """A default hot-swap while continuous traffic is in flight drops
+        nothing: every request answers 200 from one of the two bundles."""
+        import concurrent.futures
+        import urllib.request
+
+        bundle_a, _c, _p = _tabular_bundle(tmp_path, name="a", seed=0)
+        cfg_b = default_config(
+            sim=SimConfig(n_agents=A),
+            train=TrainConfig(implementation="tabular", seed=43),
+        )
+        ps_b = init_policy_state(cfg_b, jax.random.PRNGKey(9))
+        ps_b = ps_b._replace(
+            q_table=jax.random.normal(
+                jax.random.PRNGKey(10), ps_b.q_table.shape
+            )
+        )
+        bundle_b = export_policy_bundle(cfg_b, ps_b, str(tmp_path / "bb"))
+        from p2pmicrogrid_tpu.serve import (
+            AdmissionConfig,
+            GatewayServer,
+            build_gateway,
+        )
+
+        gateway = build_gateway(
+            [bundle_a, bundle_b],
+            max_batch=8,
+            admission=AdmissionConfig(max_queue_depth=4096),
+            batching="continuous",
+        )
+        server = GatewayServer(gateway)
+        obs = _obs(40, seed=47)
+        hashes = set(gateway.registry.hashes)
+
+        def one(i):
+            body = json.dumps({
+                "household": f"h{i % 6}", "obs": obs[i].tolist(),
+            }).encode()
+            req = urllib.request.Request(
+                f"http://{gateway.host}:{gateway.port}/v1/act", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        try:
+            server.start()
+            other = [
+                h for h in gateway.registry.hashes
+                if h != gateway.registry.default_hash
+            ][0]
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                futs = [pool.submit(one, i) for i in range(20)]
+                swap_body = json.dumps({"config_hash": other}).encode()
+                swap_req = urllib.request.Request(
+                    f"http://{gateway.host}:{gateway.port}/admin/swap",
+                    data=swap_body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(swap_req, timeout=30) as resp:
+                    assert resp.status == 200
+                futs += [pool.submit(one, i) for i in range(20, 40)]
+                docs = [f.result(timeout=60) for f in futs]
+        finally:
+            server.stop()
+        assert len(docs) == 40  # zero drops: every request answered
+        served = {d["config_hash"] for d in docs}
+        assert served <= hashes
+        # Traffic actually moved to the swapped-in default.
+        assert other in {d["config_hash"] for d in docs[20:]}
+
+
+class TestRecurrentFleet:
+    def test_recurrent_bundle_serves_through_fleet(self, recurrent_bundle):
+        """Acceptance: a recurrent bundle serves through the fleet tier
+        (router + replicas + household affinity) in a serve-bench --fleet
+        style run with availability 1.0; the bit-exact comparator is
+        omitted (stateless replay is not a valid reference for a stateful
+        policy — continuity is asserted in TestSlotLifecycle)."""
+        bundle, _engine = recurrent_bundle
+        from p2pmicrogrid_tpu.serve import (
+            AdmissionConfig,
+            FleetRouter,
+            LocalFleet,
+            serve_bench_fleet,
+        )
+
+        fleet = LocalFleet(
+            [bundle],
+            n_replicas=2,
+            max_batch=4,
+            admission=AdmissionConfig(max_queue_depth=4096),
+            batching="continuous",
+            max_slots=16,
+        )
+        fleet.start()
+        rows = []
+        try:
+            router = FleetRouter(fleet.replicas)
+            serve_bench_fleet(
+                router,
+                n_agents=AR,
+                reference_engine=None,
+                rate_hz=400.0,
+                n_requests=48,
+                n_households=6,
+                seed=0,
+                burst_factor=4.0,
+                burst_dwell_s=0.05,
+                probe_interval_s=0.05,
+                emit=rows.append,
+            )
+        finally:
+            fleet.stop_all()
+        head = rows[-1]
+        assert head["metric"] == "serve_bench_fleet"
+        assert head["availability"] == 1.0
+        assert head["bit_exact"] is None
+        assert head["n_requests"] == 48
+        # The bursty knobs reach the fleet schedule and its headline too.
+        assert head["burst_config"]["mode"] == "bursty"
+        assert head["burst_config"]["burst_factor"] == 4.0
+
+
+class TestRecurrentTrainChain:
+    def test_train_export_serve_deterministic(self, tmp_path):
+        """The full recurrent chain: train (day-granular, real physics) ->
+        checkpoint -> export-bundle -> engine — deterministic under the
+        seed, and the served greedy action matches the trained actor."""
+        from p2pmicrogrid_tpu.train.recurrent import (
+            recurrent_checkpoint_dir,
+            save_recurrent_checkpoint,
+            train_recurrent_community,
+        )
+
+        cfg = _cfg("ddpg_recurrent", n_agents=AR)
+        res1 = train_recurrent_community(
+            cfg, episodes=2, key=jax.random.PRNGKey(3)
+        )
+        res2 = train_recurrent_community(
+            cfg, episodes=2, key=jax.random.PRNGKey(3)
+        )
+        np.testing.assert_array_equal(res1.day_rewards, res2.day_rewards)
+        jax.tree_util.tree_map(
+            np.testing.assert_array_equal, res1.state.actor, res2.state.actor
+        )
+
+        model_dir = str(tmp_path / "models")
+        save_recurrent_checkpoint(model_dir, cfg, res1.state, episode=2)
+        from p2pmicrogrid_tpu.serve import export_bundle_from_checkpoint
+
+        bundle = export_bundle_from_checkpoint(
+            cfg,
+            recurrent_checkpoint_dir(model_dir, cfg.setting),
+            str(tmp_path / "bundle"),
+        )
+        manifest, params = load_policy_bundle(bundle)
+        assert manifest["implementation"] == "ddpg_recurrent"
+        assert manifest["hidden_state"]["shape"] == [400]
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=2,
+                              device="default")
+        obs = _obs(1, n_agents=AR, seed=53)
+        a, h = engine.act(obs, engine.init_hidden(1))
+        ref = np.asarray(
+            RecurrentActor().apply(
+                {"params": params}, obs[0][:, None, :]
+            )[..., 0, 0]
+        )
+        np.testing.assert_allclose(a[0], ref, atol=1e-6)
+        assert float(np.abs(h).max()) > 0
+
+
+class TestBurstyLoadgen:
+    def test_bursty_arrivals_deterministic(self):
+        a = bursty_arrivals(200.0, 100, burst_factor=8.0, seed=5)
+        b = bursty_arrivals(200.0, 100, burst_factor=8.0, seed=5)
+        np.testing.assert_array_equal(a, b)
+        assert (np.diff(a) > 0).all()
+        c = bursty_arrivals(200.0, 100, burst_factor=8.0, seed=6)
+        assert not np.array_equal(a, c)
+
+    def test_burst_factor_one_is_poisson(self):
+        from p2pmicrogrid_tpu.serve import poisson_arrivals
+
+        np.testing.assert_array_equal(
+            bursty_arrivals(100.0, 50, burst_factor=1.0, seed=7),
+            poisson_arrivals(100.0, 50, seed=7),
+        )
+
+    def test_out_of_range_burst_factor_refused_loudly(self):
+        from p2pmicrogrid_tpu.serve import make_arrivals
+
+        # Routed through bursty_arrivals' validation — never silently
+        # benched as plain Poisson.
+        with pytest.raises(ValueError, match="burst_factor"):
+            make_arrivals(100.0, 10, burst_factor=0.5)
+
+    def test_bursty_mean_rate_preserved(self):
+        a = bursty_arrivals(
+            500.0, 4000, burst_factor=8.0, burst_dwell_s=0.1, seed=0
+        )
+        rate = len(a) / a[-1]
+        assert 350.0 < rate < 700.0  # mean-preserving construction
+
+    def test_bursty_is_burstier_than_poisson(self):
+        from p2pmicrogrid_tpu.serve import poisson_arrivals
+
+        b = bursty_arrivals(
+            500.0, 4000, burst_factor=10.0, burst_dwell_s=0.2, seed=1
+        )
+        p = poisson_arrivals(500.0, 4000, seed=1)
+        # Dispersion of per-window counts: MMPP must exceed Poisson.
+        def dispersion(arr):
+            counts = np.histogram(
+                arr, bins=np.arange(0.0, arr[-1], 0.1)
+            )[0]
+            return counts.var() / counts.mean()
+
+        assert dispersion(b) > 2.0 * dispersion(p)
+
+    def test_serve_bench_headline_reports_burst_config(self, tmp_path):
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        engine = PolicyEngine(bundle_dir=bundle, max_batch=4)
+        rows = serve_bench(
+            engine, rate_hz=5000.0, n_requests=64, max_batch=4,
+            max_wait_s=0.001, seed=3, burst_factor=6.0, burst_dwell_s=0.05,
+        )
+        bc = rows[-1]["burst_config"]
+        assert bc["mode"] == "bursty"
+        assert bc["burst_factor"] == 6.0
+        rows_plain = serve_bench(
+            engine, rate_hz=5000.0, n_requests=64, max_batch=4,
+            max_wait_s=0.001, seed=3,
+        )
+        assert rows_plain[-1]["burst_config"]["mode"] == "poisson"
+
+
+class TestContinuousCompare:
+    def test_compare_rows_and_schema(self, tmp_path):
+        """The serve_continuous capture contract: headline last, both
+        arms' percentiles, occupancy/slot-wait stats, bit-exact verdict,
+        burst_config — and the schema checker accepts the written file."""
+        import importlib.util
+        import os
+
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        rows = serve_bench_continuous_compare(
+            bundle, rate_hz=600.0, n_requests=96, n_households=8,
+            seed=0, burst_factor=6.0, burst_dwell_s=0.1,
+            max_batch=8, max_wait_s=0.003,
+        )
+        head = rows[-1]
+        assert head["metric"] == "serve_continuous"
+        assert head["bit_exact_stateless"] is True
+        assert head["n_compared"] > 0
+        for key in ("p50_ms", "p95_ms", "p99_ms", "micro_p99_ms",
+                    "vs_microbatch", "occupancy_mean", "occupancy_p95",
+                    "slot_wait_p50_ms", "slot_wait_p95_ms"):
+            assert isinstance(head[key], (int, float))
+        assert head["burst_config"]["mode"] == "bursty"
+        assert head["transport"] == "mux"
+
+        capture = tmp_path / "SERVE_CB_test.jsonl"
+        capture.write_text(
+            "".join(json.dumps(r) + "\n" for r in rows)
+        )
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "check_artifacts_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        problems: list = []
+        mod.check_serve_cb_jsonl(str(capture), problems)
+        assert problems == []
+        # A headline stripped of its verdict is caught.
+        bad = dict(head)
+        del bad["bit_exact_stateless"]
+        capture.write_text(
+            "".join(json.dumps(r) + "\n" for r in rows[:-1] + [bad])
+        )
+        problems = []
+        mod.check_serve_cb_jsonl(str(capture), problems)
+        assert any("bit_exact_stateless" in p for p in problems)
+
+    def test_compare_refuses_recurrent(self, recurrent_bundle):
+        bundle, _engine = recurrent_bundle
+        with pytest.raises(ValueError, match="stateless"):
+            serve_bench_continuous_compare(bundle, n_requests=4)
+
+    def test_committed_capture_validates(self):
+        import importlib.util
+        import os
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "artifacts",
+            "SERVE_CB_r14.jsonl",
+        )
+        if not os.path.exists(path):
+            pytest.skip("no committed SERVE_CB capture")
+        spec = importlib.util.spec_from_file_location(
+            "check_artifacts_schema",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "check_artifacts_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        problems: list = []
+        mod.check_serve_cb_jsonl(path, problems)
+        assert problems == []
+        rows = [
+            json.loads(l) for l in open(path) if l.strip()
+        ]
+        head = rows[-1]
+        # The acceptance bar: continuous p99 strictly better than the
+        # microbatch p99 under the committed bursty profile, bit-exact.
+        assert head["vs_microbatch"] > 1.0
+        assert head["bit_exact_stateless"] is True
+        assert head["burst_config"]["mode"] == "bursty"
+
+
+class TestContinuousWarehouse:
+    def test_continuous_view_joins_occupancy_and_traces(self, tmp_path):
+        """serve.batch_occupancy / serve.slot_wait_ms histograms + the
+        serve_request traces land in the warehouse attributable by
+        (config_hash, batching) — the telemetry-query --continuous view."""
+        from p2pmicrogrid_tpu.data.results import ResultsStore
+        from p2pmicrogrid_tpu.serve import build_registry
+
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        db = str(tmp_path / "r.db")
+        obs = _obs(8, seed=59)
+        for batching in ("micro", "continuous"):
+            registry = build_registry(
+                [bundle], max_batch=4, results_db=db, batching=batching,
+                run_name=f"cb-{batching}",
+            )
+            try:
+                b = registry.get(registry.default_hash)
+                futs = [
+                    b.queue.submit(obs[i], household=f"h{i % 3}")
+                    for i in range(8)
+                ]
+                for f in futs:
+                    f.result(timeout=30)
+            finally:
+                registry.close_all()
+        with ResultsStore(db) as store:
+            rows = store.query_continuous_view()
+        by_batching = {r["batching"]: r for r in rows}
+        assert set(by_batching) == {"micro", "continuous"}
+        cont = by_batching["continuous"]
+        assert cont["n_requests"] == 8
+        assert 0.0 < cont["occupancy_mean"] <= 1.0
+        assert cont["slot_wait_p95_ms"] is not None
+        assert by_batching["micro"]["n_requests"] == 8
+        assert by_batching["micro"]["occupancy_mean"] is None
+
+    def test_telemetry_query_continuous_cli(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+        from p2pmicrogrid_tpu.serve import build_registry
+
+        bundle, _cfg_, _ps = _tabular_bundle(tmp_path)
+        db = str(tmp_path / "r.db")
+        registry = build_registry(
+            [bundle], max_batch=4, results_db=db, batching="continuous",
+        )
+        try:
+            b = registry.get(registry.default_hash)
+            b.queue.submit(_obs(1)[0], household="h0").result(timeout=30)
+        finally:
+            registry.close_all()
+        rc = main(["telemetry-query", "--results-db", db, "--continuous"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        rows = [json.loads(l) for l in out.splitlines() if l.strip()]
+        assert any(r.get("batching") == "continuous" for r in rows)
+        # --watch combination refused like the other views.
+        rc = main([
+            "telemetry-query", "--results-db", db, "--continuous", "--watch",
+        ])
+        assert rc == 2

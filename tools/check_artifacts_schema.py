@@ -372,6 +372,55 @@ def check_gateway_jsonl(path: str, problems: list) -> None:
                 )
 
 
+# Continuous-batching captures (serve-bench --continuous-compare /
+# bench_serve_continuous, ISSUE 14): the headline must carry both arms'
+# percentiles, the occupancy/slot-wait distributions and the
+# continuous-vs-microbatch verdicts — a row without them measured nothing
+# the slot-level batcher promises.
+SERVE_CB_HEADLINE_NUMERIC = (
+    "p50_ms", "p95_ms", "p99_ms",
+    "micro_p50_ms", "micro_p95_ms", "micro_p99_ms",
+    "vs_microbatch", "occupancy_mean", "occupancy_p95",
+    "slot_wait_p50_ms", "slot_wait_p95_ms", "throughput_rps",
+)
+
+
+def check_serve_cb_jsonl(path: str, problems: list) -> None:
+    """SERVE_CB_*.jsonl: metric rows + the ``serve_continuous`` headline
+    contract (numeric percentile/occupancy stats, boolean
+    ``bit_exact_stateless``, a ``burst_config`` object, headline LAST)."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    rows = [
+        (row, rw) for row, rw in _iter_jsonl_rows(path, [])
+        if isinstance(row, dict)
+    ]
+    headlines = [
+        (i, row, rw) for i, (row, rw) in enumerate(rows)
+        if row.get("metric") == "serve_continuous"
+    ]
+    if not headlines:
+        problems.append(f"{where}: no serve_continuous headline row")
+        return
+    if headlines[-1][0] != len(rows) - 1:
+        problems.append(
+            f"{where}: serve_continuous headline must be the last row"
+        )
+    for _i, row, rw in headlines:
+        _require_numeric(
+            row, SERVE_CB_HEADLINE_NUMERIC, rw, problems, "serve_continuous"
+        )
+        _require_bool(
+            row, ("bit_exact_stateless",), rw, problems, "serve_continuous"
+        )
+        bc = row.get("burst_config")
+        if not isinstance(bc, dict) or "mode" not in bc:
+            problems.append(
+                f"{rw}: serve_continuous headline needs a burst_config "
+                "object with a 'mode'"
+            )
+
+
 # Numeric SLO keys every serve_bench_fleet headline row must carry — the
 # chaos-run contract of serve/router.py:serve_bench_fleet. Availability,
 # failover count and retry rate are the point of a fleet capture: a row
@@ -1112,17 +1161,22 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
     gateway_jsonl = set(
         glob.glob(os.path.join(repo_root, "artifacts", "SERVE_GATEWAY_*.jsonl"))
     )
+    serve_cb_jsonl = set(
+        glob.glob(os.path.join(repo_root, "artifacts", "SERVE_CB_*.jsonl"))
+    )
     for pattern in ("BENCH_*.jsonl", "SERVE_*.jsonl"):
         for path in sorted(
             glob.glob(os.path.join(repo_root, "artifacts", pattern))
         ):
-            if path in gateway_jsonl:
-                # SERVE_GATEWAY_* matches SERVE_* too; the gateway check
-                # below includes the metric-row validation.
+            if path in gateway_jsonl or path in serve_cb_jsonl:
+                # SERVE_GATEWAY_* / SERVE_CB_* match SERVE_* too; their
+                # dedicated checks below include the metric-row validation.
                 continue
             check_metric_jsonl(path, problems)
     for path in sorted(gateway_jsonl):
         check_gateway_jsonl(path, problems)
+    for path in sorted(serve_cb_jsonl):
+        check_serve_cb_jsonl(path, problems)
     fleet_proc_jsonl = set(
         glob.glob(os.path.join(repo_root, "artifacts", "FLEET_PROC_*.jsonl"))
     )

@@ -52,6 +52,10 @@ HOT_PATH_FILES = (
     # whole training dispatch per slot.
     os.path.join("p2pmicrogrid_tpu", "ops", "pallas_slot.py"),
     os.path.join("p2pmicrogrid_tpu", "serve", "engine.py"),
+    # The continuous batcher's step loop (ISSUE 14) IS the serving hot
+    # path: every request of every session rides one worker's engine
+    # steps, and a stray readback there serializes the whole slot ring.
+    os.path.join("p2pmicrogrid_tpu", "serve", "continuous.py"),
     # The gateway's async handlers serve every connected household from one
     # event loop — a single un-annotated blocking readback stalls ALL of
     # them, not one request (the worst place in the repo for this class).
