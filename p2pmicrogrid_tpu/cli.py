@@ -1703,6 +1703,124 @@ def cmd_train_recurrent(args) -> int:
     return 0
 
 
+def _serve_trace_rows(results_db: str, slo_ms: float) -> list:
+    """Post-run warehouse analysis for ``serve-bench --fleet --trace``:
+    stitch the slowest exemplar traces back into cross-process trees,
+    pick the headline trace (preferring a COMPLETE >=3-process tree with
+    a failover hop — the chaos story), and decompose critical paths.
+
+    Returns the rows to append to the capture: one ``trace_tree`` row
+    with the stitched spans, then the ``serve_bench_trace`` headline
+    (metric/value/unit/vs_baseline) whose ``critical_path`` segments sum
+    to the root span's measured wall time by construction."""
+    import json as _json
+
+    from p2pmicrogrid_tpu.data.results import ResultsStore
+    from p2pmicrogrid_tpu.telemetry.report import (
+        aggregate_critical_paths,
+        trace_critical_path,
+    )
+
+    rows: list = []
+    with ResultsStore(results_db) as store:
+        seen: set = set()
+        candidates = []
+        for ex in store.query_slowest_traces(64):
+            tid = ex.get("trace_id")
+            if tid and tid not in seen:
+                seen.add(tid)
+                candidates.append(ex)
+        best = None
+        for ex in candidates:
+            tree = store.query_trace_tree(ex["trace_id"])
+            cp = trace_critical_path(tree)
+            if cp is None:
+                continue
+            ids = {s["span_id"] for s in tree}
+            complete = all(
+                s.get("parent_span_id") is None
+                or s["parent_span_id"] in ids
+                for s in tree
+            )
+            failover = any(
+                s.get("name") == "router.attempt"
+                and (s.get("attrs") or {}).get("failover")
+                for s in tree
+            )
+            cand = {
+                "exemplar": ex, "tree": tree, "cp": cp,
+                "tree_complete": complete, "failover": failover,
+            }
+            if best is None:
+                best = cand
+            if cp["n_processes"] >= 3 and failover and complete:
+                best = cand
+                break
+        # Aggregate percentile decomposition over EVERY trace in the
+        # warehouse — one query, grouped in memory.
+        trees: dict = {}
+        for (tid, sid, pid, name, ts, dur, proc, attrs) in store.con.execute(
+            "SELECT trace_id, span_id, parent_span_id, name, ts, "
+            "duration_s, process, attrs_json FROM trace_spans "
+            "ORDER BY trace_id, ts"
+        ):
+            trees.setdefault(tid, []).append({
+                "trace_id": tid, "span_id": sid, "parent_span_id": pid,
+                "name": name, "ts": ts, "duration_s": dur,
+                "process": proc,
+                "attrs": _json.loads(attrs) if attrs else {},
+            })
+        agg = aggregate_critical_paths(list(trees.values()))
+    if best is None:
+        rows.append({
+            "metric": "serve_bench_trace", "value": 0.0, "unit": "ms",
+            "vs_baseline": 0.0, "error": "no traced spans in warehouse",
+        })
+        return rows
+    cp = best["cp"]
+    rows.append({
+        "kind": "trace_tree",
+        "trace_id": cp["trace_id"],
+        "n_spans": cp["n_spans"],
+        "n_processes": cp["n_processes"],
+        "tree_complete": best["tree_complete"],
+        "failover": best["failover"],
+        "spans": [
+            {
+                "span_id": s["span_id"],
+                "parent_span_id": s.get("parent_span_id"),
+                "name": s["name"],
+                "process": s.get("process"),
+                "ts": s.get("ts"),
+                "duration_ms": round((s.get("duration_s") or 0.0) * 1e3, 3),
+            }
+            for s in best["tree"]
+        ],
+    })
+    measured_ms = float(best["exemplar"].get("latency_ms") or 0.0)
+    rows.append({
+        "metric": "serve_bench_trace",
+        "value": cp["total_ms"],
+        "unit": "ms",
+        "vs_baseline": round(slo_ms / cp["total_ms"], 2)
+        if cp["total_ms"] > 0 else 0.0,
+        "trace_id": cp["trace_id"],
+        "critical_path": {
+            k: cp[k]
+            for k in ("wire_ms", "queue_wait_ms", "padding_ms",
+                      "execute_ms", "retry_ms", "total_ms")
+        },
+        "measured_ms": round(measured_ms, 3),
+        "n_processes": cp["n_processes"],
+        "n_spans": cp["n_spans"],
+        "tree_complete": best["tree_complete"],
+        "failover": best["failover"],
+        "critical_path_percentiles": agg,
+        "results_db_traces": len(trees),
+    })
+    return rows
+
+
 def cmd_serve_bench(args) -> int:
     """Open-loop serving benchmark against a policy bundle.
 
@@ -1799,6 +1917,7 @@ def cmd_serve_bench(args) -> int:
 
             from p2pmicrogrid_tpu.serve import (
                 AdmissionConfig,
+                FaultEvent,
                 FaultPlan,
                 FleetRouter,
                 LocalFleet,
@@ -1809,7 +1928,19 @@ def cmd_serve_bench(args) -> int:
                 serve_bench_wire_compare,
             )
 
+            tracing_on = getattr(args, "trace", False)
+            trace_db_tmp = None
+            if tracing_on and not args.results_db:
+                # The stitched tree lives in the warehouse — without a
+                # user-supplied DB the capture still needs one to stitch
+                # from; a temp file, deleted after the analysis.
+                fd, trace_db_tmp = _tempfile.mkstemp(
+                    prefix="p2p-trace-", suffix=".db"
+                )
+                _os.close(fd)
+                args.results_db = trace_db_tmp
             plan = None
+            trace_stall = False
             if getattr(args, "chaos_plan", None):
                 with open(args.chaos_plan) as f:
                     plan = FaultPlan.from_json(f.read())
@@ -1824,8 +1955,24 @@ def cmd_serve_bench(args) -> int:
                     else 0.6 * duration
                 )
                 victim = f"replica-{min(1, args.replicas - 1)}"
+                extra = ()
+                if tracing_on:
+                    # A SIGKILL alone loses the victim's un-flushed spans
+                    # for requests in flight AT the kill. A stall window
+                    # BEFORE the kill (stall > the tightened per-attempt
+                    # router timeout below) forces clean failover hops
+                    # whose victim-side spans DO flush before the kill —
+                    # the >=3-process trees the TRACE capture commits.
+                    trace_stall = True
+                    extra = (FaultEvent(
+                        kind="stall", replica=victim,
+                        at_s=min(0.5, 0.1 * duration),
+                        until_s=min(1.0, 0.2 * duration),
+                        rate=1.0, stall_s=0.8, scope="act",
+                    ),)
                 plan = kill_restart_plan(
-                    victim, kill_at, restart_at, seed=args.chaos_seed
+                    victim, kill_at, restart_at, seed=args.chaos_seed,
+                    extra_events=extra,
                 )
             process_mode = getattr(args, "process", False)
             transport = getattr(args, "fleet_transport", "auto")
@@ -1970,6 +2117,10 @@ def cmd_serve_bench(args) -> int:
                 ssl_context=client_ctx,
                 token=router_token,
                 transport=transport,
+                # Tighter than the stall window's 0.8s hold: a stalled
+                # attempt must TIME OUT client-side and fail over (the
+                # traced hop), not drain the stall and answer late.
+                **({"request_timeout_s": 0.4} if trace_stall else {}),
             )
             unauth_router = None
             if use_auth:
@@ -2052,6 +2203,7 @@ def cmd_serve_bench(args) -> int:
                         process_mode and plan is not None
                     ) else 0.0,
                     gateway_baseline=gateway_baseline,
+                    trace_seed=args.bench_seed if tracing_on else None,
                     extra_headline={
                         "config_hash": reference.manifest.get("config_hash"),
                         "implementation": reference.manifest.get(
@@ -2074,6 +2226,21 @@ def cmd_serve_bench(args) -> int:
                     if path is not None:
                         try:
                             _os.unlink(path)
+                        except OSError:
+                            pass
+            if tracing_on:
+                # Everything is flushed (fleet stopped, router telemetry
+                # closed): stitch the trees, decompose the p99, and append
+                # the trace_tree row + serve_bench_trace headline LAST.
+                try:
+                    for row in _serve_trace_rows(
+                        args.results_db, slo_ms=args.slo_ms
+                    ):
+                        sink.emit(row)
+                finally:
+                    if trace_db_tmp is not None:
+                        try:
+                            _os.unlink(trace_db_tmp)
                         except OSError:
                             pass
             return 0
@@ -3071,6 +3238,60 @@ def cmd_telemetry_report(args) -> int:
         render_run,
     )
 
+    if getattr(args, "perfetto", None):
+        # Merged Chrome-trace (Perfetto-loadable) export of ONE
+        # distributed trace: spans pulled by trace_id from every given
+        # warehouse DB (one per fleet segment, or one shared), merged,
+        # one pid lane per recorded process.
+        import sqlite3
+
+        from p2pmicrogrid_tpu.data.results import TRACE_TREE_SQL
+        from p2pmicrogrid_tpu.telemetry.report import chrome_trace_export
+
+        spans = []
+        for db in args.trace_db or []:
+            try:
+                con = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+            except sqlite3.Error as err:
+                print(f"cannot open {db}: {err}", file=sys.stderr)
+                return 1
+            try:
+                cur = con.execute(TRACE_TREE_SQL, (args.perfetto,))
+                cols = [d[0] for d in cur.description]
+                for r in cur.fetchall():
+                    s = dict(zip(cols, r))
+                    s["attrs"] = json.loads(s.pop("attrs_json") or "{}")
+                    spans.append(s)
+            except sqlite3.Error as err:
+                print(f"SQL error in {db}: {err}", file=sys.stderr)
+                return 1
+            finally:
+                con.close()
+        if not spans:
+            print(
+                f"no spans for trace {args.perfetto} in "
+                f"{args.trace_db or []}",
+                file=sys.stderr,
+            )
+            return 1
+        # De-dup identical spans double-written to multiple DBs.
+        unique = {}
+        for s in spans:
+            unique.setdefault((s.get("span_id"), s.get("run_id")), s)
+        doc = chrome_trace_export(list(unique.values()))
+        out = getattr(args, "out", None)
+        if out:
+            with open(out, "w") as f:
+                json.dump(doc, f)
+            print(
+                f"wrote {len(doc['traceEvents'])} event(s) to {out} "
+                "(open in Perfetto / chrome://tracing)",
+                file=sys.stderr,
+            )
+        else:
+            print(json.dumps(doc))
+        return 0
+
     if getattr(args, "compare", None):
         a, b = args.compare
         for d in (a, b):
@@ -3257,6 +3478,28 @@ def cmd_telemetry_query(args) -> int:
     try:
         if args.sql:
             rows = select(args.sql)
+        elif getattr(args, "trace", None):
+            from p2pmicrogrid_tpu.data.results import TRACE_TREE_SQL
+            from p2pmicrogrid_tpu.telemetry.report import (
+                render_trace_tree,
+                trace_critical_path,
+            )
+
+            spans = select(TRACE_TREE_SQL, (args.trace,))
+            for s in spans:
+                s["attrs"] = json.loads(s.pop("attrs_json") or "{}")
+            if not spans:
+                print(f"no spans for trace {args.trace}", file=sys.stderr)
+                return 1
+            print(render_trace_tree(spans))
+            cp = trace_critical_path(spans)
+            if cp is not None:
+                print(json.dumps({"critical_path": cp}, default=float))
+            return 0
+        elif getattr(args, "slowest", None):
+            from p2pmicrogrid_tpu.data.results import SLOWEST_TRACES_SQL
+
+            rows = select(SLOWEST_TRACES_SQL, (args.slowest,))
         elif getattr(args, "fleet", False):
             from p2pmicrogrid_tpu.data.results import FLEET_VIEW_SQL
 
@@ -3750,6 +3993,19 @@ def main(argv=None) -> int:
     p.add_argument("--compare", nargs=2, metavar=("A", "B"),
                    help="diff two run directories' summaries side by side, "
                         "keyed by their manifests' config_hash/git_rev")
+    p.add_argument("--perfetto", metavar="TRACE_ID",
+                   help="export ONE distributed trace as merged Chrome-"
+                        "trace JSON (Perfetto/chrome://tracing loadable): "
+                        "spans pulled by trace id from every --trace-db "
+                        "warehouse, one pid timeline per process")
+    p.add_argument("--trace-db", action="append", dest="trace_db",
+                   metavar="DB",
+                   help="--perfetto: a warehouse SQLite DB to pull spans "
+                        "from; repeat for a fleet whose segments wrote to "
+                        "different DBs")
+    p.add_argument("--out",
+                   help="--perfetto: write the Chrome-trace JSON here "
+                        "instead of stdout")
     p.set_defaults(fn=cmd_telemetry_report)
 
     p = sub.add_parser(
@@ -3953,6 +4209,17 @@ def main(argv=None) -> int:
                         "and a continuous-batching gateway; emits per-arm "
                         "percentile rows and the serve_continuous "
                         "headline (SERVE_CB_*.jsonl captures)")
+    p.add_argument("--trace", action="store_true",
+                   help="--fleet: distributed tracing — every request "
+                        "carries a deterministic trace context (seeded by "
+                        "--bench-seed) across HTTP/mux into every replica; "
+                        "spans land in the --results-db warehouse (a temp "
+                        "DB if none given) and the run appends a stitched "
+                        "trace-tree row plus the serve_bench_trace "
+                        "headline with the p99 critical path "
+                        "(TRACE_*.jsonl captures). With --chaos, a stall "
+                        "window on the victim plus a tight per-attempt "
+                        "router timeout forces observable failover hops")
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser(
@@ -4480,6 +4747,16 @@ def main(argv=None) -> int:
                         "slot-wait distribution stats — the warehouse "
                         "side of the continuous-vs-microbatch comparison "
                         "(serve/continuous.py)")
+    p.add_argument("--trace", metavar="TRACE_ID",
+                   help="render ONE distributed trace as a tree: every "
+                        "span recorded under this 128-bit trace id across "
+                        "every process that wrote to this warehouse, "
+                        "stitched by parent ids, plus its critical-path "
+                        "decomposition as a final JSON line")
+    p.add_argument("--slowest", type=int, metavar="N",
+                   help="the N slowest latency-histogram exemplars "
+                        "(value-ordered) with their trace ids — the entry "
+                        "points into --trace")
     p.add_argument("--watch", action="store_true",
                    help="tail mode: poll the warehouse join and stream "
                         "new/updated rows as JSON lines until interrupted "

@@ -83,7 +83,7 @@ _DDL = [
 # is additive only; bumping TELEMETRY_SCHEMA_VERSION must come with a
 # migration branch in ``ensure_telemetry_schema``).
 
-TELEMETRY_SCHEMA_VERSION = 2
+TELEMETRY_SCHEMA_VERSION = 3
 
 _TELEMETRY_DDL = [
     # One row per telemetry run: the manifest identity columns are promoted
@@ -137,6 +137,22 @@ _TELEMETRY_DDL = [
         window_start_ts real NOT NULL, created_ts real NOT NULL,
         expires_ts real NOT NULL, released integer NOT NULL DEFAULT 0,
         exported_through_ts real)""",
+    # v3: distributed-trace spans (ISSUE 16, telemetry/tracing.py). Unlike
+    # telemetry_spans (per-process perf_counter origin), these carry EPOCH
+    # start timestamps and the propagated trace/span/parent ids — every
+    # process writes its own rows, and ``TRACE_TREE_SQL`` stitches one
+    # cross-process tree back together by trace_id. ``process`` is the
+    # emitter's role:pid label (one Perfetto lane each in the merged
+    # export); ``attrs_json`` carries the span's structured attributes
+    # (replica_id, bucket, padded_rows, hop, ...).
+    """CREATE TABLE IF NOT EXISTS trace_spans
+       (run_id text NOT NULL REFERENCES telemetry_runs(run_id),
+        seq integer NOT NULL, trace_id text NOT NULL, span_id text NOT NULL,
+        parent_span_id text, name text NOT NULL, ts real,
+        duration_s real, process text, attrs_json text,
+        PRIMARY KEY (run_id, seq))""",
+    """CREATE INDEX IF NOT EXISTS idx_trace_spans_trace
+       ON trace_spans(trace_id, ts)""",
 ]
 
 
@@ -556,9 +572,10 @@ def ensure_telemetry_schema(con: sqlite3.Connection) -> int:
     for ddl in _TELEMETRY_DDL:
         con.execute(ddl)
     if version < TELEMETRY_SCHEMA_VERSION:
-        # v0 -> v1 (warehouse tables) and v1 -> v2 (export_leases) are both
-        # pure table creation — the DDL loop above is the whole migration;
-        # future bumps branch on `version` here with ALTER TABLE migrations.
+        # v0 -> v1 (warehouse tables), v1 -> v2 (export_leases) and
+        # v2 -> v3 (trace_spans) are all pure table creation — the DDL loop
+        # above is the whole migration; future bumps branch on `version`
+        # here with ALTER TABLE migrations.
         con.execute(f"PRAGMA user_version = {TELEMETRY_SCHEMA_VERSION}")
     con.commit()
     return TELEMETRY_SCHEMA_VERSION
@@ -597,6 +614,9 @@ SELECT t.config_hash,
        COALESCE(SUM(CASE WHEN p.kind = 'counter'
            AND p.name = 'router.auth_denied' THEN p.value END), 0)
            AS router_auth_denied,
+       MAX(CASE WHEN p.kind = 'sink_gauge'
+           AND p.name = 'telemetry.ingest_lag_ms' THEN p.value END)
+           AS ingest_lag_ms,
        (SELECT json_extract(p2.attrs_json, '$.processes')
           FROM telemetry_points p2
           JOIN telemetry_runs t2 ON t2.run_id = p2.run_id
@@ -615,6 +635,42 @@ WHERE json_extract(t.manifest_json, '$.serve_role') IS NOT NULL
 GROUP BY t.config_hash
 ORDER BY t.config_hash
 """
+
+# One distributed trace tree (schema v3, ISSUE 16): every process wrote
+# its spans into its own run's ``trace_spans`` rows; this stitches the
+# cross-process tree back together by trace_id, time-ordered, with the
+# emitting run's serve_role alongside so the rendering
+# (``telemetry-query --trace``) can show WHICH process answered each hop.
+# Depth is resolved by the renderer (parent links can cross runs, so a
+# recursive CTE keyed on run-local ids would miss cross-process edges).
+TRACE_TREE_SQL = """
+SELECT s.trace_id, s.span_id, s.parent_span_id, s.name, s.ts,
+       s.duration_s, s.process, s.attrs_json, s.run_id,
+       json_extract(t.manifest_json, '$.serve_role') AS serve_role
+FROM trace_spans s
+LEFT JOIN telemetry_runs t ON t.run_id = s.run_id
+WHERE s.trace_id = ?
+ORDER BY s.ts, s.seq
+"""
+
+# Exemplar traces behind the latency histogram's slowest buckets
+# (``telemetry-query --slowest N``): ``Telemetry.histogram`` keeps one
+# max-value exemplar per log2 bucket when the caller attaches a trace_id,
+# and close() explodes them as ``hist_exemplar`` points — so the p99
+# bucket of ``router.latency_ms`` links to REAL trace_ids, not a
+# statistical abstraction.
+SLOWEST_TRACES_SQL = """
+SELECT json_extract(p.attrs_json, '$.trace_id') AS trace_id,
+       p.name, p.value AS latency_ms,
+       json_extract(p.attrs_json, '$.bucket') AS bucket,
+       p.run_id, p.ts
+FROM telemetry_points p
+WHERE p.kind = 'hist_exemplar'
+  AND json_extract(p.attrs_json, '$.trace_id') IS NOT NULL
+ORDER BY p.value DESC
+LIMIT ?
+"""
+
 
 # The training-resilience view (train/resilience.py): every config_hash
 # whose runs recorded divergence trips or rollbacks, with the
@@ -1150,6 +1206,29 @@ class ResultsStore:
                 except json.JSONDecodeError:
                     pass
         return rows
+
+    def query_trace_tree(self, trace_id: str) -> list:
+        """Every span of one distributed trace, across ALL the runs in
+        this warehouse, time-ordered (``TRACE_TREE_SQL``), as dicts with
+        ``attrs`` parsed from attrs_json."""
+        cur = self.con.execute(TRACE_TREE_SQL, (trace_id,))
+        cols = [d[0] for d in cur.description]
+        rows = [dict(zip(cols, row)) for row in cur.fetchall()]
+        for row in rows:
+            raw = row.pop("attrs_json", None)
+            try:
+                row["attrs"] = json.loads(raw) if raw else {}
+            except json.JSONDecodeError:
+                row["attrs"] = {}
+        return rows
+
+    def query_slowest_traces(self, n: int = 10) -> list:
+        """The ``n`` highest-latency histogram exemplars carrying a
+        trace_id (``SLOWEST_TRACES_SQL``) — the p99 bucket's link back to
+        real traces — as dicts."""
+        cur = self.con.execute(SLOWEST_TRACES_SQL, (int(n),))
+        cols = [d[0] for d in cur.description]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
 
     def query_continuous_view(self) -> list:
         """Continuous-vs-microbatch serving attribution per config_hash

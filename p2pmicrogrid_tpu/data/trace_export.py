@@ -349,7 +349,7 @@ def export_serve_traces(
                 window_lo = ts if window_lo is None else min(window_lo, ts)
                 window_hi = ts if window_hi is None else max(window_hi, ts)
             per_household.setdefault((run_id, household), []).append(
-                (obs, action, ts)
+                (obs, action, ts, attrs.get("request_id"))
             )
     finally:
         con.close()
@@ -360,11 +360,19 @@ def export_serve_traces(
     meta: List[dict] = []
     households: set = set()
     for (run_id, household), decisions in sorted(per_household.items()):
-        for (o, a, ts), (o_next, _, _) in zip(decisions, decisions[1:]):
+        for (o, a, ts, rid), (o_next, _, _, _) in zip(
+            decisions, decisions[1:]
+        ):
             obs_rows.append(o)
             act_rows.append(a)
             next_rows.append(o_next)
-            meta.append({"run_id": run_id, "household": household, "ts": ts})
+            meta.append({
+                "run_id": run_id, "household": household, "ts": ts,
+                # The gateway's per-row request id (the trace span id when
+                # traced): the EXACT settlement join key — household+ts
+                # stays only as the legacy-warehouse fallback.
+                "request_id": rid,
+            })
             households.add(household)
     if len(obs_rows) < max(min_transitions, 1):
         raise ValueError(
@@ -492,7 +500,9 @@ def bill_decisions(
             obs, action = attrs.get("obs"), attrs.get("action")
             if not household or obs is None or action is None or ts is None:
                 continue
-            decisions.append((household, ts, obs, action))
+            decisions.append(
+                (household, ts, obs, action, attrs.get("request_id"))
+            )
     finally:
         con.close()
     if not decisions:
@@ -508,7 +518,7 @@ def bill_decisions(
         manifest={"settlement_role": "meter", "config_hash": config_hash},
     )
     try:
-        for household, ts, obs, action in decisions:
+        for household, ts, obs, action, request_id in decisions:
             # host-sync: warehouse JSON payloads, host data throughout.
             billed = np.asarray(
                 bill_fn(
@@ -524,6 +534,9 @@ def bill_decisions(
                 # point's own timestamp column and vanish from attrs):
                 # the join key is the DECISION's timestamp.
                 decision_ts=round(float(ts), 3),
+                # Copied verbatim from the decision: the exact id join
+                # (settlement_reward_fn prefers it over household+ts).
+                request_id=request_id,
                 billed_eur=[round(float(b), 8) for b in billed],
             )
     finally:
@@ -607,6 +620,7 @@ def settlement_reward_fn(
                 rows = []  # pre-warehouse DB
         finally:
             con.close()
+        billed_by_id: Dict[str, np.ndarray] = {}
         for (attrs_json,) in rows:
             try:
                 attrs = json.loads(attrs_json) if attrs_json else {}
@@ -616,18 +630,29 @@ def settlement_reward_fn(
             values = attrs.get("billed_eur")
             if not household or values is None:
                 continue
-            key = _settlement_key(household, attrs.get("decision_ts"))
             # host-sync: warehouse JSON payloads, host data.
-            billed[key] = np.asarray(values, dtype=np.float32)
+            arr = np.asarray(values, dtype=np.float32)
+            key = _settlement_key(household, attrs.get("decision_ts"))
+            billed[key] = arr
+            rid = attrs.get("request_id")
+            if rid:
+                billed_by_id[str(rid)] = arr
         n = obs.shape[0]
         reward = np.zeros(action.shape, dtype=np.float32)
         th = cfg.thermal
         missing: List[int] = []
         for i in range(n):
             m = meta[i] if i < len(meta) else {}
-            row = billed.get(
-                _settlement_key(m.get("household"), m.get("ts"))
-            )
+            # Exact join by the decision's request_id (the serving-side
+            # trace span_id, carried through decision AND bill) when the
+            # warehouse has it; household+timestamp stays as the legacy
+            # fallback for warehouses written before ids existed.
+            rid = m.get("request_id")
+            row = billed_by_id.get(str(rid)) if rid else None
+            if row is None:
+                row = billed.get(
+                    _settlement_key(m.get("household"), m.get("ts"))
+                )
             if row is None or row.shape != action[i].shape:
                 missing.append(i)
                 continue

@@ -98,6 +98,9 @@ class _Request:
     slot: int = -1
     gen: int = -1
     fresh: bool = True
+    trace: object = None        # TraceContext when the caller is traced
+    request_id: Optional[str] = None
+    t_enq_epoch: float = 0.0
 
 
 class ContinuousBatcher:
@@ -240,12 +243,17 @@ class ContinuousBatcher:
         with self._cv:
             return len(self._pending)
 
-    def submit(self, obs_row, household: Optional[str] = None) -> Future:
+    def submit(
+        self, obs_row, household: Optional[str] = None,
+        trace=None, request_id: Optional[str] = None,
+    ) -> Future:
         """Queue one community observation row; resolves to actions [A].
 
         ``household`` pins the request to its session slot (hidden-state
         continuity for recurrent bundles); ``None`` serves from a fresh
-        deterministic zero carry on the scratch row."""
+        deterministic zero carry on the scratch row. ``trace`` (a
+        TraceContext) and ``request_id`` flow through to the step's trace
+        records so queue-wait/execute spans stitch into the caller's tree."""
         # host-sync: caller-supplied host observation row.
         obs_row = np.asarray(obs_row, dtype=np.float32)
         fut: Future = Future()
@@ -256,6 +264,8 @@ class ContinuousBatcher:
                 _Request(
                     obs=obs_row, future=fut, t_enq=time.monotonic(),
                     household=household if self.sessions_enabled else None,
+                    trace=trace, request_id=request_id,
+                    t_enq_epoch=time.time(),
                 )
             )
             self._cv.notify()
@@ -514,6 +524,7 @@ class ContinuousBatcher:
         bucket = self.engine.bucket_for(b)
         obs = np.stack([r.obs for r in batch])
         dispatch_t = time.monotonic()
+        dispatch_epoch = time.time()
         for req in batch:
             self.recent_wait_ms.append(
                 (dispatch_t, (dispatch_t - req.t_enq) * 1e3)
@@ -579,21 +590,28 @@ class ContinuousBatcher:
             except InvalidStateError:
                 pass  # cancelled between the check and delivery
         try:
-            self._trace(batch, b, bucket, dispatch_t, service_s)
+            self._trace(batch, b, bucket, dispatch_t, service_s, dispatch_epoch)
         except Exception:  # noqa: BLE001 — telemetry is best-effort
             pass
 
     def _trace(
-        self, batch, b: int, bucket: int, dispatch_t: float, service_s: float
+        self, batch, b: int, bucket: int, dispatch_t: float,
+        service_s: float, dispatch_epoch: float = 0.0,
     ) -> None:
         """Per-step occupancy + per-request slot-wait records through the
         engine's telemetry: the queueing story the warehouse attributes the
-        continuous-vs-microbatch win with."""
+        continuous-vs-microbatch win with. Traced requests additionally get
+        real ``queue.wait``/``engine.execute`` spans, one fan-in
+        ``engine.step`` span, and a synthetic ``engine.pad`` span — the same
+        shapes the microbatch queue emits."""
+        from p2pmicrogrid_tpu.telemetry.tracing import record_span
+
         tel = self.engine.telemetry
         if tel is None:
             return
         tel.counter("serve.steps")
         tel.histogram("serve.batch_occupancy", b / bucket)
+        padded = bucket - b
         for row_i, req in enumerate(batch):
             wait_ms = (dispatch_t - req.t_enq) * 1e3
             tel.histogram("serve.slot_wait_ms", wait_ms)
@@ -603,11 +621,38 @@ class ContinuousBatcher:
                 row=row_i,
                 batch_size=b,
                 bucket=bucket,
-                padded_rows=bucket - b,
+                padded_rows=padded,
                 slot=None if req.slot == self.SCRATCH else req.slot,
                 wait_ms=round(wait_ms, 3),
                 service_ms=round(service_s * 1e3, 3),
                 latency_ms=round(wait_ms + service_s * 1e3, 3),
+                request_id=req.request_id,
+            )
+        traced = [req for req in batch if req.trace is not None]
+        if not traced:
+            return
+        for req in traced:
+            wait_s = max(0.0, dispatch_epoch - req.t_enq_epoch)
+            record_span(
+                tel, req.trace.child("queue.wait"), "queue.wait",
+                req.t_enq_epoch, wait_s, batch_size=b,
+            )
+            record_span(
+                tel, req.trace.child("engine.execute"), "engine.execute",
+                dispatch_epoch, service_s,
+                bucket=bucket, batch_size=b, padded_rows=padded,
+            )
+        first_ctx = traced[0].trace
+        record_span(
+            tel, first_ctx.child("engine.step"), "engine.step",
+            dispatch_epoch, service_s,
+            bucket=bucket, batch_size=b, linked=len(traced),
+        )
+        if padded > 0:
+            record_span(
+                tel, first_ctx.child("engine.pad"), "engine.pad",
+                dispatch_epoch, service_s * padded / bucket,
+                bucket=bucket, padded_rows=padded, estimated=True,
             )
 
 

@@ -688,10 +688,13 @@ class MicroBatchQueue:
         with self._cv:
             return len(self._pending)
 
-    def submit(self, obs_row, household=None) -> Future:
+    def submit(self, obs_row, household=None, trace=None, request_id=None) -> Future:
         # ``household`` is accepted (and ignored) so the gateway submits
         # through one interface: the continuous batcher uses it for slot
         # affinity; the stateless microbatch path has no sessions to pin.
+        # ``trace`` (a TraceContext or None) and ``request_id`` ride the
+        # pending tuple so _trace can stitch queue-wait/execute spans and
+        # id-joinable serve_request events without a side lookup.
         del household
         # host-sync: caller-supplied host observation row.
         obs_row = np.asarray(obs_row, dtype=np.float32)
@@ -699,7 +702,9 @@ class MicroBatchQueue:
         with self._cv:
             if self._closed:
                 raise RuntimeError("queue is closed")
-            self._pending.append((obs_row, fut, time.monotonic()))
+            self._pending.append(
+                (obs_row, fut, time.monotonic(), trace, request_id, time.time())
+            )
             self._cv.notify()
         return fut
 
@@ -724,13 +729,14 @@ class MicroBatchQueue:
                 del self._pending[: self.max_batch]
             try:
                 dispatch_t = time.monotonic()
-                for _, _, t_enq in batch:
+                dispatch_epoch = time.time()
+                for entry in batch:
                     self.recent_wait_ms.append(
-                        (dispatch_t, (dispatch_t - t_enq) * 1e3)
+                        (dispatch_t, (dispatch_t - entry[2]) * 1e3)
                     )
-                out = self.engine.act(np.stack([row for row, _, _ in batch]))
+                out = self.engine.act(np.stack([entry[0] for entry in batch]))
                 service_s = time.monotonic() - dispatch_t
-                for i, (_, fut, _) in enumerate(batch):
+                for i, (_, fut, *_rest) in enumerate(batch):
                     # A caller may have given up mid-batch (the gateway's
                     # request timeout cancels through wrap_future);
                     # delivering to a cancelled future raises and must not
@@ -743,7 +749,7 @@ class MicroBatchQueue:
                     except InvalidStateError:
                         pass  # cancelled between the check and delivery
             except Exception as err:  # noqa: BLE001 — fail the waiters, not the loop
-                for _, fut, _ in batch:
+                for _, fut, *_rest in batch:
                     if not fut.done():
                         try:
                             fut.set_exception(err)
@@ -754,21 +760,37 @@ class MicroBatchQueue:
                 # AFTER result delivery, and fenced off: a sink hiccup (a
                 # locked warehouse DB, full disk) must not fail waiters whose
                 # inference succeeded, nor stall the next dispatch's results.
-                self._trace(batch, dispatch_t, service_s)
+                self._trace(batch, dispatch_t, service_s, dispatch_epoch)
             except Exception:  # noqa: BLE001 — telemetry is best-effort
                 pass
 
-    def _trace(self, batch, dispatch_t: float, service_s: float) -> None:
+    def _trace(
+        self, batch, dispatch_t: float, service_s: float,
+        dispatch_epoch: float = 0.0,
+    ) -> None:
         """Per-request trace records through the engine's telemetry: the
         enqueue->dispatch coalescing wait, the bucket the batch padded to,
         and the shared batch-service span — the queueing story serve-bench
-        models on a virtual clock, measured live here."""
+        models on a virtual clock, measured live here.
+
+        Traced requests additionally get real spans: a per-request
+        ``queue.wait`` and ``engine.execute`` pair, plus ONE ``engine.step``
+        span under the first traced request's context that fans in the whole
+        coalesced dispatch (``linked`` = how many traced requests shared it)
+        and a synthetic ``engine.pad`` span attributing the padded-lane share
+        of the batch's service time."""
+        from p2pmicrogrid_tpu.telemetry.tracing import record_span
+
         tel = self.engine.telemetry
         if tel is None:
             return
         n = len(batch)
         bucket = self.engine.bucket_for(n)
-        for row_i, (_, _, t_enq) in enumerate(batch):
+        padded = bucket - n
+        traced = [e for e in batch if len(e) >= 6 and e[3] is not None]
+        for row_i, entry in enumerate(batch):
+            t_enq = entry[2]
+            request_id = entry[4] if len(entry) >= 6 else None
             wait_ms = (dispatch_t - t_enq) * 1e3
             tel.histogram("serve.queue_wait_ms", wait_ms)
             tel.event(
@@ -777,10 +799,37 @@ class MicroBatchQueue:
                 row=row_i,
                 batch_size=n,
                 bucket=bucket,
-                padded_rows=bucket - n,
+                padded_rows=padded,
                 wait_ms=round(wait_ms, 3),
                 service_ms=round(service_s * 1e3, 3),
                 latency_ms=round(wait_ms + service_s * 1e3, 3),
+                request_id=request_id,
+            )
+        if not traced:
+            return
+        for entry in traced:
+            ctx, t_enq_epoch = entry[3], entry[5]
+            wait_s = max(0.0, dispatch_epoch - t_enq_epoch)
+            record_span(
+                tel, ctx.child("queue.wait"), "queue.wait",
+                t_enq_epoch, wait_s, batch_size=n,
+            )
+            record_span(
+                tel, ctx.child("engine.execute"), "engine.execute",
+                dispatch_epoch, service_s,
+                bucket=bucket, batch_size=n, padded_rows=padded,
+            )
+        first_ctx = traced[0][3]
+        record_span(
+            tel, first_ctx.child("engine.step"), "engine.step",
+            dispatch_epoch, service_s,
+            bucket=bucket, batch_size=n, linked=len(traced),
+        )
+        if padded > 0:
+            record_span(
+                tel, first_ctx.child("engine.pad"), "engine.pad",
+                dispatch_epoch, service_s * padded / bucket,
+                bucket=bucket, padded_rows=padded, estimated=True,
             )
 
     def close(self) -> None:

@@ -88,6 +88,12 @@ import numpy as np
 from p2pmicrogrid_tpu.serve.auth import AuthError
 from p2pmicrogrid_tpu.serve.registry import BundleRegistry, ServingBundle
 from p2pmicrogrid_tpu.serve.wire import serve_mux_connection
+from p2pmicrogrid_tpu.telemetry.tracing import (
+    TRACE_HEADER,
+    new_span_id,
+    record_span,
+)
+from p2pmicrogrid_tpu.telemetry.tracing import decode as decode_trace
 
 _JSON_HEADERS = (("Content-Type", "application/json"),)
 _REASONS = {
@@ -485,7 +491,8 @@ class ServeGateway:
                     if fault is not None and fault.kind == "error":
                         raise _HttpError(500, "injected fault")
                     return await self._route(
-                        method, path, body, token=bearer_token(headers)
+                        method, path, body, token=bearer_token(headers),
+                        trace=headers.get(TRACE_HEADER),
                     )
 
                 status, payload, extra = await route_safely(
@@ -522,15 +529,20 @@ class ServeGateway:
 
     # -- the multiplexed listener --------------------------------------------
 
-    async def _mux_route(self, method: str, path: str, body_doc, token):
+    async def _mux_route(
+        self, method: str, path: str, body_doc, token, trace=None
+    ):
         """One mux frame's request through the SAME routing/admission/auth
         path HTTP requests take (the frame body re-serializes so /v1/act
-        and /admin/swap parse identically on both wires)."""
+        and /admin/swap parse identically on both wires). ``trace`` is
+        the frame's encoded trace context (serve_mux_connection passes it
+        because this route declares the parameter)."""
         self.stats["requests"] += 1
         self.stats["mux_requests"] += 1
         body = json.dumps(body_doc).encode() if body_doc is not None else b""
         return await route_safely(
-            self._route(method, path, body, token=token), self.stats
+            self._route(method, path, body, token=token, trace=trace),
+            self.stats,
         )
 
     def _on_mux_fault(self, fault) -> None:
@@ -597,7 +609,9 @@ class ServeGateway:
                 lambda: self.authenticator.check_admin(token), self.stats
             )
 
-    async def _route(self, method: str, path: str, body: bytes, token=None):
+    async def _route(
+        self, method: str, path: str, body: bytes, token=None, trace=None
+    ):
         if path == "/healthz":
             if method != "GET":
                 raise _HttpError(405, "GET only")
@@ -627,7 +641,7 @@ class ServeGateway:
         if path == "/v1/act":
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._act(body, token=token)
+            return await self._act(body, token=token, trace=trace)
         if path == "/admin/swap":
             if method != "POST":
                 raise _HttpError(405, "POST only")
@@ -719,8 +733,13 @@ class ServeGateway:
                     retry_after_s=adm.retry_after_s,
                 )
 
-    async def _act(self, body: bytes, token=None):
+    async def _act(self, body: bytes, token=None, trace=None):
         self.stats["act_requests"] += 1
+        # Decoded ONCE at the door; a malformed value means untraced, not
+        # 400 — observability must never fail a request it observes.
+        ctx = decode_trace(trace)
+        t_req = time.monotonic()
+        t_req_epoch = time.time()
         if self._draining:
             raise _HttpError(
                 503, "gateway is draining",
@@ -740,14 +759,38 @@ class ServeGateway:
             raise _HttpError(503, str(err)) from None
         obs, batched = self._parse_obs(doc, bundle.engine.n_agents)
         self._admit(bundle)
+        gw_ctx = ctx.child("gateway.act") if ctx is not None else None
+        if gw_ctx is not None and bundle.telemetry is not None:
+            # Admission/auth/parse cost up to this point, as its own span.
+            record_span(
+                bundle.telemetry, gw_ctx.child("gateway.admit"),
+                "gateway.admit", t_req_epoch, time.monotonic() - t_req,
+                replica_id=self.replica_id,
+            )
         self._inflight += 1
         self._idle.clear()
         try:
             # The household id rides into the queue: the continuous
             # batcher pins it to its session slot (hidden-state
-            # continuity); the microbatch queue ignores it.
+            # continuity); the microbatch queue ignores it. Every row gets
+            # a request_id — the per-row trace span id when traced, a
+            # random one otherwise — so serve_request/serve_decision
+            # events pair EXACTLY by id (data/trace_export.py), never by
+            # household+timestamp ordering.
+            row_ctxs = [
+                gw_ctx.child(f"row{b}") if gw_ctx is not None else None
+                for b in range(obs.shape[0])
+            ]
+            row_ids = [
+                (rc.span_id if rc is not None else new_span_id())
+                for rc in row_ctxs
+            ]
             futures = [
-                bundle.queue.submit(row, household=household) for row in obs
+                bundle.queue.submit(
+                    row, household=household,
+                    trace=row_ctxs[b], request_id=row_ids[b],
+                )
+                for b, row in enumerate(obs)
             ]
             rows = await asyncio.wait_for(
                 asyncio.gather(*(asyncio.wrap_future(f) for f in futures)),
@@ -787,9 +830,35 @@ class ServeGateway:
                         "serve_decision",
                         household=household,
                         row=b,
+                        request_id=row_ids[b],
                         obs=obs[b].tolist(),
                         action=actions[b],
                     )
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
+        if gw_ctx is not None and bundle.telemetry is not None:
+            # One span per row at the ROW context itself (the queue's
+            # queue.wait/engine.execute spans are its children) — without
+            # it every queue span would be an orphan in the stitched tree.
+            for b, rc in enumerate(row_ctxs):
+                record_span(
+                    bundle.telemetry, rc, "gateway.row",
+                    t_req_epoch, time.monotonic() - t_req,
+                    row=b, request_id=row_ids[b],
+                )
+            record_span(
+                bundle.telemetry, gw_ctx, "gateway.act",
+                t_req_epoch, time.monotonic() - t_req,
+                replica_id=self.replica_id, hop=ctx.hop,
+                n_rows=len(rows), household=household,
+                config_hash=bundle.config_hash,
+            )
+            # Flush NOW, per traced request: a replica SIGKILLed seconds
+            # from now must not take this request's spans down with its
+            # 64-record batch buffer — the chaos capture's cross-process
+            # trees depend on the victim's spans surviving it.
+            try:
+                bundle.telemetry.flush()
             except Exception:  # noqa: BLE001 — telemetry is best-effort
                 pass
         return 200, {

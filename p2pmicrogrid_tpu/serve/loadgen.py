@@ -39,6 +39,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from p2pmicrogrid_tpu.serve.wire import FrameTooLarge, WireProtocolError
+from p2pmicrogrid_tpu.telemetry.tracing import record_span, root_context
 
 
 # --- client retry primitives --------------------------------------------------
@@ -454,6 +455,7 @@ async def _http_request_json(
     timeout_s: float,
     ssl=None,
     token: Optional[str] = None,
+    trace: Optional[str] = None,
 ):
     """One JSON request over a fresh connection; returns (status, parsed
     body, response headers). A non-empty body that fails to parse comes
@@ -462,11 +464,15 @@ async def _http_request_json(
     HTTP/1.1 — mirrors the gateway's server side; the ONE copy of the
     client framing logic (the fleet router's GETs share it). ``ssl`` is a
     client SSLContext for TLS-terminating gateways; ``token`` rides as the
-    ``Authorization: Bearer`` credential (serve/auth.py)."""
+    ``Authorization: Bearer`` credential (serve/auth.py); ``trace`` is an
+    encoded distributed-trace context (telemetry/tracing.py) carried as
+    the ``x-p2p-trace`` header — the HTTP front's propagation channel."""
     body = json.dumps(payload).encode() if payload is not None else b""
     head = f"{method} {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
     if token is not None:
         head += f"Authorization: Bearer {token}\r\n"
+    if trace is not None:
+        head += f"x-p2p-trace: {trace}\r\n"
     if payload is not None:
         head += (
             "Content-Type: application/json\r\n"
@@ -511,11 +517,12 @@ async def _http_request_json(
 
 async def _http_post_json(
     host: str, port: int, path: str, payload: dict, timeout_s: float,
-    ssl=None, token: Optional[str] = None,
+    ssl=None, token: Optional[str] = None, trace: Optional[str] = None,
 ):
     """(status, doc, headers) of one POST — see ``_http_request_json``."""
     return await _http_request_json(
-        host, port, "POST", path, payload, timeout_s, ssl=ssl, token=token
+        host, port, "POST", path, payload, timeout_s, ssl=ssl, token=token,
+        trace=trace,
     )
 
 
@@ -546,6 +553,8 @@ def run_network_loadgen(
     mux_pool_size: int = 2,
     mux_max_frame_bytes: Optional[int] = None,
     record_actions: bool = False,
+    trace_seed: Optional[int] = None,
+    trace_telemetry=None,
 ) -> NetworkLoadgenResult:
     """Fire ``obs[i]`` at the gateway at ``arrivals[i]`` seconds (open loop:
     send times never wait on completions) and measure wire latencies.
@@ -574,6 +583,14 @@ def run_network_loadgen(
     ``shed_rate``, and latency includes the backoff time a real client
     would spend. Retry sleeps are seeded (``retry_seed``) so two runs
     draw identical jitter.
+
+    ``trace_seed`` (not None) turns on distributed tracing: request ``i``
+    carries the deterministic root context ``root_context(trace_seed, i)``
+    on the wire (HTTP header / mux frame field), so the server-side spans
+    of two replays of one schedule stitch into byte-identical trees. With
+    ``trace_telemetry`` the loadgen also records the client-side root span
+    (``client.request``: send -> final response, retries included) — the
+    tree's top without a router in front.
     """
     if transport not in ("http", "mux"):
         raise ValueError(f"transport must be 'http' or 'mux', got {transport!r}")
@@ -589,7 +606,8 @@ def run_network_loadgen(
     pool_box: List = [None]  # MuxPool, created inside the event loop
 
     async def attempt(
-        payload: dict, attempt_timeout_s: float, token: Optional[str]
+        payload: dict, attempt_timeout_s: float, token: Optional[str],
+        trace: Optional[str] = None,
     ):
         """(status, doc, headers); transport failures -> status -1."""
         try:
@@ -608,11 +626,12 @@ def run_network_loadgen(
                         host, port, size=mux_pool_size, ssl=ssl, **kw
                     )
                 return await pool_box[0].request(
-                    path, payload, attempt_timeout_s, token=token
+                    path, payload, attempt_timeout_s, token=token,
+                    trace=trace,
                 )
             return await _http_post_json(
                 host, port, path, payload, attempt_timeout_s,
-                ssl=ssl, token=token,
+                ssl=ssl, token=token, trace=trace,
             )
         except FrameTooLarge as err:
             # Over-cap REQUEST on the mux wire: the terminal 413 the HTTP
@@ -635,7 +654,9 @@ def run_network_loadgen(
         payload = {"household": household, "obs": obs[i].tolist()}
         token = token_fn(household) if token_fn is not None else None
         rng = random.Random((retry_seed << 20) ^ i)
+        ctx = root_context(trace_seed, i) if trace_seed is not None else None
         t_send = time.perf_counter()
+        t_send_epoch = time.time()
         deadline = t_send + (retry.deadline_s if retry else timeout_s)
         tries = 0
         while True:
@@ -646,7 +667,8 @@ def run_network_loadgen(
                 0.05, min(timeout_s, deadline - time.perf_counter())
             )
             status, doc, headers = await attempt(
-                payload, attempt_timeout, token
+                payload, attempt_timeout, token,
+                trace=ctx.encode() if ctx is not None else None,
             )
             tries += 1
             # A 200 whose payload failed to parse is a corrupt answer —
@@ -681,6 +703,16 @@ def run_network_loadgen(
         hashes[i] = (doc or {}).get("config_hash")
         if actions_out is not None:
             actions_out[i] = (doc or {}).get("actions")
+        if ctx is not None and trace_telemetry is not None:
+            record_span(
+                trace_telemetry, ctx, "client.request",
+                t_send_epoch, float(latencies[i]),
+                status=int(status), retries=int(retries[i]),
+            )
+            trace_telemetry.histogram(
+                "client.latency_ms", float(latencies[i]) * 1e3,
+                trace_id=ctx.trace_id,
+            )
 
     async def run() -> float:
         t0 = time.perf_counter()

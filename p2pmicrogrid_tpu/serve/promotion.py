@@ -732,19 +732,42 @@ class CanaryController:
                 "AND p.ts >= ?",
                 (config_hash, since_ts),
             ).fetchall()
+            decision_rows = con.execute(
+                "SELECT json_extract(p.attrs_json, '$.request_id') "
+                "FROM telemetry_points p "
+                "JOIN telemetry_runs t ON t.run_id = p.run_id "
+                "WHERE t.config_hash = ? AND p.kind = 'serve_decision' "
+                "AND p.ts >= ?",
+                (config_hash, since_ts),
+            ).fetchall()
         except sqlite3.OperationalError:
             return None, 0  # pre-warehouse DB
         finally:
             con.close()
+        decision_ids = {str(r) for (r,) in decision_rows if r}
         latencies: List[float] = []
+        id_latencies: List[float] = []
         for (attrs_json,) in rows:
             try:
                 attrs = json.loads(attrs_json) if attrs_json else {}
             except ValueError:
                 continue
             v = attrs.get("latency_ms")
-            if isinstance(v, (int, float)):
-                latencies.append(float(v))
+            if not isinstance(v, (int, float)):
+                continue
+            latencies.append(float(v))
+            rid = attrs.get("request_id")
+            if rid and str(rid) in decision_ids:
+                id_latencies.append(float(v))
+        # Exact join: when requests and this arm's decisions share
+        # request_ids, the SLO is computed over exactly the requests
+        # that produced a recorded decision for THIS arm — a request
+        # misattributed by the timestamp-era heuristics (shared queue,
+        # clock skew) can no longer charge the wrong arm. Warehouses
+        # written before ids existed fall back to every serve_request
+        # row under the arm's config_hash, as before.
+        if id_latencies:
+            latencies = id_latencies
         if not latencies:
             return None, 0
         # host-sync: warehouse JSON payloads, host data.

@@ -45,6 +45,8 @@ from p2pmicrogrid_tpu.serve.gateway import (
 )
 from p2pmicrogrid_tpu.serve.router import FleetRouter, FleetSwapError
 from p2pmicrogrid_tpu.serve.wire import serve_mux_connection
+from p2pmicrogrid_tpu.telemetry.tracing import TRACE_HEADER, record_span
+from p2pmicrogrid_tpu.telemetry.tracing import decode as decode_trace
 
 
 class RouterProxy:
@@ -133,7 +135,7 @@ class RouterProxy:
 
     # -- routing -------------------------------------------------------------
 
-    async def _route(self, method: str, path: str, doc, token):
+    async def _route(self, method: str, path: str, doc, token, trace=None):
         if path == "/healthz":
             if method != "GET":
                 raise _HttpError(405, "GET only")
@@ -165,7 +167,7 @@ class RouterProxy:
         if path == "/v1/act":
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._act(doc, token)
+            return await self._act(doc, token, trace=trace)
         if path == "/admin/swap":
             if method != "POST":
                 raise _HttpError(405, "POST only")
@@ -181,8 +183,12 @@ class RouterProxy:
             return 200, outcome, []
         raise _HttpError(404, f"no route {path}")
 
-    async def _act(self, doc, token):
+    async def _act(self, doc, token, trace=None):
         self.stats["act_requests"] += 1
+        ctx = decode_trace(trace)
+        p_ctx = ctx.child("proxy.act") if ctx is not None else None
+        t0 = time.monotonic()
+        t0_epoch = time.time()
         if not isinstance(doc, dict):
             raise _HttpError(400, "body must be a JSON object")
         household = doc.get("household")
@@ -208,19 +214,33 @@ class RouterProxy:
                 f"{self.max_request_rows}-row request limit",
             )
         results = await asyncio.gather(*(
-            self.router.act(household, row, deadline_s=self.request_timeout_s)
-            for row in obs
+            self.router.act(
+                household, row, deadline_s=self.request_timeout_s,
+                trace=(p_ctx.child(f"row{i}") if p_ctx is not None else None),
+            )
+            for i, row in enumerate(obs)
         ))
         worst = next((r for r in results if not r.ok), None)
+
+        def finish(status: int):
+            if p_ctx is not None:
+                record_span(
+                    self.router.telemetry, p_ctx, "proxy.act",
+                    t0_epoch, time.monotonic() - t0,
+                    status=status, n_rows=len(obs), hop=ctx.hop,
+                )
+
         if worst is not None:
             extra = (
                 [("Retry-After", f"{worst.retry_after_s:g}")]
                 if worst.retry_after_s is not None else []
             )
             status = worst.status if worst.status > 0 else 502
+            finish(status)
             return status, {"error": worst.error or "replica failure"}, extra
         actions = [r.actions for r in results]
         self.stats["act_ok"] += 1
+        finish(200)
         return 200, {
             "actions": actions if batched else actions[0],
             "config_hash": results[0].config_hash,
@@ -229,7 +249,7 @@ class RouterProxy:
 
     # -- fronts --------------------------------------------------------------
 
-    async def _route_bytes(self, method, path, body: bytes, token):
+    async def _route_bytes(self, method, path, body: bytes, token, trace=None):
         import json as _json
 
         doc = None
@@ -240,7 +260,7 @@ class RouterProxy:
                 raise _HttpError(
                     400, f"body is not valid JSON: {err}"
                 ) from None
-        return await self._route(method, path, doc, token)
+        return await self._route(method, path, doc, token, trace=trace)
 
     async def _handle_http(self, reader, writer) -> None:
         self._conns.add(writer)
@@ -266,7 +286,8 @@ class RouterProxy:
                 self.stats["requests"] += 1
                 status, payload, extra = await route_safely(
                     self._route_bytes(
-                        method, path, body, bearer_token(headers)
+                        method, path, body, bearer_token(headers),
+                        trace=headers.get(TRACE_HEADER),
                     ),
                     self.stats,
                 )
@@ -286,10 +307,11 @@ class RouterProxy:
             except (ConnectionError, OSError):
                 pass
 
-    async def _mux_route(self, method, path, body_doc, token):
+    async def _mux_route(self, method, path, body_doc, token, trace=None):
         self.stats["requests"] += 1
         return await route_safely(
-            self._route(method, path, body_doc, token), self.stats
+            self._route(method, path, body_doc, token, trace=trace),
+            self.stats,
         )
 
     async def _handle_mux(self, reader, writer) -> None:

@@ -88,6 +88,11 @@ from p2pmicrogrid_tpu.serve.wire import (
     SyncMuxProbe,
     WireProtocolError,
 )
+from p2pmicrogrid_tpu.telemetry.tracing import (
+    TraceContext,
+    record_span,
+    root_context,
+)
 
 # WireProtocolError covers a peer answering malformed frames (version
 # skew, corruption): act() must score it as one failed request, never let
@@ -409,19 +414,24 @@ class FleetRouter:
             pools[rep.replica_id] = pool
         return pool
 
-    async def _post_act(self, rep: Replica, payload: dict, timeout_s: float):
+    async def _post_act(
+        self, rep: Replica, payload: dict, timeout_s: float,
+        trace: Optional[str] = None,
+    ):
         """(status, doc, headers) over the replica's preferred wire. Pool
         replay is OFF here: the router's own retry/failover loop is the
-        retry authority — the pool reconnects, the router re-sends."""
+        retry authority — the pool reconnects, the router re-sends.
+        ``trace`` is the encoded per-attempt trace context (mux frame
+        field / HTTP header)."""
         pool = self._pool_for(rep)
         if pool is not None:
             return await pool.request(
                 "/v1/act", payload, timeout_s, token=self.token,
-                replay=False,
+                replay=False, trace=trace,
             )
         return await _http_post_json(
             rep.host, rep.port, "/v1/act", payload, timeout_s,
-            ssl=self.ssl_context, token=self.token,
+            ssl=self.ssl_context, token=self.token, trace=trace,
         )
 
     async def close_pools(self) -> None:
@@ -734,15 +744,40 @@ class FleetRouter:
         household: Optional[str],
         obs_row,
         deadline_s: Optional[float] = None,
+        trace: Optional[TraceContext] = None,
     ) -> RouterResult:
         """Route one act request with retry/failover; never raises for
         server-side failure — the outcome (including router-side sheds)
-        comes back as a ``RouterResult``."""
+        comes back as a ``RouterResult``.
+
+        With a ``trace`` (telemetry/tracing.py ``TraceContext``), the
+        whole retry/failover anatomy becomes spans in the attached
+        telemetry's warehouse: one ``router.act`` root per request, a
+        ``router.attempt`` child per try (attrs: replica_id, status,
+        whether it was a failover hop) and a ``router.backoff`` child per
+        sleep — and each attempt's child context rides the wire, so the
+        server-side spans hang off the exact attempt that caused them."""
         policy = self.retry
         t0 = time.monotonic()
+        t0_epoch = time.time()
         deadline = t0 + (
             deadline_s if deadline_s is not None else policy.deadline_s
         )
+
+        def finish(result: RouterResult) -> RouterResult:
+            if trace is not None and self.telemetry is not None:
+                elapsed = time.monotonic() - t0
+                record_span(
+                    self.telemetry, trace, "router.act", t0_epoch, elapsed,
+                    status=result.status, retries=result.retries,
+                    failovers=result.failovers, replica_id=result.replica_id,
+                    household=household,
+                )
+                self.telemetry.histogram(
+                    "router.latency_ms", elapsed * 1e3,
+                    trace_id=trace.trace_id,
+                )
+            return result
         # host-sync: caller-supplied host observation row, not device data.
         payload = {"obs": np.asarray(obs_row, dtype=np.float32).tolist()}
         if household:
@@ -760,15 +795,16 @@ class FleetRouter:
                 rid = self.route(household, exclude=frozenset(exclude))
             except NoHealthyReplicas as err:
                 self._bump("shed")
-                return RouterResult(
+                return finish(RouterResult(
                     status=503, shed=True,
                     retry_after_s=self.shed_retry_after_s,
                     error=str(err), retries=tries, failovers=failovers,
-                )
-            if (
+                ))
+            was_failover = (
                 prev_rid is not None and rid != prev_rid
                 and prev_rid in exclude
-            ):
+            )
+            if was_failover:
                 # A failover is leaving a FAULTED replica — a 429 retry
                 # that round-robins (anonymous traffic) or re-routes is
                 # load balancing, not failover, and must not pollute the
@@ -779,19 +815,28 @@ class FleetRouter:
             timeout = max(0.05, min(
                 self.request_timeout_s, deadline - time.monotonic()
             ))
+            # Per-attempt child context: deterministic from the root +
+            # attempt index, encoded onto the wire so the replica's spans
+            # hang off THIS attempt (not the request in the abstract).
+            attempt_ctx = (
+                trace.child(f"attempt{tries}") if trace is not None else None
+            )
+            t_att = time.monotonic()
+            t_att_epoch = time.time()
             try:
                 status, doc, headers = await self._post_act(
-                    rep, payload, timeout
+                    rep, payload, timeout,
+                    trace=attempt_ctx.encode() if attempt_ctx else None,
                 )
             except FrameTooLarge as err:
                 # The REQUEST is over the wire cap — the mux mirror of an
                 # HTTP 413: terminal client error, no health penalty, no
                 # failover (the same payload would "fail" every replica
                 # in turn and read as a fleet outage).
-                return RouterResult(
+                return finish(RouterResult(
                     status=413, replica_id=rid, error=str(err),
                     retries=tries, failovers=failovers,
-                )
+                ))
             except _TRANSPORT_ERRORS as err:
                 status, doc, headers = -1, None, {}
                 transport_error = f"{type(err).__name__}: {err}"
@@ -802,28 +847,36 @@ class FleetRouter:
             if corrupt:
                 self._bump("corrupt_detected")
                 status = -1
+            if attempt_ctx is not None and self.telemetry is not None:
+                record_span(
+                    self.telemetry, attempt_ctx, "router.attempt",
+                    t_att_epoch, time.monotonic() - t_att,
+                    replica_id=rid, try_index=tries - 1, status=status,
+                    failover=was_failover,
+                    error=transport_error or None,
+                )
             if status == 200:
                 self.mark_result(rid, True)
                 self._record_route(household, rid)
-                return RouterResult(
+                return finish(RouterResult(
                     status=200,
                     actions=doc.get("actions"),
                     config_hash=doc.get("config_hash"),
                     replica_id=rid,
                     retries=tries - 1,
                     failovers=failovers,
-                )
+                ))
             if status in _TERMINAL_CLIENT_STATUSES:
                 # The REQUEST (or its credential) is bad, not the replica
                 # — retrying the same payload elsewhere cannot help, and
                 # auth rejections must never charge the retry budget.
                 if status in (401, 403):
                     self._bump("auth_denied")
-                return RouterResult(
+                return finish(RouterResult(
                     status=status, replica_id=rid,
                     error=(doc or {}).get("error"),
                     retries=tries - 1, failovers=failovers,
-                )
+                ))
             if status == -1 or status >= 500 or corrupt:
                 # Replica fault: feed health, fail over away from it for
                 # the remainder of this request.
@@ -844,13 +897,13 @@ class FleetRouter:
                 # into a retry storm. Shed at the router with Retry-After.
                 self._bump("budget_denied")
                 self._bump("shed")
-                return RouterResult(
+                return finish(RouterResult(
                     status=503, shed=True,
                     retry_after_s=self.shed_retry_after_s,
                     error="retry budget exhausted",
                     replica_id=rid, retries=tries - 1,
                     failovers=failovers, gave_up=True,
-                )
+                ))
             with self._lock:
                 backoff = policy.backoff_s(
                     tries - 1, self._rng, _retry_after_s(headers)
@@ -860,13 +913,19 @@ class FleetRouter:
             self._bump("retries")
             self._bump("backoff_ms", backoff * 1e3)
             await asyncio.sleep(backoff)
-        return RouterResult(
+            if trace is not None and self.telemetry is not None:
+                record_span(
+                    self.telemetry, trace.child(f"backoff{tries - 1}"),
+                    "router.backoff", time.time() - backoff, backoff,
+                    try_index=tries - 1,
+                )
+        return finish(RouterResult(
             status=status, replica_id=rid,
             error=(doc or {}).get("error") if isinstance(doc, dict) else None,
             retries=tries - 1, failovers=failovers,
             retry_after_s=_retry_after_s(headers),
             gave_up=tries > 1,
-        )
+        ))
 
     # -- fleet orchestration -------------------------------------------------
 
@@ -1566,9 +1625,16 @@ def run_fleet_loadgen(
     arrivals: np.ndarray,
     households: List[str],
     deadline_s: Optional[float] = None,
+    trace_seed: Optional[int] = None,
 ) -> FleetLoadgenResult:
     """The open-loop Poisson schedule fired through the ROUTER (retry,
-    failover and shed semantics included) instead of at one gateway."""
+    failover and shed semantics included) instead of at one gateway.
+
+    ``trace_seed`` (not None) traces every request: request ``i`` carries
+    ``root_context(trace_seed, i)`` through ``router.act`` — the router
+    records the root + attempt/backoff spans, the replicas their server
+    spans, and the warehouse stitches the cross-process tree back
+    together (``TRACE_TREE_SQL``)."""
     obs = np.asarray(obs, dtype=np.float32)  # host-sync: host-side inputs
     arrivals = np.asarray(arrivals, dtype=float)  # host-sync: host schedule
     n = int(arrivals.shape[0])
@@ -1587,7 +1653,11 @@ def run_fleet_loadgen(
             await asyncio.sleep(delay)
         t_send = time.perf_counter()
         result = await router.act(
-            households[i % len(households)], obs[i], deadline_s=deadline_s
+            households[i % len(households)], obs[i], deadline_s=deadline_s,
+            trace=(
+                root_context(trace_seed, i)
+                if trace_seed is not None else None
+            ),
         )
         latencies[i] = time.perf_counter() - t_send
         statuses[i] = result.status
@@ -1644,6 +1714,7 @@ def serve_bench_fleet(
     gateway_baseline: Optional[dict] = None,
     burst_factor: float = 1.0,
     burst_dwell_s: float = 0.25,
+    trace_seed: Optional[int] = None,
 ) -> List[dict]:
     """Fleet-level SLO benchmark: the serve-bench open-loop schedule
     through the router over a live fleet, optionally with a fault plan
@@ -1684,7 +1755,8 @@ def serve_bench_fleet(
         if schedule is not None:
             schedule.start()
         result = run_fleet_loadgen(
-            router, obs, arrivals, households, deadline_s=deadline_s
+            router, obs, arrivals, households, deadline_s=deadline_s,
+            trace_seed=trace_seed,
         )
         if schedule is not None:
             # Let a restart scheduled NEAR the run's end still apply (the
@@ -1862,6 +1934,7 @@ def serve_bench_fleet(
             "offered_rate_rps": rate_hz,
             "slo_ms": slo_ms,
             "burst_config": burst_config,
+            "trace_seed": trace_seed,
             **(extra_headline or {}),
         }
     )

@@ -18,8 +18,13 @@ Framing (the spec README documents):
   is a protocol error and kills the connection (the stream position past
   a bogus prefix is unknowable).
 * Request object:  ``{"id": int, "method": "POST", "path": "/v1/act",
-  "body": {...}, "token": "p2p1..."}`` — ``token`` optional, carries the
-  per-household bearer (serve/auth.py) when the gateway terminates trust.
+  "body": {...}, "token": "p2p1...", "trace": "<trace_id>-<span_id>-<hop>"}``
+  — ``token`` optional, carries the per-household bearer (serve/auth.py)
+  when the gateway terminates trust; ``trace`` optional, carries the
+  encoded distributed-trace context (telemetry/tracing.py — the mux
+  counterpart of the ``x-p2p-trace`` HTTP header). ``MuxPool`` replays
+  stamp the replayed frame with hop+1, so server spans distinguish the
+  original delivery from the post-reconnect one.
 * Response object: ``{"id": int, "status": int, "body": {...}}`` plus
   ``"retry_after_s"`` when the server sheds. ``id`` echoes the request.
 * A response whose ``body`` is not an object is a DETECTABLY corrupt
@@ -51,6 +56,7 @@ framing, fault-injection hooks and concurrent per-frame dispatch.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import json
 import time
 from typing import Callable, Dict, List, Optional
@@ -201,6 +207,7 @@ class MuxConnection:
         timeout_s: float,
         method: str = "POST",
         token: Optional[str] = None,
+        trace: Optional[str] = None,
     ):
         """(status, body doc | None-if-corrupt, headers-ish dict)."""
         if self.closed:
@@ -213,6 +220,8 @@ class MuxConnection:
             frame["body"] = body
         if token is not None:
             frame["token"] = token
+        if trace is not None:
+            frame["trace"] = trace
         encoded = encode_frame(frame)
         if len(encoded) > self.max_frame_bytes + _LEN_BYTES:
             # Refuse locally: an over-cap request would only earn a
@@ -340,6 +349,7 @@ class MuxPool:
         method: str = "POST",
         token: Optional[str] = None,
         replay: bool = True,
+        trace: Optional[str] = None,
     ):
         """(status, doc, headers) — see ``MuxConnection.request``."""
         deadline = time.monotonic() + timeout_s
@@ -355,7 +365,8 @@ class MuxPool:
             try:
                 conn = await self._conn_at(slot)
                 return await conn.request(
-                    path, body, remaining, method=method, token=token
+                    path, body, remaining, method=method, token=token,
+                    trace=trace,
                 )
             except FrameTooLarge:
                 # The REQUEST is over the cap — terminal, and the
@@ -383,6 +394,13 @@ class MuxPool:
                     raise
                 replayed = True
                 self.replays += 1
+                if trace is not None:
+                    # Same trace/span identity, one delivery later: the
+                    # server spans of the replayed hop must not be
+                    # mistaken for the original send's.
+                    from p2pmicrogrid_tpu.telemetry.tracing import bump_hop
+
+                    trace = bump_hop(trace)
 
     async def close(self) -> None:
         for i, conn in enumerate(self._conns):
@@ -535,9 +553,13 @@ async def serve_mux_connection(
 
     ``route(method, path, body_doc, token)`` is an awaitable returning
     ``(status, payload_dict, extra_headers)`` — the gateway and the router
-    proxy each bind their own. Every frame dispatches CONCURRENTLY (its
-    own task), responses interleave by id — the multiplexing. Protocol
-    errors answer one ``{"id": null, "status": 400}`` frame, then close.
+    proxy each bind their own. A route that also declares a ``trace``
+    parameter receives the frame's encoded trace context
+    (``trace=<str|None>``, telemetry/tracing.py); 4-arg routes keep
+    working untraced, so the wire upgrade never breaks a deployed
+    handler. Every frame dispatches CONCURRENTLY (its own task),
+    responses interleave by id — the multiplexing. Protocol errors
+    answer one ``{"id": null, "status": 400}`` frame, then close.
 
     ``fault_decide(scope)`` (serve/faults.py ``FaultInjector.decide``)
     applies the chaos kinds at the wire: stall delays the response, error
@@ -547,6 +569,13 @@ async def serve_mux_connection(
     """
     write_lock = asyncio.Lock()
     tasks: set = set()
+    # Signature sniff ONCE per connection, not per frame: trace-aware
+    # routes opt in by declaring the parameter; everything else (including
+    # the test suite's minimal 4-arg stubs) stays untraced.
+    try:
+        route_takes_trace = "trace" in inspect.signature(route).parameters
+    except (TypeError, ValueError):
+        route_takes_trace = False
 
     async def send(doc: dict) -> None:
         # A client that vanished mid-exchange (disconnect, drop-fault
@@ -561,7 +590,9 @@ async def serve_mux_connection(
         except (ConnectionError, OSError):
             pass
 
-    async def handle(rid: int, method: str, path: str, body, token) -> None:
+    async def handle(
+        rid: int, method: str, path: str, body, token, trace=None
+    ) -> None:
         fault = fault_decide(_mux_fault_scope(path)) if fault_decide else None
         if fault is not None:
             if on_fault is not None:
@@ -577,8 +608,15 @@ async def serve_mux_connection(
             await send({"id": rid, "status": 500,
                         "body": {"error": "injected fault"}})
             return
-        status, payload, extra = await route(method, path, body, token)
+        if route_takes_trace:
+            status, payload, extra = await route(
+                method, path, body, token, trace=trace
+            )
+        else:
+            status, payload, extra = await route(method, path, body, token)
         doc: dict = {"id": rid, "status": status, "body": payload}
+        if trace is not None:
+            doc["trace"] = trace  # echo: responses stay attributable
         for name, value in extra or ():
             if str(name).lower() == "retry-after":
                 try:
@@ -623,6 +661,11 @@ async def serve_mux_connection(
             path = frame.get("path")
             body = frame.get("body")
             token = frame.get("token")
+            # Tolerant by design: a malformed trace field downgrades the
+            # request to untraced, it never fails the frame.
+            trace = frame.get("trace")
+            if not isinstance(trace, str):
+                trace = None
             if not isinstance(path, str):
                 await send({"id": rid, "status": 400,
                             "body": {"error": "frame carries no path"}})
@@ -636,7 +679,7 @@ async def serve_mux_connection(
                             "body": {"error": "token must be a string"}})
                 continue
             task = asyncio.ensure_future(
-                handle(rid, str(method).upper(), path, body, token)
+                handle(rid, str(method).upper(), path, body, token, trace)
             )
             tasks.add(task)
             task.add_done_callback(tasks.discard)

@@ -39,6 +39,15 @@ from p2pmicrogrid_tpu.telemetry.registry import (
     set_current,
 )
 from p2pmicrogrid_tpu.telemetry.spans import Span, SpanRecorder
+from p2pmicrogrid_tpu.telemetry.tracing import (
+    TRACE_HEADER,
+    TraceContext,
+    bump_hop,
+    new_span_id,
+    record_span,
+    root_context,
+)
+from p2pmicrogrid_tpu.telemetry.tracing import decode as decode_trace
 
 __all__ = [
     "AsyncDrain",
@@ -70,4 +79,11 @@ __all__ = [
     "set_current",
     "Span",
     "SpanRecorder",
+    "TRACE_HEADER",
+    "TraceContext",
+    "bump_hop",
+    "decode_trace",
+    "new_span_id",
+    "record_span",
+    "root_context",
 ]

@@ -141,6 +141,8 @@ class SqliteSink:
         self._seq = 0
         self._span_seq = 0
         self._points: list = []
+        self._trace_seq = 0
+        self._trace_rows: list = []
         self._registered = False
 
     # -- wiring -------------------------------------------------------------
@@ -222,6 +224,38 @@ class SqliteSink:
             # typed explosion — storing the blob too would duplicate every
             # aggregate as one unqueryable attrs_json row.
             return
+        if record.get("kind") == "trace_span":
+            # Distributed-trace spans (telemetry/tracing.py) land in the
+            # dedicated trace_spans table: trace/span/parent ids and the
+            # epoch start become real columns (TRACE_TREE_SQL filters and
+            # time-orders on them), not attrs_json payload.
+            rec = dict(record)
+            rec.pop("ts", None)
+            rec.pop("kind", None)
+            row = (
+                self._run_id or "run", self._trace_seq,
+                str(rec.pop("trace_id", "")), str(rec.pop("span_id", "")),
+                rec.pop("parent_span_id", None), str(rec.pop("name", "")),
+                rec.pop("start_ts", None), rec.pop("duration_s", None),
+                rec.pop("process", None), _dumps(rec) if rec else None,
+            )
+            with self._lock:
+                self._trace_rows.append(row)
+                self._trace_seq += 1
+                if len(self._trace_rows) >= self.batch:
+                    try:
+                        self._flush_locked()
+                    except Exception as err:  # noqa: BLE001 — mirror emit()
+                        self._points = []
+                        self._trace_rows = []
+                        if not getattr(self, "_flush_warned", False):
+                            self._flush_warned = True
+                            print(
+                                f"SqliteSink: dropping telemetry points "
+                                f"({type(err).__name__}: {err})",
+                                file=sys.stderr,
+                            )
+            return
         ts, kind, name, value, attrs = self._point_of(record)
         with self._lock:
             self._points.append(
@@ -250,18 +284,45 @@ class SqliteSink:
     def _flush_locked(self) -> None:
         if not self._registered:
             self._write_run_row()
-        if not self._points:
+        if not self._points and not self._trace_rows:
             return
+        points = self._points
+        if points:
+            # Ingest-lag gauge (ROADMAP item 4): the oldest event in this
+            # batch waited (commit time - event ts) to become queryable —
+            # the staleness bound every warehouse reader (the canary's
+            # per-stage attribution above all) actually sees. Recorded as
+            # one extra point per flush, directly (not via emit: that
+            # would re-enter the buffer this flush is draining). Kind
+            # "sink_gauge", not "gauge": sink-internal health points must
+            # not inflate a run's user-gauge counts/rollups.
+            batch_ts = [p[2] for p in points if p[2] is not None]
+            if batch_ts:
+                now = time.time()
+                lag_ms = max(0.0, (now - min(batch_ts)) * 1e3)
+                points = points + [(
+                    self._run_id or "run", self._seq, round(now, 3),
+                    "sink_gauge", "telemetry.ingest_lag_ms", round(lag_ms, 3),
+                    None,
+                )]
+                self._seq += 1
         con = self._connect()
         with con:
             # Plain INSERT: a (run_id, seq) collision means two runs share an
             # id — raising (surfaced as the one-time drop warning in emit)
             # beats OR REPLACE silently interleaving their rows.
-            con.executemany(
-                "INSERT INTO telemetry_points VALUES (?,?,?,?,?,?,?)",
-                self._points,
-            )
+            if points:
+                con.executemany(
+                    "INSERT INTO telemetry_points VALUES (?,?,?,?,?,?,?)",
+                    points,
+                )
+            if self._trace_rows:
+                con.executemany(
+                    "INSERT INTO trace_spans VALUES (?,?,?,?,?,?,?,?,?,?)",
+                    self._trace_rows,
+                )
         self._points = []
+        self._trace_rows = []
 
     # -- close-time aggregates (called by Telemetry.close) -------------------
 
@@ -277,6 +338,17 @@ class SqliteSink:
                 {"ts": ts, "kind": "histogram", "name": name,
                  "value": stats.get("p50"), **stats}
             )
+        for name, per_bucket in summary.get("exemplars", {}).items():
+            # One point per (histogram, log2 bucket) exemplar: value is the
+            # bucket's max sample, attrs carry the trace_id it links to —
+            # SLOWEST_TRACES_SQL orders these by value to answer
+            # ``telemetry-query --slowest``.
+            for bucket, ex in per_bucket.items():
+                self.emit(
+                    {"ts": ts, "kind": "hist_exemplar", "name": name,
+                     "value": ex.get("value"), "bucket": bucket,
+                     "trace_id": ex.get("trace_id")}
+                )
 
     def write_spans(self, recorder) -> None:
         """Persist every completed span (``spans.SpanRecorder``)."""
@@ -506,6 +578,9 @@ class Telemetry:
         self._counters: dict = {}
         self._gauges: dict = {}
         self._hists: dict = {}
+        # {hist name: {log2 bucket: (max value, trace_id)}} — distributed-
+        # trace exemplars attached via histogram(..., trace_id=...).
+        self._exemplars: dict = {}
         self._closed = False
         # Identity-aware sinks (SqliteSink) bind to the run manifest here so
         # their warehouse rows carry config_hash/git_rev from the start.
@@ -566,8 +641,20 @@ class Telemetry:
     def gauge(self, name: str, value) -> None:
         self._gauges[name] = value
 
-    def histogram(self, name: str, value) -> None:
-        self._hists.setdefault(name, []).append(float(value))
+    def histogram(self, name: str, value, trace_id: Optional[str] = None) -> None:
+        value = float(value)
+        self._hists.setdefault(name, []).append(value)
+        if trace_id is not None:
+            # One exemplar per log2 latency bucket: the max-value sample's
+            # trace_id, so each bucket of the final distribution — the p99
+            # bucket above all — links to a REAL trace
+            # (``telemetry-query --slowest``). Bucket by magnitude, not
+            # rank: percentiles shift as samples arrive, bucket edges don't.
+            bucket = 0 if value < 1.0 else int(value).bit_length()
+            per_bucket = self._exemplars.setdefault(name, {})
+            prev = per_bucket.get(bucket)
+            if prev is None or value > prev[0]:
+                per_bucket[bucket] = (value, str(trace_id))
 
     @property
     def counters(self) -> dict:
@@ -637,6 +724,13 @@ class Telemetry:
             "counters": {k: float(v) for k, v in self._counters.items()},
             "gauges": {k: float(v) for k, v in self._gauges.items()},
             "histograms": {k: self._hist_stats(v) for k, v in self._hists.items()},
+            "exemplars": {
+                name: {
+                    str(bucket): {"value": v, "trace_id": tid}
+                    for bucket, (v, tid) in sorted(per.items())
+                }
+                for name, per in self._exemplars.items()
+            },
             "spans": self.spans.totals(),
         }
 

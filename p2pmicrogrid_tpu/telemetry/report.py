@@ -307,3 +307,234 @@ def compare_runs(dir_a: str, dir_b: str) -> str:
         fmt=lambda s: s.get("total_s") if isinstance(s, dict) else s,
     )
     return "\n".join(parts) + "\n"
+
+# -- distributed-trace analysis -----------------------------------------------
+#
+# The span-dict shape below is what ``ResultsStore.query_trace_tree``
+# returns: trace_id / span_id / parent_span_id / name / ts (epoch seconds) /
+# duration_s / process / attrs (parsed attrs_json) / run_id.
+
+_ROOT_SPAN_PREFERENCE = ("client.request", "router.act", "proxy.act")
+
+
+def _span_index(spans):
+    by_id = {}
+    for s in spans:
+        sid = s.get("span_id")
+        if sid and sid not in by_id:
+            by_id[sid] = s
+    return by_id
+
+
+def _find_root(spans):
+    """The span whose duration is the request's wall time: the outermost
+    recorded observer (client > router > proxy), falling back to the
+    longest span — a partial tree (a killed process never flushed its
+    root) still decomposes against the best cover we have."""
+    for name in _ROOT_SPAN_PREFERENCE:
+        named = [s for s in spans if s.get("name") == name]
+        if named:
+            return max(named, key=lambda s: s.get("duration_s") or 0.0)
+    return max(spans, key=lambda s: s.get("duration_s") or 0.0)
+
+
+def _descends_from(span, ancestor_id, by_id, _limit=64):
+    sid = span.get("parent_span_id")
+    for _ in range(_limit):
+        if sid is None:
+            return False
+        if sid == ancestor_id:
+            return True
+        parent = by_id.get(sid)
+        sid = parent.get("parent_span_id") if parent else None
+    return False
+
+
+def trace_critical_path(spans) -> Optional[dict]:
+    """Decompose ONE trace's end-to-end wall time into additive segments:
+
+    ``retry_ms``    backoff sleeps + every FAILED attempt's wall time
+    ``queue_wait_ms`` enqueue->dispatch coalescing wait (winning attempt)
+    ``padding_ms``  the padded-lane share of engine execution
+    ``execute_ms``  engine execution net of padding
+    ``wire_ms``     the remainder: serialization, sockets, framing, auth
+
+    The segments sum to ``total_ms`` (the root span's duration) by
+    construction — wire is computed as the remainder, clamped at zero —
+    so per-segment attribution is exact against the measured latency, not
+    a sum of possibly-overlapping child spans."""
+    spans = [s for s in spans if s.get("duration_s") is not None]
+    if not spans:
+        return None
+    by_id = _span_index(spans)
+    root = _find_root(spans)
+    total_ms = (root.get("duration_s") or 0.0) * 1e3
+
+    attempts = [s for s in spans if s.get("name") == "router.attempt"]
+    failed = [
+        s for s in attempts
+        if (s.get("attrs") or {}).get("status") != 200
+    ]
+    backoffs = [s for s in spans if s.get("name") == "router.backoff"]
+    retry_ms = sum(s["duration_s"] for s in failed + backoffs) * 1e3
+
+    winners = [
+        s for s in attempts if (s.get("attrs") or {}).get("status") == 200
+    ]
+    win_id = winners[-1]["span_id"] if winners else None
+
+    def on_winning_path(span):
+        # No router in the tree (single-process gateway trace): every
+        # queue/engine span is on the one path there is.
+        if win_id is None:
+            return not any(
+                _descends_from(span, f["span_id"], by_id) for f in failed
+            )
+        return _descends_from(span, win_id, by_id)
+
+    queue_wait_ms = sum(
+        s["duration_s"] for s in spans
+        if s.get("name") == "queue.wait" and on_winning_path(s)
+    ) * 1e3
+    executes = [
+        s for s in spans
+        if s.get("name") == "engine.execute" and on_winning_path(s)
+    ]
+    execute_raw_ms = sum(s["duration_s"] for s in executes) * 1e3
+    padding_ms = sum(
+        s["duration_s"]
+        * (s.get("attrs") or {}).get("padded_rows", 0)
+        / max(1, (s.get("attrs") or {}).get("bucket", 1))
+        for s in executes
+    ) * 1e3
+    wire_ms = max(
+        0.0, total_ms - retry_ms - queue_wait_ms - execute_raw_ms
+    )
+    return {
+        "trace_id": root.get("trace_id"),
+        "root": root.get("name"),
+        "total_ms": round(total_ms, 3),
+        "wire_ms": round(wire_ms, 3),
+        "queue_wait_ms": round(queue_wait_ms, 3),
+        "padding_ms": round(padding_ms, 3),
+        "execute_ms": round(execute_raw_ms - padding_ms, 3),
+        "retry_ms": round(retry_ms, 3),
+        "n_spans": len(spans),
+        "n_processes": len({s.get("process") for s in spans
+                            if s.get("process")}),
+    }
+
+
+def aggregate_critical_paths(trees) -> dict:
+    """Percentile critical paths over many traces: sort by each tree's
+    root duration, pick the p50/p95/p99 exemplar trace, decompose it.
+    ``trees`` is a list of span lists (one per trace)."""
+    decomposed = [
+        cp for cp in (trace_critical_path(t) for t in trees) if cp
+    ]
+    decomposed.sort(key=lambda cp: cp["total_ms"])
+    out = {"n_traces": len(decomposed)}
+    if not decomposed:
+        return out
+    for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+        idx = min(len(decomposed) - 1, int(q * (len(decomposed) - 1) + 0.5))
+        out[label] = decomposed[idx]
+    return out
+
+
+def render_trace_tree(spans) -> str:
+    """Plain-text tree of one trace: indentation by parent chain, per-span
+    duration, process and the attrs that matter for triage."""
+    spans = sorted(
+        [s for s in spans if s.get("span_id")],
+        key=lambda s: (s.get("ts") or 0.0),
+    )
+    if not spans:
+        return "(no spans)"
+    by_id = _span_index(spans)
+    children: dict = {}
+    roots = []
+    for s in spans:
+        pid = s.get("parent_span_id")
+        if pid and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    lines = [f"trace {spans[0].get('trace_id')} — {len(spans)} span(s), "
+             f"{len({s.get('process') for s in spans if s.get('process')})} "
+             f"process(es)"]
+
+    def walk(span, depth):
+        attrs = span.get("attrs") or {}
+        keep = {
+            k: v for k, v in attrs.items()
+            if k in ("replica_id", "status", "failover", "try_index",
+                     "bucket", "padded_rows", "batch_size", "linked",
+                     "estimated", "retries", "failovers", "household", "hop")
+            and v is not None
+        }
+        extra = (
+            " " + " ".join(f"{k}={v}" for k, v in sorted(keep.items()))
+            if keep else ""
+        )
+        dur = span.get("duration_s")
+        lines.append(
+            f"{'  ' * depth}{span.get('name')}  "
+            f"[{(dur or 0.0) * 1e3:.2f} ms]"
+            f"  @{span.get('process') or '?'}{extra}"
+        )
+        for child in sorted(
+            children.get(span.get("span_id"), []),
+            key=lambda s: (s.get("ts") or 0.0),
+        ):
+            walk(child, depth + 1)
+
+    for root in roots:
+        walk(root, 1)
+    return "\n".join(lines)
+
+
+def chrome_trace_export(spans) -> dict:
+    """Merged Chrome-trace (Perfetto-loadable) JSON for ONE distributed
+    trace: every process in the tree becomes its own pid lane, spans
+    become complete ("X") events on per-span tids so concurrent children
+    never visually occlude each other. Timestamps are rebased to the
+    earliest span (microseconds), so cross-process clock offsets read as
+    honest skew rather than hiding it."""
+    spans = [s for s in spans if s.get("duration_s") is not None]
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t_min = min(s.get("ts") or 0.0 for s in spans)
+    procs = sorted({s.get("process") or "?" for s in spans})
+    pid_of = {p: i for i, p in enumerate(procs)}
+    events = [
+        {
+            "ph": "M", "name": "process_name", "pid": pid_of[p], "tid": 0,
+            "args": {"name": p},
+        }
+        for p in procs
+    ]
+    lane: dict = {}
+    for s in sorted(spans, key=lambda s: (s.get("ts") or 0.0)):
+        pid = pid_of[s.get("process") or "?"]
+        tid = lane.get(pid, 0)
+        lane[pid] = tid + 1
+        args = dict(s.get("attrs") or {})
+        args["span_id"] = s.get("span_id")
+        if s.get("parent_span_id"):
+            args["parent_span_id"] = s["parent_span_id"]
+        events.append({
+            "ph": "X",
+            "name": s.get("name") or "span",
+            "cat": "trace",
+            "pid": pid,
+            "tid": tid,
+            "ts": round(((s.get("ts") or 0.0) - t_min) * 1e6, 1),
+            "dur": round(s["duration_s"] * 1e6, 1),
+            "args": args,
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": spans[0].get("trace_id")},
+    }

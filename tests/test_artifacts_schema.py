@@ -433,3 +433,80 @@ class TestAutopilotChecker:
         )
         problems = checker.check_all(str(tmp_path))
         assert any("autopilot_cycle" in p for p in problems)
+
+
+class TestTraceChecker:
+    """TRACE_*.jsonl (ISSUE 16): tree-complete, >= 3 processes, additive
+    critical path, headline-last."""
+
+    def _capture(self, *, total=100.0, wire=35.0, n_processes=3,
+                 orphan=False, headline_last=True):
+        spans = [
+            {"span_id": "a" * 16, "parent_span_id": None,
+             "name": "router.act", "process": "router:1",
+             "ts": 0.0, "duration_ms": total},
+            {"span_id": "b" * 16,
+             "parent_span_id": ("x" * 16 if orphan else "a" * 16),
+             "name": "router.attempt", "process": "gateway:2",
+             "ts": 0.001, "duration_ms": 60.0},
+        ]
+        tree = {"kind": "trace_tree", "trace_id": "t" * 32,
+                "n_spans": len(spans), "n_processes": n_processes,
+                "tree_complete": not orphan, "failover": True,
+                "spans": spans}
+        headline = {
+            "metric": "serve_bench_trace", "value": total, "unit": "ms",
+            "vs_baseline": 1.0, "trace_id": "t" * 32,
+            "tree_complete": not orphan, "failover": True,
+            "n_processes": n_processes, "measured_ms": total,
+            "critical_path": {
+                "total_ms": total, "wire_ms": wire, "queue_wait_ms": 10.0,
+                "padding_ms": 10.0, "execute_ms": 10.0, "retry_ms": 35.0,
+            },
+        }
+        rows = [tree, headline] if headline_last else [headline, tree]
+        return "\n".join(json.dumps(r) for r in rows) + "\n"
+
+    def _check(self, checker, tmp_path, text):
+        path = tmp_path / "TRACE_r99.jsonl"
+        path.write_text(text)
+        problems = []
+        checker.check_trace_jsonl(str(path), problems)
+        return problems
+
+    def test_good_capture_passes(self, checker, tmp_path):
+        assert self._check(checker, tmp_path, self._capture()) == []
+
+    def test_segment_drift_flagged(self, checker, tmp_path):
+        problems = self._check(
+            checker, tmp_path, self._capture(wire=80.0)
+        )
+        assert any("segments sum" in p for p in problems)
+
+    def test_too_few_processes_flagged(self, checker, tmp_path):
+        problems = self._check(
+            checker, tmp_path, self._capture(n_processes=2)
+        )
+        assert any(">= 3" in p for p in problems)
+
+    def test_orphan_span_flagged(self, checker, tmp_path):
+        problems = self._check(
+            checker, tmp_path, self._capture(orphan=True)
+        )
+        assert any("orphan" in p for p in problems)
+        assert any("incomplete" in p for p in problems)
+
+    def test_headline_must_be_last(self, checker, tmp_path):
+        problems = self._check(
+            checker, tmp_path, self._capture(headline_last=False)
+        )
+        assert any("LAST row" in p for p in problems)
+
+    def test_check_all_scans_trace_captures(self, checker, tmp_path):
+        art = tmp_path / "artifacts"
+        art.mkdir()
+        (art / "TRACE_r99.jsonl").write_text(
+            self._capture(n_processes=1)
+        )
+        problems = checker.check_all(str(tmp_path))
+        assert any(">= 3" in p for p in problems)

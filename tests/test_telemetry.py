@@ -1074,3 +1074,58 @@ class TestServeCompaction:
             "telemetry-query", "--results-db", str(tmp_path / "nope.db"),
             "--compact",
         ]) == 1
+
+
+class TestIngestLagGauge:
+    def test_flush_records_sink_gauge_and_fleet_view_surfaces_it(
+        self, tmp_path
+    ):
+        """Every SqliteSink flush records the oldest buffered event's
+        commit lag as ``telemetry.ingest_lag_ms`` — kind ``sink_gauge``,
+        NOT ``gauge``, so sink-internal health never inflates a run's
+        user-gauge counts — and the fleet view surfaces the worst lag
+        per config."""
+        import sqlite3
+
+        from p2pmicrogrid_tpu.data import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import (
+            SqliteSink,
+            Telemetry,
+            run_manifest,
+        )
+
+        db = str(tmp_path / "results.db")
+        tel = Telemetry(
+            run_id="lag-test",
+            sinks=[SqliteSink(db)],
+            manifest=run_manifest(
+                extra={"config_hash": "cfg-lag", "serve_role": "router"}
+            ),
+        )
+        tel.gauge("user.gauge", 1.0)
+        tel.event("noise")
+        tel.close()
+
+        con = sqlite3.connect(db)
+        try:
+            rows = con.execute(
+                "SELECT kind, name, value FROM telemetry_points "
+                "WHERE kind IN ('gauge', 'sink_gauge')"
+            ).fetchall()
+        finally:
+            con.close()
+        lags = [r for r in rows if r[0] == "sink_gauge"]
+        assert lags and all(
+            r[1] == "telemetry.ingest_lag_ms" and r[2] >= 0.0 for r in lags
+        )
+        # The user-gauge count is untouched by the sink's own point.
+        assert sum(1 for r in rows if r[0] == "gauge") == 1
+
+        store = ResultsStore(db)
+        try:
+            fleet = store.query_fleet_view()
+        finally:
+            store.close()
+        assert len(fleet) == 1
+        assert fleet[0]["ingest_lag_ms"] is not None
+        assert fleet[0]["ingest_lag_ms"] >= 0.0

@@ -1,0 +1,521 @@
+"""Fleet-wide distributed tracing (ISSUE 16).
+
+Acceptance for the tracing tier: deterministic trace/span ids from the
+loadgen seed, a tolerant wire encoding shared by the ``x-p2p-trace``
+header and the mux frame's ``trace`` field (with ``MuxPool`` replays
+bumping the hop counter), spans landing in the warehouse's
+``trace_spans`` table and stitching back into cross-process trees, an
+additive critical-path decomposition whose segments sum to the root
+span's measured wall time, and — slow tier — one SIGKILL chaos run whose
+victim's requests reconstruct as a SINGLE tree spanning >= 3 processes
+including the failover hop. Tracing off must stay off: no ``--trace``,
+no ``trace_span`` rows.
+"""
+
+import asyncio
+import itertools
+import json
+import sqlite3
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.config import SimConfig, TrainConfig, default_config
+from p2pmicrogrid_tpu.serve import export_policy_bundle
+from p2pmicrogrid_tpu.serve.wire import MuxPool, serve_mux_connection
+from p2pmicrogrid_tpu.telemetry.report import (
+    aggregate_critical_paths,
+    chrome_trace_export,
+    render_trace_tree,
+    trace_critical_path,
+)
+from p2pmicrogrid_tpu.telemetry.tracing import (
+    TRACE_HEADER,
+    TraceContext,
+    bump_hop,
+    decode,
+    new_span_id,
+    record_span,
+    root_context,
+)
+from p2pmicrogrid_tpu.train import init_policy_state
+
+A = 3
+
+
+def _make_bundle(tmp_path, seed, name):
+    cfg = default_config(
+        sim=SimConfig(n_agents=A),
+        train=TrainConfig(implementation="tabular", seed=seed),
+    )
+    ps = init_policy_state(cfg, jax.random.PRNGKey(seed))
+    ps = ps._replace(
+        q_table=jax.random.normal(
+            jax.random.PRNGKey(seed + 1), ps.q_table.shape
+        )
+    )
+    return export_policy_bundle(cfg, ps, str(tmp_path / name))
+
+
+class TestTraceContext:
+    def test_root_context_deterministic(self):
+        a = root_context(7, 3)
+        assert a == root_context(7, 3)
+        assert a.trace_id != root_context(7, 4).trace_id
+        assert a.trace_id != root_context(8, 3).trace_id
+        assert len(a.trace_id) == 32 and len(a.span_id) == 16
+        assert a.parent_span_id is None and a.hop == 0
+
+    def test_encode_decode_round_trip(self):
+        ctx = root_context(0, 0).with_hop(2)
+        back = decode(ctx.encode())
+        assert back is not None
+        assert (back.trace_id, back.span_id, back.hop) == (
+            ctx.trace_id, ctx.span_id, 2
+        )
+        # The receiver does not know the sender's parent linkage.
+        assert back.parent_span_id is None
+
+    def test_child_is_deterministic_and_parented(self):
+        ctx = root_context(1, 1)
+        c1 = ctx.child("router.attempt0")
+        assert c1 == ctx.child("router.attempt0")
+        assert c1 != ctx.child("router.attempt1")
+        assert c1.parent_span_id == ctx.span_id
+        assert c1.trace_id == ctx.trace_id
+        # Grandchild chains keep linking.
+        g = c1.child("queue.wait")
+        assert g.parent_span_id == c1.span_id
+
+    def test_bump_hop(self):
+        ctx = root_context(0, 0)
+        bumped = decode(bump_hop(ctx.encode()))
+        assert bumped.hop == ctx.hop + 1
+        assert (bumped.trace_id, bumped.span_id) == (
+            ctx.trace_id, ctx.span_id
+        )
+        # Malformed input passes through unchanged, never raises.
+        assert bump_hop("not-a-trace") == "not-a-trace"
+
+    @pytest.mark.parametrize("garbage", [
+        None, 7, "", "a-b", "a-b-c-d", "x" * 32 + "-" + "y" * 16 + "-00",
+        "0" * 31 + "-" + "0" * 16 + "-00",
+    ])
+    def test_decode_garbage_is_none(self, garbage):
+        assert decode(garbage) is None
+
+    def test_new_span_id_shape(self):
+        ids = {new_span_id() for _ in range(32)}
+        assert len(ids) == 32
+        assert all(len(i) == 16 for i in ids)
+
+    def test_record_span_is_noop_without_telemetry_or_context(self):
+        record_span(None, root_context(0, 0), "x", 0.0, 0.0)
+        record_span(object(), None, "x", 0.0, 0.0)  # would raise if used
+
+
+class TestWarehouseTraceTree:
+    def test_spans_round_trip_into_tree(self, tmp_path):
+        from p2pmicrogrid_tpu.data import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        db = str(tmp_path / "results.db")
+        tel = Telemetry(run_id="trace-test", sinks=[SqliteSink(db)])
+        root = root_context(3, 0)
+        t0 = time.time()
+        record_span(tel, root, "router.act", t0, 0.1, retries=0)
+        att = root.child("router.attempt0")
+        record_span(tel, att, "router.attempt", t0 + 0.001, 0.08,
+                    replica_id="replica-0", status=200)
+        record_span(tel, att.child("queue.wait"), "queue.wait",
+                    t0 + 0.002, 0.01)
+        tel.close()
+
+        store = ResultsStore(db)
+        try:
+            spans = store.query_trace_tree(root.trace_id)
+        finally:
+            store.close()
+        assert [s["name"] for s in spans] == [
+            "router.act", "router.attempt", "queue.wait"
+        ]
+        by_id = {s["span_id"]: s for s in spans}
+        assert spans[0]["parent_span_id"] is None
+        assert by_id[att.span_id]["parent_span_id"] == root.span_id
+        assert by_id[att.span_id]["attrs"]["replica_id"] == "replica-0"
+        # Every span's process label landed (one Perfetto lane per process).
+        assert all(s["process"] for s in spans)
+
+    def test_histogram_exemplars_link_slowest_traces(self, tmp_path):
+        from p2pmicrogrid_tpu.data import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        db = str(tmp_path / "results.db")
+        tel = Telemetry(run_id="exemplar-test", sinks=[SqliteSink(db)])
+        for i, v in enumerate([2.0, 900.0, 40.0]):
+            tel.histogram(
+                "router.latency_ms", v,
+                trace_id=root_context(0, i).trace_id,
+            )
+        tel.close()
+        store = ResultsStore(db)
+        try:
+            rows = store.query_slowest_traces(2)
+        finally:
+            store.close()
+        assert rows, "exemplars should surface"
+        assert rows[0]["latency_ms"] == 900.0
+        assert rows[0]["trace_id"] == root_context(0, 1).trace_id
+
+
+class TestCriticalPath:
+    def _failover_spans(self):
+        """A synthetic failover tree: one failed attempt + backoff, a
+        winning attempt with queue/execute children (half-padded lane),
+        100 ms end to end."""
+        root = root_context(5, 0)
+        a0 = root.child("router.attempt0")
+        bk = root.child("router.backoff0")
+        a1 = root.child("router.attempt1")
+        qw = a1.child("queue.wait")
+        ex = a1.child("engine.execute")
+
+        def span(ctx, name, ts, dur, process, **attrs):
+            return {
+                "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+                "parent_span_id": ctx.parent_span_id, "name": name,
+                "ts": ts, "duration_s": dur, "process": process,
+                "attrs": attrs,
+            }
+
+        return [
+            span(root, "router.act", 0.0, 0.100, "router:1", retries=1),
+            span(a0, "router.attempt", 0.0, 0.030, "router:1",
+                 replica_id="replica-0", status=503),
+            span(bk, "router.backoff", 0.030, 0.005, "router:1"),
+            span(a1, "router.attempt", 0.035, 0.060, "router:1",
+                 replica_id="replica-1", status=200, failover=True),
+            span(qw, "queue.wait", 0.036, 0.010, "gateway:2"),
+            span(ex, "engine.execute", 0.046, 0.020, "gateway:2",
+                 bucket=8, padded_rows=4, batch_size=4),
+        ]
+
+    def test_segments_sum_to_total(self):
+        cp = trace_critical_path(self._failover_spans())
+        assert cp["root"] == "router.act"
+        assert cp["total_ms"] == pytest.approx(100.0)
+        # Failed attempt (30) + backoff (5).
+        assert cp["retry_ms"] == pytest.approx(35.0)
+        assert cp["queue_wait_ms"] == pytest.approx(10.0)
+        # 20 ms execute, half the lanes padding.
+        assert cp["padding_ms"] == pytest.approx(10.0)
+        assert cp["execute_ms"] == pytest.approx(10.0)
+        segments = (cp["wire_ms"] + cp["queue_wait_ms"] + cp["padding_ms"]
+                    + cp["execute_ms"] + cp["retry_ms"])
+        assert segments == pytest.approx(cp["total_ms"], rel=1e-6)
+        assert cp["n_processes"] == 2
+
+    def test_losing_attempts_queue_time_not_charged(self):
+        """queue/execute under the FAILED attempt count as retry, not as
+        queue-wait — only the winning path's spans decompose."""
+        spans = self._failover_spans()
+        a0_id = spans[1]["span_id"]
+        spans.append({
+            "trace_id": spans[0]["trace_id"], "span_id": "f" * 16,
+            "parent_span_id": a0_id, "name": "queue.wait",
+            "ts": 0.001, "duration_s": 0.025, "process": "gateway:3",
+            "attrs": {},
+        })
+        cp = trace_critical_path(spans)
+        assert cp["queue_wait_ms"] == pytest.approx(10.0)  # unchanged
+
+    def test_aggregate_picks_percentile_exemplars(self):
+        trees = []
+        for i in range(10):
+            root = root_context(9, i)
+            trees.append([{
+                "trace_id": root.trace_id, "span_id": root.span_id,
+                "parent_span_id": None, "name": "router.act",
+                "ts": 0.0, "duration_s": 0.01 * (i + 1),
+                "process": "router:1", "attrs": {},
+            }])
+        agg = aggregate_critical_paths(trees)
+        assert agg["n_traces"] == 10
+        assert agg["p50"]["total_ms"] < agg["p95"]["total_ms"]
+        assert agg["p99"]["total_ms"] == pytest.approx(100.0)
+
+    def test_render_tree_text(self):
+        text = render_trace_tree(self._failover_spans())
+        assert "router.act" in text and "engine.execute" in text
+        assert "2 process(es)" in text
+        assert "replica_id=replica-1" in text
+        # Children indent under their parents.
+        lines = text.splitlines()
+        act = next(l for l in lines if "router.act" in l)
+        qw = next(l for l in lines if "queue.wait" in l)
+        assert len(qw) - len(qw.lstrip()) > len(act) - len(act.lstrip())
+
+    def test_chrome_trace_export_lanes(self):
+        doc = chrome_trace_export(self._failover_spans())
+        events = doc["traceEvents"]
+        meta = [e for e in events if e["ph"] == "M"]
+        complete = [e for e in events if e["ph"] == "X"]
+        assert {m["args"]["name"] for m in meta} == {"router:1", "gateway:2"}
+        assert len(complete) == 6
+        assert min(e["ts"] for e in complete) == 0.0  # rebased
+        assert doc["otherData"]["trace_id"] == self._failover_spans()[0][
+            "trace_id"
+        ]
+
+
+class TestMuxTracePropagation:
+    def test_trace_field_reaches_route_and_replay_bumps_hop(self):
+        """One mux request through a server that drops the FIRST
+        connection cold: the pool replays on a fresh connection and the
+        route sees the SAME trace identity one hop later."""
+        seen = []
+        conn_no = itertools.count()
+
+        async def route(method, path, body, token, trace=None):
+            seen.append(trace)
+            return 200, {"ok": True}, []
+
+        async def handler(r, w):
+            if next(conn_no) == 0:
+                w.close()  # cold drop: client replays
+                return
+            try:
+                await serve_mux_connection(r, w, route)
+            finally:
+                w.close()
+
+        ctx = root_context(2, 0)
+
+        async def run():
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            pool = MuxPool("127.0.0.1", port, size=1)
+            try:
+                status, doc, _ = await pool.request(
+                    "/v1/act", {"x": 1}, 5.0, trace=ctx.encode()
+                )
+            finally:
+                await pool.close()
+                server.close()
+                await server.wait_closed()
+            return status, pool.replays
+
+        status, replays = asyncio.run(run())
+        assert status == 200 and replays == 1
+        assert len(seen) == 1
+        delivered = decode(seen[0])
+        assert (delivered.trace_id, delivered.span_id) == (
+            ctx.trace_id, ctx.span_id
+        )
+        assert delivered.hop == 1  # the replay, not the original send
+
+    def test_untraced_route_stub_keeps_working(self):
+        """A deployed 4-arg route (no ``trace`` parameter) still serves
+        traced frames — the wire upgrade never breaks a handler."""
+        async def route(method, path, body, token):
+            return 200, {"ok": True}, []
+
+        async def handler(r, w):
+            try:
+                await serve_mux_connection(r, w, route)
+            finally:
+                w.close()
+
+        async def run():
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            pool = MuxPool("127.0.0.1", port, size=1)
+            try:
+                return await pool.request(
+                    "/v1/act", {}, 5.0,
+                    trace=root_context(0, 0).encode(),
+                )
+            finally:
+                await pool.close()
+                server.close()
+                await server.wait_closed()
+
+        status, doc, _ = asyncio.run(run())
+        assert status == 200
+
+
+class TestServeBenchTraceInProcess:
+    """The fast (in-process LocalFleet) capture path: serve-bench --fleet
+    --trace emits a stitched tree + an additive critical-path headline,
+    ids are deterministic from --bench-seed, and WITHOUT --trace the
+    warehouse stays span-free."""
+
+    def test_trace_headline_and_tree(self, tmp_path, capfd):
+        from p2pmicrogrid_tpu import cli
+
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        db = str(tmp_path / "results.db")
+        rc = cli.main([
+            "serve-bench", "--fleet", "--trace",
+            "--bundle", bundle, "--replicas", "2",
+            "--requests", "32", "--rate", "64",
+            "--bench-seed", "7",
+            "--agents", str(A), "--results-db", db,
+        ])
+        assert rc == 0
+        lines = [
+            json.loads(l)
+            for l in capfd.readouterr().out.splitlines()
+            if l.strip().startswith("{")
+        ]
+        tree = next(r for r in lines if r.get("kind") == "trace_tree")
+        headline = next(
+            r for r in lines if r.get("metric") == "serve_bench_trace"
+        )
+        # The stitched tree is complete: every parent id resolves.
+        assert tree["tree_complete"] is True
+        assert tree["n_spans"] >= 5
+        names = {s["name"] for s in tree["spans"]}
+        assert {"router.act", "router.attempt", "gateway.act",
+                "queue.wait", "engine.execute"} <= names
+        # Deterministic ids: the exemplar trace is one of the seeded
+        # roots, byte-identical across replays of this schedule.
+        expected = {root_context(7, i).trace_id for i in range(32)}
+        assert tree["trace_id"] in expected
+        # Additive decomposition against the measured root latency.
+        cp = headline["critical_path"]
+        segments = (cp["wire_ms"] + cp["queue_wait_ms"] + cp["padding_ms"]
+                    + cp["execute_ms"] + cp["retry_ms"])
+        assert segments == pytest.approx(cp["total_ms"], rel=0.05)
+        assert headline["critical_path_percentiles"]["n_traces"] == 32
+        # The warehouse answers for every request traced.
+        con = sqlite3.connect(db)
+        try:
+            n_traces = con.execute(
+                "SELECT COUNT(DISTINCT trace_id) FROM trace_spans"
+            ).fetchone()[0]
+        finally:
+            con.close()
+        assert n_traces == 32
+
+    def test_trace_off_means_no_spans(self, tmp_path, capfd):
+        from p2pmicrogrid_tpu import cli
+
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        db = str(tmp_path / "results.db")
+        rc = cli.main([
+            "serve-bench", "--fleet",
+            "--bundle", bundle, "--replicas", "2",
+            "--requests", "32", "--rate", "64",
+            "--agents", str(A), "--results-db", db,
+        ])
+        assert rc == 0
+        out = capfd.readouterr().out
+        assert "serve_bench_trace" not in out
+        con = sqlite3.connect(db)
+        try:
+            n = con.execute("SELECT COUNT(*) FROM trace_spans").fetchone()[0]
+        finally:
+            con.close()
+        assert n == 0
+
+    def test_telemetry_query_renders_tree(self, tmp_path, capfd):
+        from p2pmicrogrid_tpu import cli
+        from p2pmicrogrid_tpu.data import ResultsStore
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        db = str(tmp_path / "results.db")
+        tel = Telemetry(run_id="q-test", sinks=[SqliteSink(db)])
+        root = root_context(0, 0)
+        t0 = time.time()
+        record_span(tel, root, "router.act", t0, 0.05)
+        record_span(tel, root.child("router.attempt0"), "router.attempt",
+                    t0, 0.04, replica_id="replica-0", status=200)
+        tel.histogram("router.latency_ms", 50.0, trace_id=root.trace_id)
+        tel.close()
+
+        rc = cli.main(["telemetry-query", "--results-db", db,
+                       "--trace", root.trace_id])
+        assert rc == 0
+        out = capfd.readouterr().out
+        assert "router.act" in out and "critical_path" in out
+
+        rc = cli.main(["telemetry-query", "--results-db", db,
+                       "--slowest", "1"])
+        assert rc == 0
+        rows = [json.loads(l)
+                for l in capfd.readouterr().out.splitlines()
+                if l.strip().startswith("{")]
+        assert rows and rows[0]["trace_id"] == root.trace_id
+
+        # Satellite: the merged Perfetto export over the same warehouse.
+        out_path = tmp_path / "trace.json"
+        rc = cli.main(["telemetry-report", "--perfetto", root.trace_id,
+                       "--trace-db", db, "--out", str(out_path)])
+        assert rc == 0
+        doc = json.loads(out_path.read_text())
+        assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+
+@pytest.mark.slow
+class TestProcessChaosTraceEndToEnd:
+    def test_sigkilled_replica_requests_stitch_across_processes(
+        self, tmp_path, capfd
+    ):
+        """The TRACE_r16 capture path end to end: real subprocess
+        replicas, one SIGKILLed mid-run, --trace on — at least one
+        request reconstructs as a SINGLE tree spanning >= 3 processes
+        (router + two replicas via the failover hop), and the p99
+        critical-path segments sum to the measured latency within 5%."""
+        from p2pmicrogrid_tpu import cli
+
+        bundle = _make_bundle(tmp_path, 0, "b1")
+        db = str(tmp_path / "results.db")
+        rc = cli.main([
+            "serve-bench", "--fleet", "--process", "--chaos", "--trace",
+            "--bundle", bundle,
+            "--replicas", "2",
+            "--requests", "192", "--rate", "64",
+            # The kill must land AFTER the trace-stall window drains
+            # (stall [0.3, 0.6) + 0.8 s hold -> victim-side spans flush
+            # by ~1.4 s): an earlier SIGKILL loses the victim's half of
+            # the failover trees this capture exists to stitch.
+            "--kill-at", "1.8", "--restart-at", "3.5",
+            "--bench-seed", "0",
+            "--agents", str(A), "--results-db", db,
+        ])
+        assert rc == 0
+        lines = [
+            json.loads(l)
+            for l in capfd.readouterr().out.splitlines()
+            if l.strip().startswith("{")
+        ]
+        tree = next(r for r in lines if r.get("kind") == "trace_tree")
+        headline = next(
+            r for r in lines if r.get("metric") == "serve_bench_trace"
+        )
+        assert headline["tree_complete"] is True
+        assert headline["n_processes"] >= 3
+        assert headline["failover"] is True
+        # The failover hop is IN the tree: two distinct replica_ids
+        # under one root.
+        assert tree["trace_id"] == headline["trace_id"]
+        cp = headline["critical_path"]
+        segments = (cp["wire_ms"] + cp["queue_wait_ms"] + cp["padding_ms"]
+                    + cp["execute_ms"] + cp["retry_ms"])
+        assert segments == pytest.approx(cp["total_ms"], rel=0.05)
+        assert headline["measured_ms"] == pytest.approx(
+            cp["total_ms"], rel=0.05
+        )
+        # Deterministic roots under the fixed seed.
+        expected = {root_context(0, i).trace_id for i in range(192)}
+        assert tree["trace_id"] in expected
+        # The tree reconstructs from the warehouse too, not just the
+        # capture: telemetry-query --trace renders it.
+        rc = cli.main(["telemetry-query", "--results-db", db,
+                       "--trace", tree["trace_id"]])
+        assert rc == 0
+        out = capfd.readouterr().out
+        assert "router.attempt" in out

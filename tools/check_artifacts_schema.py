@@ -518,6 +518,99 @@ def check_fleet_proc_jsonl(path: str, problems: list) -> None:
         )
 
 
+# Distributed-trace captures (serve-bench --fleet --trace, ISSUE 16):
+# the committed tree must actually be the cross-process failover tree the
+# capture promises — complete (every parent id resolves), spanning >= 3
+# processes, with an ADDITIVE critical path (segments sum to the root's
+# measured wall time within 5%) and the serve_bench_trace headline LAST,
+# so downstream tail-parsers read the decomposition, not a mid-run row.
+TRACE_SEGMENT_KEYS = (
+    "wire_ms", "queue_wait_ms", "padding_ms", "execute_ms", "retry_ms",
+)
+
+
+def check_trace_jsonl(path: str, problems: list) -> None:
+    where = os.path.relpath(path)
+    rows = list(_iter_jsonl_rows(path, problems))
+    trees = [(r, w) for r, w in rows
+             if isinstance(r, dict) and r.get("kind") == "trace_tree"]
+    headlines = [(r, w) for r, w in rows
+                 if isinstance(r, dict)
+                 and r.get("metric") == "serve_bench_trace"]
+    for row, w in rows:
+        if isinstance(row, dict) and row.get("kind") == "trace_tree":
+            continue  # span rows are not metric rows
+        check_metric_row(row, w, problems)
+    if not trees:
+        problems.append(f"{where}: no trace_tree row")
+    if not headlines:
+        problems.append(f"{where}: no serve_bench_trace headline row")
+        return
+    headline, hw = headlines[-1]
+    if rows and rows[-1][0] is not headline:
+        problems.append(
+            f"{where}: serve_bench_trace headline must be the LAST row"
+        )
+    _require_bool(headline, ("tree_complete", "failover"), hw, problems,
+                  "serve_bench_trace")
+    if headline.get("tree_complete") is False:
+        problems.append(f"{hw}: committed trace tree is incomplete")
+    n_proc = headline.get("n_processes")
+    if not isinstance(n_proc, (int, float)) or isinstance(n_proc, bool):
+        problems.append(
+            f"{hw}: serve_bench_trace missing numeric 'n_processes'"
+        )
+    elif n_proc < 3:
+        problems.append(
+            f"{hw}: trace spans {n_proc} process(es); the capture "
+            "contract is >= 3 (router + both failover replicas)"
+        )
+    cp = headline.get("critical_path")
+    if not isinstance(cp, dict):
+        problems.append(
+            f"{hw}: serve_bench_trace missing 'critical_path' object"
+        )
+    else:
+        _require_numeric(cp, TRACE_SEGMENT_KEYS + ("total_ms",),
+                         hw, problems, "critical_path")
+        total = cp.get("total_ms")
+        segments = [cp.get(k) for k in TRACE_SEGMENT_KEYS]
+        if (
+            isinstance(total, (int, float)) and not isinstance(total, bool)
+            and total > 0
+            and all(isinstance(s, (int, float)) and not isinstance(s, bool)
+                    for s in segments)
+        ):
+            drift = abs(sum(segments) - total) / total
+            if drift > 0.05:
+                problems.append(
+                    f"{hw}: critical-path segments sum to "
+                    f"{sum(segments):.3f} ms vs total {total:.3f} ms "
+                    f"({drift:.1%} off; contract is 5%)"
+                )
+    for tree, tw in trees:
+        spans = tree.get("spans")
+        if not isinstance(spans, list) or not spans:
+            problems.append(f"{tw}: trace_tree row has no spans")
+            continue
+        ids = {s.get("span_id") for s in spans if isinstance(s, dict)}
+        for s in spans:
+            if not isinstance(s, dict) or not s.get("span_id"):
+                problems.append(f"{tw}: span without span_id")
+                continue
+            parent = s.get("parent_span_id")
+            if parent is not None and parent not in ids:
+                problems.append(
+                    f"{tw}: span {s['span_id']} parent {parent} not in "
+                    "the tree (orphan — the stitch is incomplete)"
+                )
+    tree_ids = {t.get("trace_id") for t, _ in trees}
+    if headline.get("trace_id") not in tree_ids:
+        problems.append(
+            f"{hw}: headline trace_id has no matching trace_tree row"
+        )
+
+
 # Private-key refusal: committed captures may carry certs for provenance,
 # but key MATERIAL in the repo is a credential leak no matter how "test"
 # it looks. artifacts/tls/ is the designated LOCAL scratch
@@ -1080,9 +1173,10 @@ def check_run_dir(run_dir: str, problems: list) -> None:
 # Keep in sync with p2pmicrogrid_tpu/data/results.py:TELEMETRY_SCHEMA_VERSION
 # (hardcoded so this tool stays stdlib-only and runs without the package).
 # v1 = warehouse tables; v2 added export_leases (the export/retention
-# handshake). A v1 DB is still valid — it migrates in place on its next
-# write (data/results.ensure_telemetry_schema) — so both verify.
-ACCEPTED_TELEMETRY_SCHEMA_VERSIONS = (1, 2)
+# handshake); v3 added trace_spans (distributed-trace trees). An older DB
+# is still valid — it migrates in place on its next write
+# (data/results.ensure_telemetry_schema) — so all three verify.
+ACCEPTED_TELEMETRY_SCHEMA_VERSIONS = (1, 2, 3)
 
 _TELEMETRY_TABLES = ("telemetry_runs", "telemetry_points", "telemetry_spans")
 
@@ -1190,6 +1284,10 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
         check_fleet_jsonl(path, problems)
     for path in sorted(fleet_proc_jsonl):
         check_fleet_proc_jsonl(path, problems)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "TRACE_*.jsonl"))
+    ):
+        check_trace_jsonl(path, problems)
     check_no_private_keys(repo_root, problems)
     for path in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "RESILIENCE_*.jsonl"))

@@ -96,6 +96,10 @@ HOT_PATH_FILES = (
     os.path.join("p2pmicrogrid_tpu", "regimes", "train.py"),
     os.path.join("p2pmicrogrid_tpu", "regimes", "evaluate.py"),
     os.path.join("p2pmicrogrid_tpu", "telemetry", "async_drain.py"),
+    # Trace-context propagation (ISSUE 16) runs per request on every
+    # serving hot path above — the module must stay stdlib-only and
+    # readback-free, or tracing taxes the very latencies it attributes.
+    os.path.join("p2pmicrogrid_tpu", "telemetry", "tracing.py"),
 )
 
 ANNOTATION = "host-sync:"
