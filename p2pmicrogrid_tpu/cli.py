@@ -1902,6 +1902,62 @@ def cmd_serve_bench(args) -> int:
                 emit=sink.emit,
             )
             return 0
+        if getattr(args, "fleet", False) and getattr(args, "population", None):
+            # Million-household scale tier (scale/bench.py): the virtual-
+            # clock fleet bench — synthetic Zipf x rate-class population,
+            # real consistent-hash placement, real plan_open_loop dispatch
+            # per replica over a MEASURED per-bucket engine service model,
+            # real per-replica warehouse shard ingest. Socket mode cannot
+            # offer 100k+ rps from one host; this path measures the same
+            # policies at the population the fleet is sized for
+            # (SCALE_*.jsonl captures).
+            from p2pmicrogrid_tpu.scale import (
+                Population,
+                PopulationConfig,
+                serve_bench_scale,
+            )
+
+            engine = PolicyEngine(
+                bundle_dir=bundle, max_batch=args.max_batch,
+                device=getattr(args, "serve_device", "auto"),
+            )
+            pop = Population(PopulationConfig(
+                n_households=args.population,
+                seed=args.bench_seed,
+                zipf_s=getattr(args, "population_zipf_s", 0.6),
+                churn=getattr(args, "population_churn", 0.02),
+            ))
+            replica_counts = [
+                int(r) for r in args.scaling_replicas.split(",") if r
+            ]
+            serve_bench_scale(
+                engine=engine,
+                population=pop,
+                rate_hz=args.rate,
+                duration_s=getattr(args, "duration_s", 15.0),
+                replica_counts=replica_counts,
+                vnodes=getattr(args, "vnodes", 4096),
+                max_wait_s=args.max_wait_ms / 1e3,
+                max_slots=getattr(args, "max_sessions", 256),
+                results_db=args.results_db,
+                seed=args.bench_seed,
+                emit=sink.emit,
+                extra_headline={
+                    "config_hash": engine.manifest.get("config_hash"),
+                    "implementation": engine.manifest.get(
+                        "implementation"
+                    ),
+                    "n_agents": engine.n_agents,
+                },
+            )
+            return 0
+        if getattr(args, "population", None):
+            print(
+                "--population needs --fleet (the scale tier benches the "
+                "fleet serving path)",
+                file=sys.stderr,
+            )
+            return 2
         if getattr(args, "fleet", False):
             # Fleet mode: N gateway replicas behind the consistent-hash
             # router, the open-loop schedule fired THROUGH the router
@@ -2057,6 +2113,7 @@ def cmd_serve_bench(args) -> int:
                     serve_device=getattr(args, "serve_device", "auto"),
                     batching=getattr(args, "batching", "micro"),
                     max_slots=getattr(args, "max_sessions", 256),
+                    shard_warehouse=getattr(args, "shard_warehouse", False),
                 )
                 fleet.start()
                 # The bit-exactness comparator lives in THIS process: the
@@ -2084,6 +2141,7 @@ def cmd_serve_bench(args) -> int:
                     authenticator=authenticator,
                     batching=getattr(args, "batching", "micro"),
                     max_slots=getattr(args, "max_sessions", 256),
+                    shard_warehouse=getattr(args, "shard_warehouse", False),
                 )
                 fleet.start()
                 reference = fleet.reference_engine()
@@ -2454,6 +2512,7 @@ def cmd_serve_gateway(args) -> int:
         ),
         batching=getattr(args, "batching", "micro"),
         max_slots=getattr(args, "max_sessions", 256),
+        shard_id=getattr(args, "shard_id", None),
         host=args.host,
         port=args.port,
         mux_port=getattr(args, "mux_port", None),
@@ -2616,6 +2675,25 @@ def cmd_serve_router(args) -> int:
         # wildcard (it probes /stats and pushes /admin/swap).
         router_token = authenticator.mint("*")
 
+    router_tel = None
+    if getattr(args, "results_db", None):
+        # The standalone proxy binds its OWN warehouse shard (ROADMAP
+        # item 4): at fleet scale the router's per-request counters and
+        # fleet_stats events must not contend on a replica's WAL file.
+        from p2pmicrogrid_tpu.telemetry import (
+            SqliteSink,
+            Telemetry,
+            run_manifest,
+        )
+        from p2pmicrogrid_tpu.telemetry.registry import run_stamp
+
+        shard_id = getattr(args, "shard_id", None) or "router"
+        router_tel = Telemetry(
+            run_id=f"serve-router-{run_stamp()}",
+            sinks=[SqliteSink(args.results_db, shard_id=shard_id)],
+            manifest=run_manifest(extra={"serve_role": "router"}),
+        )
+
     router = FleetRouter(
         replicas,
         retry=RetryPolicy(
@@ -2624,6 +2702,7 @@ def cmd_serve_router(args) -> int:
         ),
         ssl_context=backend_ssl,
         token=router_token,
+        telemetry=router_tel,
     )
     proxy = RouterProxy(
         router, host=args.host, port=args.port,
@@ -2670,6 +2749,8 @@ def cmd_serve_router(args) -> int:
         with open(args.stats_out, "w") as f:
             json.dump(router.fleet_stats(), f, indent=2)
         print(f"serve-router: stats -> {args.stats_out}", file=sys.stderr)
+    if router_tel is not None:
+        router_tel.close()
     return 0
 
 
@@ -2940,6 +3021,7 @@ def cmd_promote(args) -> int:
                     [r for r in args.regimes.split(",") if r]
                     if getattr(args, "regimes", None) else None
                 ),
+                batching=getattr(args, "batching", "continuous"),
             )
             emit({
                 "metric": "promotion_case",
@@ -3386,12 +3468,38 @@ def cmd_telemetry_query(args) -> int:
     streams new/updated rows as they land (tail mode). Output: one JSON
     object per row (machine-greppable, like the bench suites).
     """
+    import os
     import sqlite3
 
     from p2pmicrogrid_tpu.data.results import (
         TELEMETRY_JOIN_SQL,
         TELEMETRY_SCHEMA_VERSION,
     )
+
+    shards = list(getattr(args, "shards", None) or [])
+    if not shards and not args.results_db:
+        print(
+            "pass --results-db and/or at least one --shard",
+            file=sys.stderr,
+        )
+        return 2
+    if shards and getattr(args, "compact", False):
+        print(
+            "--compact and --shard cannot combine: compaction rewrites "
+            "ONE real warehouse in place, but the federated view is an "
+            "in-memory merge — compact each shard's --results-db "
+            "directly",
+            file=sys.stderr,
+        )
+        return 2
+    if shards and getattr(args, "watch", False):
+        print(
+            "--watch and --shard cannot combine: the federated view is "
+            "a point-in-time merge, so a tail over it would never see "
+            "new rows — watch one shard, or re-run the merge",
+            file=sys.stderr,
+        )
+        return 2
 
     if getattr(args, "compact", False):
         # Retention pass (the ONE write mode this command has): roll
@@ -3433,13 +3541,49 @@ def cmd_telemetry_query(args) -> int:
         finally:
             con.close()
 
-    # Read-only open: querying must never create a DB, run migrations, or
-    # let --sql mutate the warehouse.
-    try:
-        con = sqlite3.connect(f"file:{args.results_db}?mode=ro", uri=True)
-    except sqlite3.Error as err:
-        print(f"cannot open {args.results_db}: {err}", file=sys.stderr)
-        return 1
+    if shards:
+        # Federated view: merge every shard (plus --results-db when also
+        # given) into an in-memory warehouse and run the SAME view SQL
+        # against it. All warehouse tables carry natural primary keys, so
+        # the INSERT OR IGNORE merge is idempotent — the federated rows
+        # are identical to what one funnel DB would hold (regression-
+        # tested in tests/test_scale.py), and the source files are opened
+        # read-only and never touched.
+        from p2pmicrogrid_tpu.data.results import merge_warehouse_shards
+
+        sources = (
+            [args.results_db] if args.results_db else []
+        ) + shards
+        missing = [s for s in sources if not os.path.exists(s)]
+        if missing:
+            print(
+                f"no such shard file(s): {', '.join(missing)}",
+                file=sys.stderr,
+            )
+            return 1
+        con = sqlite3.connect(":memory:")
+        try:
+            merge_stats = merge_warehouse_shards(con, sources)
+        except sqlite3.Error as err:
+            print(f"shard merge failed: {err}", file=sys.stderr)
+            con.close()
+            return 1
+        print(
+            json.dumps({"federated": merge_stats, "sources": sources}),
+            file=sys.stderr,
+        )
+    else:
+        # Read-only open: querying must never create a DB, run
+        # migrations, or let --sql mutate the warehouse.
+        try:
+            con = sqlite3.connect(
+                f"file:{args.results_db}?mode=ro", uri=True
+            )
+        except sqlite3.Error as err:
+            print(
+                f"cannot open {args.results_db}: {err}", file=sys.stderr
+            )
+            return 1
 
     def select(sql, params=()):
         cur = con.execute(sql, params)
@@ -4130,6 +4274,41 @@ def main(argv=None) -> int:
                         "availability/failover/retry SLOs (FLEET_*.jsonl)")
     p.add_argument("--replicas", type=int, default=3,
                    help="--fleet: gateway replica count (default 3)")
+    p.add_argument("--population", type=int, default=None,
+                   help="million-household scale tier: synthetic "
+                        "population size. With --fleet, switches to the "
+                        "virtual-clock scale bench (scale/bench.py) — "
+                        "Zipf x rate-class household arrivals, real "
+                        "consistent-hash placement, measured per-bucket "
+                        "engine service model, per-replica warehouse "
+                        "shard ingest (SCALE_*.jsonl captures)")
+    p.add_argument("--scaling-replicas", dest="scaling_replicas",
+                   default="3,10,30",
+                   help="--population: comma-separated replica counts for "
+                        "the scaling sweep; the LARGEST is the headline "
+                        "(default 3,10,30)")
+    p.add_argument("--population-zipf-s", type=float, default=0.6,
+                   dest="population_zipf_s",
+                   help="--population: popularity skew exponent "
+                        "(default 0.6; 0 = uniform)")
+    p.add_argument("--population-churn", type=float, default=0.02,
+                   dest="population_churn",
+                   help="--population: fraction of requests from cold "
+                        "uniform households (default 0.02)")
+    p.add_argument("--vnodes", type=int, default=4096,
+                   help="--population: consistent-hash virtual nodes per "
+                        "replica for the scale sweep (default 4096 — "
+                        "spread tightens as 1/sqrt(vnodes))")
+    p.add_argument("--duration-s", type=float, default=15.0,
+                   dest="duration_s",
+                   help="--population: virtual-clock schedule length in "
+                        "seconds; requests = rate x duration (default 15)")
+    p.add_argument("--shard-warehouse", action="store_true",
+                   dest="shard_warehouse",
+                   help="--fleet: one WAL-mode SQLite warehouse shard per "
+                        "replica next to --results-db (replica telemetry "
+                        "fans out instead of funneling into one writer); "
+                        "federate with telemetry-query --shard")
     p.add_argument("--chaos", action="store_true",
                    help="--fleet: apply the default deterministic fault "
                         "plan — kill one replica at 30%% of the run, "
@@ -4290,6 +4469,12 @@ def main(argv=None) -> int:
     p.add_argument("--replica-id", dest="replica_id",
                    help="this replica's fleet identity (rides /readyz, "
                         "/stats and the fault injector's coins)")
+    p.add_argument("--shard-id", dest="shard_id",
+                   help="warehouse shard identity: bind this replica's "
+                        "telemetry to its own --results-db file (one "
+                        "WAL-mode shard per replica; the process-fleet "
+                        "supervisor passes it under --shard-warehouse, "
+                        "and telemetry-query --shard federates the set)")
     p.add_argument("--restarts", type=_nonneg_int, default=0,
                    help="relaunch count (the process-fleet supervisor "
                         "passes it so fleet stats attribute churn)")
@@ -4491,6 +4676,12 @@ def main(argv=None) -> int:
                    dest="max_regime_regression",
                    help="gate: scale-free per-regime regression tolerance "
                         "for --regimes (default 0 — any regression blocks)")
+    p.add_argument("--batching", choices=["micro", "continuous"],
+                   default="continuous",
+                   help="canary gateway queue front (default continuous "
+                        "— bit-exact vs micro for the stateless bundles "
+                        "promotion serves; pass micro to reproduce the "
+                        "pre-scale-tier coalescing queue)")
     p.set_defaults(fn=cmd_promote)
 
     p = sub.add_parser(
@@ -4685,6 +4876,15 @@ def main(argv=None) -> int:
                    help="fleet secret: verify household tokens at the "
                         "proxy and mint the router's wildcard credential "
                         "toward the replicas")
+    p.add_argument("--results-db", dest="results_db",
+                   help="bind router telemetry (fleet_stats events, "
+                        "router counters) to this SQLite warehouse")
+    p.add_argument("--shard-id", dest="shard_id",
+                   help="warehouse shard identity for the router's own "
+                        "telemetry rows (default 'router'); under a "
+                        "sharded fleet, point --results-db at the "
+                        "router's OWN shard file so the proxy never "
+                        "contends with replica writers")
     p.add_argument("--retry-attempts", type=int, default=5,
                    dest="retry_attempts",
                    help="router retry policy: max attempts per request "
@@ -4711,7 +4911,19 @@ def main(argv=None) -> int:
              "config-hash join of telemetry runs to eval runs, one JSON "
              "object per row; --sql runs arbitrary SQL",
     )
-    p.add_argument("--results-db", required=True)
+    p.add_argument("--results-db", required=False,
+                   help="warehouse DB; optional when --shard files are "
+                        "given (the federated view is built from the "
+                        "shards alone)")
+    p.add_argument("--shard", action="append", dest="shards",
+                   metavar="DB", default=None,
+                   help="per-replica warehouse shard file; repeat per "
+                        "shard. The shards (plus --results-db when also "
+                        "given) are merged into an in-memory warehouse "
+                        "first, so every view federates the whole fleet "
+                        "— same rows as if all replicas had written one "
+                        "DB. Incompatible with --compact (compaction "
+                        "must rewrite a real shard in place)")
     p.add_argument("--sql",
                    help="run this SQL instead of the default join "
                         "(tables: telemetry_runs, telemetry_points, "

@@ -15,6 +15,7 @@ The loggers accept numpy arrays straight from the simulator's ``SlotOutputs``
 from __future__ import annotations
 
 import json
+import os
 import sqlite3
 import time as _time
 from typing import Optional, Sequence
@@ -579,6 +580,83 @@ def ensure_telemetry_schema(con: sqlite3.Connection) -> int:
         con.execute(f"PRAGMA user_version = {TELEMETRY_SCHEMA_VERSION}")
     con.commit()
     return TELEMETRY_SCHEMA_VERSION
+
+
+# --- per-replica warehouse shards (ROADMAP item 4) ---------------------------
+#
+# At fleet scale every per-request `serve_request`/`serve_decision` row
+# funneling into ONE SQLite file is the first thing to fall over (the
+# per-sink `telemetry.ingest_lag_ms` gauge is the meter). The scale tier
+# instead binds one WAL-mode shard per replica
+# (`SqliteSink(path, shard_id=...)`, `LocalFleet(shard_warehouse=True)`)
+# and federates them at read time: `merge_warehouse_shards` unions shard
+# tables into one DB, and `telemetry-query --shard A --shard B` runs the
+# fleet/continuous/promotion views over the merged set — row-identical to
+# the same traffic funneled into a single DB (tests/test_scale.py).
+
+#: Warehouse tables a shard merge copies, in FK-safe order. Every one
+#: carries a natural primary key (run_id / (run_id, seq) / lease_id /
+#: (setting, implementation, is_testing)), which is what makes the merge
+#: idempotent under INSERT OR IGNORE.
+SHARD_MERGE_TABLES = (
+    "telemetry_runs",
+    "telemetry_points",
+    "telemetry_spans",
+    "trace_spans",
+    "eval_runs",
+    "export_leases",
+)
+
+
+def shard_db_path(results_db: str, shard: str) -> str:
+    """The per-replica shard file for a base warehouse path: sibling files
+    ``<stem>.shard-<shard><ext>`` so a shard set globs/sorts together next
+    to the base DB it federates into."""
+    stem, ext = os.path.splitext(results_db)
+    return f"{stem}.shard-{shard}{ext}"
+
+
+def merge_warehouse_shards(con: sqlite3.Connection, shard_paths) -> dict:
+    """Federate per-replica warehouse shards into ``con``.
+
+    Each shard is ATTACHed and its warehouse tables are unioned into the
+    destination with ``INSERT OR IGNORE`` keyed on the tables' natural
+    primary keys — run ids are unique per sink run, so distinct replicas
+    never collide, and the merge is IDEMPOTENT: merging a shard twice, or
+    merging shards in any order, yields the same row set. A shard from a
+    SIGKILLed replica merges cleanly too: SQLite transactions are atomic,
+    so a torn last batch is simply absent — the committed prefix merges
+    and the federated view stays consistent (never a half-row).
+
+    Returns per-table inserted-row counts plus the shard count. Shards
+    missing a table (older schema, empty sink) contribute nothing for it.
+    """
+    ensure_telemetry_schema(con)
+    stats = {t: 0 for t in SHARD_MERGE_TABLES}
+    stats["shards"] = 0
+    for path in shard_paths:
+        con.execute("ATTACH DATABASE ? AS _shard", (str(path),))
+        try:
+            have = {
+                r[0]
+                for r in con.execute(
+                    "SELECT name FROM _shard.sqlite_master "
+                    "WHERE type = 'table'"
+                )
+            }
+            with con:
+                for table in SHARD_MERGE_TABLES:
+                    if table not in have:
+                        continue
+                    cur = con.execute(
+                        f"INSERT OR IGNORE INTO main.{table} "
+                        f"SELECT * FROM _shard.{table}"
+                    )
+                    stats[table] += cur.rowcount
+            stats["shards"] += 1
+        finally:
+            con.execute("DETACH DATABASE _shard")
+    return stats
 
 
 # One fleet view over per-replica serving runs (serve/router.py): every

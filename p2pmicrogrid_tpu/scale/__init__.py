@@ -1,0 +1,44 @@
+"""Million-household scale tier (ROADMAP item 4).
+
+The serving stack's correctness story was proven at tens of households
+per replica; this package is where the same stack is exercised — and
+audited — at a MILLION household ids:
+
+* ``population``: a deterministic synthetic household population —
+  stable ids over a seeded 1M-id space, Zipf-skewed request mix shaped
+  by per-household rate classes, join/leave churn — usable as a drop-in
+  arrival source for the fleet loadgen and the virtual-clock scale
+  bench.
+* ``bench``: the virtual-clock fleet bench behind ``serve-bench --fleet
+  --population``: real per-replica ``plan_open_loop`` dispatch over a
+  measured engine service model, real consistent-hash ring placement,
+  real per-replica SQLite shard ingest — sustained rps/replica, p99 and
+  warehouse ingest lag at 1M households plus the replica-scaling rows.
+* ``audit``: structural O(1)-per-request audits of the router, registry
+  and session ring — the checks that nothing on the request path (or in
+  a stats snapshot) iterates or materializes the household id space.
+"""
+
+from p2pmicrogrid_tpu.scale.audit import (
+    audit_registry_scalability,
+    audit_ring_scalability,
+    audit_router_scalability,
+    run_scale_audit,
+)
+from p2pmicrogrid_tpu.scale.bench import serve_bench_scale
+from p2pmicrogrid_tpu.scale.population import (
+    Population,
+    PopulationConfig,
+    RATE_CLASSES,
+)
+
+__all__ = [
+    "Population",
+    "PopulationConfig",
+    "RATE_CLASSES",
+    "serve_bench_scale",
+    "audit_registry_scalability",
+    "audit_ring_scalability",
+    "audit_router_scalability",
+    "run_scale_audit",
+]

@@ -1,0 +1,421 @@
+"""Virtual-clock fleet bench at million-household population scale.
+
+The socket-mode fleet bench (``serve_bench_fleet``) measures the REAL
+wire — and tops out around a few thousand rps per host, far below the
+offered load a metropolitan P2P fleet sees. This bench measures the
+same serving policies at 100k+ rps by replaying them on the virtual
+clock, keeping every load-bearing component real:
+
+* **Arrivals** come from the synthetic population engine — Zipf x
+  rate-class weighted household draws with churn, on the exact
+  integer-nanosecond Poisson schedule (``loadgen.poisson_arrivals``).
+* **Placement** is the real ``ConsistentHashRing`` (sha256 + bisect),
+  one lookup per unique household, at the vnode count under test — the
+  replica-spread numbers are hash placement, not a model of it.
+* **Dispatch** is the real ``plan_open_loop`` replay of the microbatch
+  policy, per replica, over that replica's own arrival subsequence.
+* **Service times** are MEASURED per bucket on a warmed ``PolicyEngine``
+  (or supplied as an explicit model in tests) — the one modelled
+  quantity, and it is a measurement, not an assumption.
+* **Warehouse ingest** is real: each replica writes its batch telemetry
+  through its own WAL-mode ``SqliteSink`` shard, and the headline's
+  ``ingest_lag_ms`` is the sink's own ingest-lag gauge read back from
+  the shard files after a ``merge_warehouse_shards`` federation pass.
+* **Session spill** is a deterministic LRU replay of each replica's
+  household sequence against ``max_slots`` — the measured policy behind
+  the continuous batcher's eviction/rejoin accounting.
+
+Emitted rows (headline LAST, ``serve_bench_scale``): one
+``scale_replica_sweep`` row per replica count, one ``scale_scaling``
+row with the spread-vs-replicas table, one ``scale_spill`` row, then
+the headline with sustained rps/replica, p99 and warehouse ingest lag
+at the full population. ``tools/check_artifacts_schema.py`` validates
+the committed ``artifacts/SCALE_*.jsonl`` against this contract.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from p2pmicrogrid_tpu.scale.population import Population, PopulationConfig
+
+
+def _bucket_for(n: int, max_batch: int) -> int:
+    """Engine's bucket rule (next power of two, capped) without needing
+    an engine — keeps the modeled path usable in engine-less tests."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+def measure_bucket_service_model(
+    engine, repeats: int = 5, seed: int = 0
+) -> Dict[int, float]:
+    """Median measured ``engine.act`` seconds per batch bucket on the
+    warmed engine — the service-time model ``plan_open_loop`` replays.
+    Median (not min) so a one-off scheduler stall cannot understate, and
+    one-off cache luck cannot overstate, sustained capacity."""
+    from p2pmicrogrid_tpu.serve.loadgen import synthetic_obs
+
+    engine.warmup(include_step=False)
+    model: Dict[int, float] = {}
+    for bucket in engine.buckets:
+        obs = synthetic_obs(bucket, engine.n_agents, seed=seed)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            engine.act(obs)
+            times.append(time.perf_counter() - t0)
+        model[bucket] = float(np.median(times))
+    return model
+
+
+def _assign_replicas(
+    pop: Population,
+    idx: np.ndarray,
+    replica_ids: List[str],
+    vnodes: int,
+):
+    """(per-request replica ordinal [n], ring) — one REAL ring lookup per
+    unique household (cached), never per request and never over the full
+    id space."""
+    from p2pmicrogrid_tpu.serve.router import ConsistentHashRing
+
+    ring = ConsistentHashRing(vnodes=vnodes)
+    for rid in replica_ids:
+        ring.add(rid)
+    ordinal = {rid: i for i, rid in enumerate(replica_ids)}
+    unique = np.unique(idx)
+    lut = np.empty(unique.shape[0], dtype=np.int32)
+    for u, household_index in enumerate(unique):
+        lut[u] = ordinal[ring.lookup(pop.household_id(int(household_index)))]
+    return lut[np.searchsorted(unique, idx)], ring
+
+
+def _simulate_lru_spill(
+    household_seq: np.ndarray, max_slots: int
+) -> Dict[str, int]:
+    """Deterministic replay of the continuous batcher's LRU slot policy
+    over one replica's household sequence: hits (resident), joins,
+    evictions, and rejoins (evicted households returning — each one a
+    session re-init the fleet pays for an undersized ring)."""
+    resident: OrderedDict = OrderedDict()
+    evicted_once: set = set()
+    hits = joins = evictions = rejoins = 0
+    for h in household_seq:
+        h = int(h)
+        if h in resident:
+            resident.move_to_end(h)
+            hits += 1
+            continue
+        if h in evicted_once:
+            rejoins += 1
+        joins += 1
+        if len(resident) >= max_slots:
+            victim, _ = resident.popitem(last=False)
+            evicted_once.add(victim)
+            evictions += 1
+        resident[h] = True
+    return {
+        "requests": int(household_seq.shape[0]),
+        "hits": hits,
+        "joins": joins,
+        "evictions": evictions,
+        "rejoins": rejoins,
+    }
+
+
+def _measure_shard_ingest(
+    results_db: str,
+    replica_ids: List[str],
+    per_replica_batches: List[List[dict]],
+    seed: int,
+    config_hash: Optional[str] = None,
+) -> dict:
+    """Write each replica's batch telemetry through its own WAL-mode
+    ``SqliteSink`` shard (real inserts, real fsync policy), then run the
+    federation merge and read the sinks' own ``telemetry.ingest_lag_ms``
+    gauges back out of the shard files. Returns the ingest block the
+    headline reports."""
+    from p2pmicrogrid_tpu.data.results import (
+        merge_warehouse_shards,
+        shard_db_path,
+    )
+    from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry, run_manifest
+    from p2pmicrogrid_tpu.telemetry.registry import run_stamp
+
+    shard_paths: List[str] = []
+    for rid, batches in zip(replica_ids, per_replica_batches):
+        shard = shard_db_path(results_db, rid)
+        shard_paths.append(shard)
+        extra = {"serve_role": "scale-bench"}
+        # Carry the served bundle's config_hash so the federated --fleet
+        # view can join bench shards the same way it joins replica shards.
+        if config_hash is not None:
+            extra["config_hash"] = config_hash
+        tel = Telemetry(
+            run_id=f"scale-bench-{rid}-{run_stamp()}-{seed}",
+            sinks=[SqliteSink(shard, shard_id=rid)],
+            manifest=run_manifest(extra=extra),
+        )
+        for b in batches:
+            tel.event("scale_batch", **b)
+        tel.close()
+
+    lags: List[float] = []
+    for shard in shard_paths:
+        con = sqlite3.connect(f"file:{shard}?mode=ro", uri=True)
+        try:
+            for (v,) in con.execute(
+                "SELECT value FROM telemetry_points "
+                "WHERE name = 'telemetry.ingest_lag_ms'"
+            ):
+                lags.append(float(v))
+        finally:
+            con.close()
+
+    con = sqlite3.connect(results_db)
+    try:
+        merge_stats = merge_warehouse_shards(con, shard_paths)
+    finally:
+        con.close()
+    lag_arr = np.array(lags if lags else [0.0])
+    return {
+        "shards": len(shard_paths),
+        "batches_written": sum(len(b) for b in per_replica_batches),
+        "ingest_lag_ms_p50": round(float(np.percentile(lag_arr, 50)), 3),
+        "ingest_lag_ms_max": round(float(lag_arr.max()), 3),
+        "merged_rows": {
+            k: v for k, v in merge_stats.items() if k != "shards"
+        },
+    }
+
+
+def serve_bench_scale(
+    service_model: Optional[Dict[int, float]] = None,
+    engine=None,
+    population: Optional[Population] = None,
+    n_households: int = 1_000_000,
+    rate_hz: float = 100_000.0,
+    duration_s: float = 15.0,
+    replica_counts: Iterable[int] = (3, 10, 30),
+    vnodes: int = 4096,
+    max_batch: int = 64,
+    max_wait_s: float = 0.002,
+    max_slots: int = 256,
+    results_db: Optional[str] = None,
+    seed: int = 0,
+    emit: Optional[Callable[[dict], None]] = None,
+    extra_headline: Optional[dict] = None,
+) -> List[dict]:
+    """The million-household virtual-clock bench (see module docstring).
+
+    Pass either a warmed ``engine`` (its per-bucket service times are
+    measured) or an explicit ``service_model`` ``{bucket: seconds}``.
+    ``results_db`` enables the real shard-ingest measurement for the
+    headline replica count; without it ``ingest_lag_ms`` is reported as
+    0.0 with ``ingest.measured = False``.
+
+    The headline (LAST row, ``serve_bench_scale``) reports the LARGEST
+    replica count's sustained rps/replica and p99; the scaling row
+    reports hash-placement spread for every count — consistent hashing
+    must spread the population within a few percent at each size.
+    """
+    if service_model is None:
+        if engine is None:
+            raise ValueError("pass an engine or an explicit service_model")
+        max_batch = engine.max_batch
+        service_model = measure_bucket_service_model(engine, seed=seed)
+    pop = population or Population(
+        PopulationConfig(n_households=n_households, seed=seed)
+    )
+    n_requests = int(rate_hz * duration_s)
+    if n_requests < 1:
+        raise ValueError(
+            f"rate_hz x duration_s gives {n_requests} requests"
+        )
+
+    from p2pmicrogrid_tpu.serve.loadgen import (
+        plan_open_loop,
+        poisson_arrivals,
+    )
+
+    arrivals = poisson_arrivals(rate_hz, n_requests, seed=seed)
+    idx = pop.sample(n_requests, seed=seed + 1)
+    skew = pop.skew_summary(idx)
+
+    rows: List[dict] = []
+
+    def push(row: dict) -> None:
+        rows.append(row)
+        if emit:
+            emit(row)
+
+    replica_counts = sorted(set(int(r) for r in replica_counts))
+    headline_r = replica_counts[-1]
+    spread_by_count: Dict[int, float] = {}
+    headline_block: Optional[dict] = None
+    ingest_block = {"measured": False, "ingest_lag_ms_max": 0.0,
+                    "ingest_lag_ms_p50": 0.0}
+    spill_block: Optional[dict] = None
+
+    for n_replicas in replica_counts:
+        replica_ids = [f"replica-{r}" for r in range(n_replicas)]
+        assign, _ring = _assign_replicas(pop, idx, replica_ids, vnodes)
+        counts = np.bincount(assign, minlength=n_replicas)
+        mean_load = counts.mean()
+        spread = float(np.abs(counts - mean_load).max() / mean_load)
+        spread_by_count[n_replicas] = round(spread, 4)
+
+        latencies: List[np.ndarray] = []
+        rps: List[float] = []
+        per_replica_batches: List[List[dict]] = []
+        for r in range(n_replicas):
+            mask = assign == r
+            rep_arrivals = arrivals[mask]
+            if rep_arrivals.shape[0] == 0:
+                per_replica_batches.append([])
+                continue
+            result = plan_open_loop(
+                rep_arrivals,
+                lambda i, j: service_model[
+                    _bucket_for(j - i, max_batch)
+                ],
+                max_batch=max_batch,
+                max_wait_s=max_wait_s,
+                bucket_fn=lambda n: _bucket_for(n, max_batch),
+            )
+            latencies.append(result.latencies_s)
+            rps.append(result.throughput_rps)
+            per_replica_batches.append([
+                {
+                    "replica": r,
+                    "batch": b,
+                    "batch_size": result.batch_sizes[b],
+                    "bucket": result.bucket_sizes[b],
+                    "dispatch_s": round(result.dispatch_s[b], 6),
+                    "service_ms": round(result.service_s[b] * 1e3, 3),
+                }
+                for b in range(len(result.batch_sizes))
+            ])
+
+        lat = np.concatenate(latencies) * 1e3
+        offered_per_replica = rate_hz / n_replicas
+        sustained = float(np.mean(rps))
+        block = {
+            "metric": "scale_replica_sweep",
+            "value": round(sustained, 1),
+            "unit": "requests/sec",
+            "vs_baseline": round(sustained / offered_per_replica, 3),
+            "replicas": n_replicas,
+            "offered_rps_per_replica": round(offered_per_replica, 1),
+            "rps_per_replica": round(sustained, 1),
+            "saturated": bool(sustained < 0.95 * offered_per_replica),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p95_ms": round(float(np.percentile(lat, 95)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "load_spread": spread_by_count[n_replicas],
+            "vnodes": vnodes,
+        }
+        push(block)
+
+        if n_replicas == headline_r:
+            headline_block = block
+            # Spill policy, measured on the most-loaded replica: the
+            # worst-case working set the session ring must absorb.
+            hot = int(np.argmax(counts))
+            spill = _simulate_lru_spill(idx[assign == hot], max_slots)
+            served = max(1, spill["requests"])
+            spill_block = {
+                "metric": "scale_spill",
+                "value": round(spill["hits"] / served, 4),
+                "unit": "fraction",
+                "vs_baseline": 0.0,
+                "replica": hot,
+                "max_slots": max_slots,
+                **spill,
+                "hit_rate": round(spill["hits"] / served, 4),
+                "eviction_rate": round(spill["evictions"] / served, 4),
+                "rejoin_rate": round(spill["rejoins"] / served, 4),
+            }
+            if results_db:
+                ingest_block = dict(
+                    _measure_shard_ingest(
+                        results_db, replica_ids, per_replica_batches,
+                        seed,
+                        config_hash=(extra_headline or {}).get("config_hash"),
+                    ),
+                    measured=True,
+                )
+
+    push({
+        "metric": "scale_scaling",
+        "value": max(spread_by_count.values()),
+        "unit": "fraction",
+        "vs_baseline": 0.0,
+        "replica_counts": replica_counts,
+        "load_spread_by_count": {
+            str(k): v for k, v in spread_by_count.items()
+        },
+        "max_load_spread": max(spread_by_count.values()),
+        "vnodes": vnodes,
+    })
+    if spill_block is not None:
+        push(spill_block)
+
+    headline = {
+        "metric": "serve_bench_scale",
+        "value": headline_block["rps_per_replica"],
+        "unit": "requests/sec",
+        "vs_baseline": round(
+            headline_block["rps_per_replica"]
+            / headline_block["offered_rps_per_replica"],
+            3,
+        ),
+        "households": pop.n_households,
+        "n_requests": n_requests,
+        "rate_hz": rate_hz,
+        "duration_s": duration_s,
+        "replicas": headline_r,
+        "rps_per_replica": headline_block["rps_per_replica"],
+        "offered_rps_per_replica": headline_block[
+            "offered_rps_per_replica"
+        ],
+        "saturated": headline_block["saturated"],
+        "p50_ms": headline_block["p50_ms"],
+        "p99_ms": headline_block["p99_ms"],
+        "ingest_lag_ms": ingest_block["ingest_lag_ms_max"],
+        "ingest": ingest_block,
+        "load_spread": headline_block["load_spread"],
+        "scaling": {
+            "replica_counts": replica_counts,
+            "load_spread_by_count": {
+                str(k): v for k, v in spread_by_count.items()
+            },
+        },
+        "population": {
+            "n_households": pop.n_households,
+            "seed": pop.config.seed,
+            "zipf_s": pop.config.zipf_s,
+            "churn": pop.config.churn,
+            **skew,
+        },
+        "service_model_ms": {
+            str(b): round(s * 1e3, 4)
+            for b, s in sorted(service_model.items())
+        },
+        "max_batch": max_batch,
+        "max_wait_s": max_wait_s,
+        "vnodes": vnodes,
+        "seed": seed,
+    }
+    if extra_headline:
+        headline.update(extra_headline)
+    push(headline)
+    return rows

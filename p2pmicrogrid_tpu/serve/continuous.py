@@ -69,7 +69,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -162,8 +162,16 @@ class ContinuousBatcher:
             "steps": 0, "joins": 0, "evictions": 0, "retired": 0,
             "scratch_rows": 0, "stale_generation_drops": 0,
             "slot_deferrals": 0, "slot_wait_expired": 0,
-            "cancelled_drops": 0,
+            "cancelled_drops": 0, "spill_rejoins": 0,
         }
+        # Spill-policy meter (ROADMAP item 4): households seen returning
+        # after an LRU eviction. A high rejoin share means max_slots is
+        # below the live working set and the ring is thrashing re-inits —
+        # the signal the scale bench's spill row quantifies. Bounded at
+        # 4x max_slots so a million-household churn cannot grow it; only
+        # recency (not completeness) matters for the thrash signal.
+        self._recently_evicted: OrderedDict = OrderedDict()
+        self._recently_evicted_cap = 4 * max_slots
         self._ring = None
         self._ring_step = None
         if engine.is_recurrent:
@@ -372,11 +380,20 @@ class ContinuousBatcher:
             if not candidates:
                 return self.SCRATCH
             _, slot = min(candidates)
-            self._by_household.pop(self._slots[slot].household, None)
+            victim = self._slots[slot].household
+            self._by_household.pop(victim, None)
             self._retire_locked(slot)
             self._free.remove(slot)
             self.stats["evictions"] += 1
+            self._recently_evicted[victim] = self._step_counter
+            self._recently_evicted.move_to_end(victim)
+            while len(self._recently_evicted) > self._recently_evicted_cap:
+                self._recently_evicted.popitem(last=False)
         m = self._slots[slot]
+        if self._recently_evicted.pop(household, None) is not None:
+            # This household was LRU-evicted recently and is now paying a
+            # deterministic re-init: the spill cost the eviction deferred.
+            self.stats["spill_rejoins"] += 1
         m.household = household
         m.fresh = True
         m.served = 0

@@ -1118,6 +1118,7 @@ def build_bundle(
     serve_role: str = "candidate",
     batching: str = "micro",
     max_slots: int = 256,
+    shard_id: Optional[str] = None,
 ):
     """Load ONE bundle dir into ``(engine, queue, telemetry)`` — the unit
     ``build_registry`` loops over at startup and ``/admin/register`` runs
@@ -1131,7 +1132,11 @@ def build_bundle(
     join/leave ``ContinuousBatcher`` with per-household session slots —
     REQUIRED for recurrent bundles, whose hidden state lives engine-side;
     ``max_slots`` bounds resident sessions per bundle). A recurrent bundle
-    under ``"micro"`` is refused loudly at construction."""
+    under ``"micro"`` is refused loudly at construction.
+
+    ``shard_id`` names the warehouse shard this bundle's sink writes
+    (per-replica sharded write path, ROADMAP item 4) — it rides the run
+    manifest so the federated merge attributes runs to shards."""
     from p2pmicrogrid_tpu.serve.continuous import ContinuousBatcher
     from p2pmicrogrid_tpu.serve.engine import MicroBatchQueue, PolicyEngine
     from p2pmicrogrid_tpu.serve.export import load_policy_bundle
@@ -1155,7 +1160,9 @@ def build_bundle(
         # bundles built back-to-back (registry startup loop, racing
         # /admin/register pushes) from colliding on one warehouse run row.
         run_id=f"{run_name}-{run_stamp()}-{uuid.uuid4().hex[:6]}",
-        sinks=[SqliteSink(results_db)] if results_db else [],
+        sinks=(
+            [SqliteSink(results_db, shard_id=shard_id)] if results_db else []
+        ),
         manifest=run_manifest(
             extra={
                 "config_hash": config_hash,
@@ -1196,6 +1203,7 @@ def make_bundle_factory(
     run_name: str = "gateway",
     batching: str = "micro",
     max_slots: int = 256,
+    shard_id: Optional[str] = None,
 ):
     """The ``/admin/register`` hook: a closure over this gateway's engine
     settings building one runtime-registered bundle per call."""
@@ -1211,6 +1219,7 @@ def make_bundle_factory(
             serve_role="candidate",
             batching=batching,
             max_slots=max_slots,
+            shard_id=shard_id,
         )
 
     return factory
@@ -1226,6 +1235,7 @@ def build_registry(
     run_name: str = "gateway",
     batching: str = "micro",
     max_slots: int = 256,
+    shard_id: Optional[str] = None,
 ) -> BundleRegistry:
     """Load each bundle dir into an engine + queue + per-bundle telemetry
     registered in a fresh ``BundleRegistry`` (first bundle = default).
@@ -1260,6 +1270,7 @@ def build_registry(
                 serve_role="default" if i == 0 else "candidate",
                 batching=batching,
                 max_slots=max_slots,
+                shard_id=shard_id,
             )
             registry.register(
                 engine, pending_queue, telemetry=pending_tel,
@@ -1298,6 +1309,7 @@ def build_gateway(
     restarts: int = 0,
     batching: str = "micro",
     max_slots: int = 256,
+    shard_id: Optional[str] = None,
 ) -> ServeGateway:
     """``build_registry`` + a gateway owning the result (the one-process
     serving entry point; the fleet harness composes the pieces itself).
@@ -1314,6 +1326,7 @@ def build_gateway(
         run_name=run_name,
         batching=batching,
         max_slots=max_slots,
+        shard_id=shard_id,
     )
     return ServeGateway(
         registry, admission=admission, host=host, port=port, own_bundles=True,
@@ -1329,6 +1342,7 @@ def build_gateway(
             run_name=run_name,
             batching=batching,
             max_slots=max_slots,
+            shard_id=shard_id,
         ),
     )
 

@@ -135,12 +135,55 @@ class RetryBudget:
             return False
 
 
+# Arrival schedules are built on an integer-nanosecond virtual clock and
+# only converted to float seconds at the edge. A float64 cumsum of ~10 us
+# exponential gaps accumulates rounding drift that grows with n — at
+# 100k rps x minutes (10^7+ arrivals) the drift reaches the same order as
+# the gaps themselves, silently reshaping batch composition between runs
+# of different lengths. int64 addition is exact; 2^53 ns (~104 days of
+# virtual time) bounds where the final float conversion stays exact too.
+_MAX_EXACT_NS = 1 << 53
+
+
+def gaps_to_schedule_ns(gaps_s: np.ndarray) -> np.ndarray:
+    """Quantize inter-arrival gaps (seconds) to >= 1 ns each and cumsum on
+    the int64 nanosecond clock — the exact arrival schedule. The 1 ns
+    floor keeps the schedule STRICTLY increasing (a zero-quantized gap
+    would make two arrivals simultaneous and dispatch-order ambiguous)."""
+    gaps_ns = np.rint(np.asarray(gaps_s, dtype=float) * 1e9).astype(np.int64)
+    np.maximum(gaps_ns, 1, out=gaps_ns)
+    t_ns = np.cumsum(gaps_ns)
+    if t_ns.size and int(t_ns[-1]) >= _MAX_EXACT_NS:
+        raise OverflowError(
+            f"arrival schedule spans {int(t_ns[-1])} ns >= 2^53 — beyond "
+            "~104 days of virtual time the float64 second conversion "
+            "stops being nanosecond-exact; split the schedule"
+        )
+    return t_ns
+
+
+def schedule_ns_to_s(t_ns: np.ndarray) -> np.ndarray:
+    """int64 nanosecond schedule -> float64 seconds. Below 2^53 ns every
+    tick is exactly representable, so ``round(t * 1e9)`` round-trips to
+    the integer schedule (regression-tested in tests/test_scale.py)."""
+    t_ns = np.asarray(t_ns, dtype=np.int64)
+    if t_ns.size and int(t_ns[-1]) >= _MAX_EXACT_NS:
+        raise OverflowError(
+            f"schedule tick {int(t_ns[-1])} ns >= 2^53 is not exactly "
+            "representable in float64 seconds"
+        )
+    return t_ns.astype(np.float64) / 1e9
+
+
 def poisson_arrivals(rate_hz: float, n: int, seed: int = 0) -> np.ndarray:
-    """Cumulative arrival times (seconds) of ``n`` Poisson requests."""
+    """Cumulative arrival times (seconds) of ``n`` Poisson requests,
+    exact on the integer-nanosecond virtual clock (no cumsum drift at
+    100k+ rps x minutes — see ``gaps_to_schedule_ns``)."""
     if rate_hz <= 0:
         raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
     rng = np.random.default_rng(seed)
-    return np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+    gaps = rng.exponential(1.0 / rate_hz, size=n)
+    return schedule_ns_to_s(gaps_to_schedule_ns(gaps))
 
 
 def bursty_arrivals(
@@ -177,21 +220,25 @@ def bursty_arrivals(
     rng = np.random.default_rng(seed)
     rate_on = rate_hz * 2.0 * burst_factor / (1.0 + burst_factor)
     rate_off = rate_hz * 2.0 / (1.0 + burst_factor)
-    arrivals: List[float] = []
-    t = 0.0
+    # Same integer-nanosecond clock as poisson_arrivals: each drawn dwell
+    # and gap is quantized to >= 1 ns at the draw, and the running clocks
+    # are Python ints — exact at any n, so long schedules cannot drift a
+    # request across a dwell boundary relative to short ones.
+    arrivals_ns: List[int] = []
+    t_ns = 0
     on = True  # start in a burst: the first dispatch already sees a clump
-    while len(arrivals) < n:
-        dwell = rng.exponential(burst_dwell_s)
+    while len(arrivals_ns) < n:
+        dwell_ns = max(1, round(rng.exponential(burst_dwell_s) * 1e9))
         rate = rate_on if on else rate_off
-        tt = t
-        while len(arrivals) < n:
-            tt += rng.exponential(1.0 / rate)
-            if tt >= t + dwell:
+        tt_ns = t_ns
+        while len(arrivals_ns) < n:
+            tt_ns += max(1, round(rng.exponential(1.0 / rate) * 1e9))
+            if tt_ns >= t_ns + dwell_ns:
                 break
-            arrivals.append(tt)
-        t += dwell
+            arrivals_ns.append(tt_ns)
+        t_ns += dwell_ns
         on = not on
-    return np.asarray(arrivals[:n], dtype=float)
+    return schedule_ns_to_s(np.asarray(arrivals_ns[:n], dtype=np.int64))
 
 
 def make_arrivals(

@@ -73,6 +73,7 @@ class ProcessFleet:
         serve_device: str = "auto",
         batching: str = "micro",
         max_slots: int = 256,
+        shard_warehouse: bool = False,
         supervise: bool = True,
         backoff_s: float = 0.25,
         backoff_cap_s: float = 4.0,
@@ -100,6 +101,21 @@ class ProcessFleet:
         self.serve_device = serve_device
         self.batching = batching
         self.max_slots = max_slots
+        # Sharded warehouse write path (ROADMAP item 4): each child binds
+        # its own WAL shard file + shard identity instead of contending on
+        # one DB across processes. A relaunched replica rebinds the SAME
+        # shard — its committed prefix survives the SIGKILL and the next
+        # run appends beside it (the merge is keyed by run_id, so torn
+        # tails never collide with the relaunch's rows).
+        self.shard_warehouse = shard_warehouse
+        self.shard_paths: List[str] = []
+        if shard_warehouse and results_db:
+            from p2pmicrogrid_tpu.data.results import shard_db_path
+
+            self.shard_paths = [
+                shard_db_path(results_db, f"replica-{i}")
+                for i in range(n_replicas)
+            ]
         self.supervise = supervise
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
@@ -144,7 +160,15 @@ class ProcessFleet:
         if self.fault_plan_file:
             argv += ["--chaos-plan", self.fault_plan_file]
         if self.results_db:
-            argv += ["--results-db", self.results_db]
+            if self.shard_warehouse:
+                from p2pmicrogrid_tpu.data.results import shard_db_path
+
+                argv += [
+                    "--results-db", shard_db_path(self.results_db, rid),
+                    "--shard-id", rid,
+                ]
+            else:
+                argv += ["--results-db", self.results_db]
         return argv
 
     def _spawn(self, rid: str, port: int = 0,

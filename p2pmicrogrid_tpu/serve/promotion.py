@@ -1157,6 +1157,7 @@ def run_promotion_pipeline(
     incumbent_eval: Optional[Tuple[float, float]] = None,
     regime_specs: Optional[Sequence] = None,
     regime_s_per_regime: int = 4,
+    batching: str = "continuous",
 ) -> dict:
     """Gate + canary for ONE candidate against a live in-process gateway.
 
@@ -1168,6 +1169,14 @@ def run_promotion_pipeline(
     reports, availability, rolled_back/promoted flags and a bit-exact
     check of the post-rollback (or post-promote) serving path against
     the bundle that should be serving.
+
+    ``batching`` selects the gateway queue front; the default is now the
+    slot-level ``"continuous"`` batcher (bit-exact vs ``"micro"`` for the
+    stateless bundles promotion serves, verified per-request here by the
+    post-ramp bit-exact check against a direct engine — so the committed
+    ``PROMOTION_*``/``AUTOPILOT_*`` capture semantics carry over
+    unchanged). Pass ``"micro"`` to reproduce the coalescing-window
+    queue those captures were originally measured under.
     """
     import jax  # noqa: F401 — engine construction below needs a backend
 
@@ -1220,6 +1229,7 @@ def run_promotion_pipeline(
             max_queue_depth=100_000, wait_budget_ms=1e9
         ),
         run_name="promotion",
+        batching=batching,
     )
     server = GatewayServer(gateway)
     host, port = server.start()

@@ -71,6 +71,11 @@ class BundleRegistry:
         self._default: Optional[str] = None
         self._split: Optional[Tuple[str, float]] = None  # (hash_b, percent)
         self._pins: Dict[str, str] = {}
+        # Incremental per-bundle pin tallies, maintained on every pin
+        # mutation (ROADMAP item 4): stats() must stay O(bundles) — at a
+        # million pinned households, re-counting the id-keyed map per
+        # snapshot would make every /stats poll iterate the id space.
+        self._pin_counts: Dict[str, int] = {}
         self.swap_count = 0
 
     # -- membership ----------------------------------------------------------
@@ -119,9 +124,12 @@ class BundleRegistry:
                     "clear the split first"
                 )
             bundle = self._bundles.pop(config_hash)
+            # Control-plane op (not the per-request path): dropping one
+            # bundle's pins rebuilds the map once per remove.
             self._pins = {
                 h: c for h, c in self._pins.items() if c != config_hash
             }
+            self._pin_counts.pop(config_hash, None)
             return bundle
 
     def get(self, config_hash: str) -> ServingBundle:
@@ -159,6 +167,7 @@ class BundleRegistry:
                 # routing to it is moot.
                 self._split = None
             self._pins.clear()
+            self._pin_counts.clear()
             self.swap_count += 1
             return previous
 
@@ -193,6 +202,7 @@ class BundleRegistry:
         sessions survive the widening."""
         with self._lock:
             self._pins.clear()
+            self._pin_counts.clear()
 
     # -- routing hot path ----------------------------------------------------
 
@@ -219,6 +229,18 @@ class BundleRegistry:
                 arm, percent = self._split
                 if _household_slot(household_id) < percent:
                     chosen = arm
+                # O(1) per request: one dict write + tally adjust — the
+                # split hash above is constant-time, and nothing on this
+                # path scales with how many households exist.
+                previous = self._pins.get(household_id)
+                if previous != chosen:
+                    if previous is not None:
+                        self._pin_counts[previous] = (
+                            self._pin_counts.get(previous, 1) - 1
+                        )
+                    self._pin_counts[chosen] = (
+                        self._pin_counts.get(chosen, 0) + 1
+                    )
                 self._pins[household_id] = chosen
             return self._bundles[chosen]
 
@@ -230,7 +252,11 @@ class BundleRegistry:
             return len(self._pins)
 
     def stats(self) -> dict:
-        """Per-bundle serving stats snapshot (lock-held, O(bundles))."""
+        """Per-bundle serving stats snapshot — lock-held and O(bundles),
+        NEVER O(pins): the per-bundle pinned tallies are maintained
+        incrementally on the route path, so a million-household split does
+        not turn every /stats poll into an id-space scan
+        (tests/test_scale.py regression-tests this at 1M ids)."""
         import numpy as np
 
         with self._lock:
@@ -252,9 +278,7 @@ class BundleRegistry:
                         round(float(np.percentile(waits, 95)), 3)
                         if waits else 0.0
                     ),
-                    "pinned_households": sum(
-                        1 for c in self._pins.values() if c == h
-                    ),
+                    "pinned_households": self._pin_counts.get(h, 0),
                 }
             return {
                 "default": self._default,

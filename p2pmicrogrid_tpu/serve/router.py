@@ -733,9 +733,23 @@ class FleetRouter:
         with self._lock:
             return len(self._pins)
 
-    def pinned_households(self) -> Dict[str, str]:
+    def pinned_households(self, limit: int = 10_000) -> Dict[str, str]:
+        """A snapshot of failover pins, CAPPED at ``limit`` entries
+        (ROADMAP item 4): pins record only failover placements so the map
+        stays small in steady state, but after a chaos storm at a
+        million-household population an uncapped copy would materialize
+        per-household state on every observability poll. ``pinned_count``
+        is the O(1) total; pass a larger limit explicitly to widen the
+        sample."""
         with self._lock:
-            return dict(self._pins)
+            if len(self._pins) <= limit:
+                return dict(self._pins)
+            out: Dict[str, str] = {}
+            for h, rid in self._pins.items():
+                if len(out) >= limit:
+                    break
+                out[h] = rid
+            return out
 
     # -- request path --------------------------------------------------------
 
@@ -1346,6 +1360,7 @@ class LocalFleet:
         authenticator=None,
         batching: str = "micro",
         max_slots: int = 256,
+        shard_warehouse: bool = False,
     ):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -1372,6 +1387,15 @@ class LocalFleet:
         # classic "micro" coalescing queue.
         self.batching = batching
         self.max_slots = max_slots
+        # Sharded warehouse write path (ROADMAP item 4): with
+        # ``shard_warehouse`` on, each replica binds its OWN WAL-mode
+        # SQLite shard (``<results_db stem>.shard-<rid><ext>``) instead of
+        # funneling every per-request row into one file — the single-DB
+        # funnel is the first thing to fall over at a million households.
+        # ``shard_paths`` lists the files for a read-time federation
+        # (``telemetry-query --shard`` / merge_warehouse_shards).
+        self.shard_warehouse = shard_warehouse
+        self.shard_paths: List[str] = []
         self._lock = threading.Lock()
         self._entries: Dict[str, dict] = {}
         self.kills: List[str] = []
@@ -1394,26 +1418,34 @@ class LocalFleet:
                     FaultInjector(self.fault_plan, rid)
                     if self.fault_plan is not None else None
                 )
+                rep_db, shard_id = self.results_db, None
+                if self.shard_warehouse and self.results_db:
+                    from p2pmicrogrid_tpu.data.results import shard_db_path
+
+                    rep_db, shard_id = shard_db_path(self.results_db, rid), rid
+                    self.shard_paths.append(rep_db)
                 registry = build_registry(
                     self.bundle_dirs,
                     max_batch=self.max_batch,
                     max_wait_s=self.max_wait_s,
-                    results_db=self.results_db,
+                    results_db=rep_db,
                     device=self.device,
                     warmup=self.warmup,
                     run_name=f"{self.run_name}-{rid}",
                     batching=self.batching,
                     max_slots=self.max_slots,
+                    shard_id=shard_id,
                 )
                 factory = make_bundle_factory(
                     max_batch=self.max_batch,
                     max_wait_s=self.max_wait_s,
-                    results_db=self.results_db,
+                    results_db=rep_db,
                     device=self.device,
                     warmup=self.warmup,
                     run_name=f"{self.run_name}-{rid}",
                     batching=self.batching,
                     max_slots=self.max_slots,
+                    shard_id=shard_id,
                 )
                 gateway = ServeGateway(
                     registry, admission=self.admission, host=self.host,
@@ -1626,6 +1658,7 @@ def run_fleet_loadgen(
     households: List[str],
     deadline_s: Optional[float] = None,
     trace_seed: Optional[int] = None,
+    household_ids: Optional[List[str]] = None,
 ) -> FleetLoadgenResult:
     """The open-loop Poisson schedule fired through the ROUTER (retry,
     failover and shed semantics included) instead of at one gateway.
@@ -1634,10 +1667,20 @@ def run_fleet_loadgen(
     ``root_context(trace_seed, i)`` through ``router.act`` — the router
     records the root + attempt/backoff spans, the replicas their server
     spans, and the warehouse stitches the cross-process tree back
-    together (``TRACE_TREE_SQL``)."""
+    together (``TRACE_TREE_SQL``).
+
+    ``household_ids`` (one id PER REQUEST, len == len(arrivals)) replaces
+    the default round-robin over ``households`` — the hook the synthetic
+    population engine (scale/population.py) uses to drive a realistic
+    Zipf-skewed household mix through the same router path."""
     obs = np.asarray(obs, dtype=np.float32)  # host-sync: host-side inputs
     arrivals = np.asarray(arrivals, dtype=float)  # host-sync: host schedule
     n = int(arrivals.shape[0])
+    if household_ids is not None and len(household_ids) != n:
+        raise ValueError(
+            f"household_ids carries {len(household_ids)} ids for "
+            f"{n} arrivals — the population sequence must be per-request"
+        )
     latencies = np.zeros(n)
     statuses = np.full(n, -1, dtype=np.int64)
     retries = np.zeros(n, dtype=np.int64)
@@ -1652,8 +1695,12 @@ def run_fleet_loadgen(
         if delay > 0:
             await asyncio.sleep(delay)
         t_send = time.perf_counter()
+        hid = (
+            household_ids[i] if household_ids is not None
+            else households[i % len(households)]
+        )
         result = await router.act(
-            households[i % len(households)], obs[i], deadline_s=deadline_s,
+            hid, obs[i], deadline_s=deadline_s,
             trace=(
                 root_context(trace_seed, i)
                 if trace_seed is not None else None
@@ -1715,6 +1762,7 @@ def serve_bench_fleet(
     burst_factor: float = 1.0,
     burst_dwell_s: float = 0.25,
     trace_seed: Optional[int] = None,
+    household_ids: Optional[List[str]] = None,
 ) -> List[dict]:
     """Fleet-level SLO benchmark: the serve-bench open-loop schedule
     through the router over a live fleet, optionally with a fault plan
@@ -1733,6 +1781,10 @@ def serve_bench_fleet(
     with ZERO retries and ZERO retry-budget spend — the headline's
     ``auth_probe`` block records it, and ``auth_shed_rate`` reports the
     gateways' 401/403 fraction of all act requests.
+
+    ``household_ids`` (one per request) overrides the round-robin
+    ``n_households`` mix with an explicit per-request id sequence — the
+    synthetic population engine's entry point (scale/population.py).
 
     ``gateway_baseline`` (a prior ``fleet_stats()['gateway_totals']``):
     gateway stats are cumulative per process, so pre-run traffic (the
@@ -1756,7 +1808,7 @@ def serve_bench_fleet(
             schedule.start()
         result = run_fleet_loadgen(
             router, obs, arrivals, households, deadline_s=deadline_s,
-            trace_seed=trace_seed,
+            trace_seed=trace_seed, household_ids=household_ids,
         )
         if schedule is not None:
             # Let a restart scheduled NEAR the run's end still apply (the

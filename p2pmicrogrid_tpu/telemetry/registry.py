@@ -127,12 +127,21 @@ class SqliteSink:
     ``batch`` records and on close); the connection runs in WAL mode and is
     lock-guarded, so the serve engine's microbatch worker thread can emit
     concurrently with the main thread.
+
+    ``shard_id`` names the warehouse shard this sink writes (ROADMAP item
+    4): at fleet scale every replica binds its OWN WAL-mode SQLite file
+    instead of funneling through one DB, and the identity rides the run
+    manifest (``manifest_json.shard_id``) so a federated merge
+    (``data/results.py:merge_warehouse_shards``) can attribute every run
+    to the shard that wrote it. ``None`` (the default) keeps the
+    single-funnel behavior unchanged.
     """
 
-    def __init__(self, path: str, batch: int = 64):
+    def __init__(self, path: str, batch: int = 64, shard_id: Optional[str] = None):
         import threading
 
         self.path = path
+        self.shard_id = shard_id
         self.batch = max(1, int(batch))
         self._con = None
         self._lock = threading.Lock()
@@ -166,6 +175,8 @@ class SqliteSink:
         e.g. with the mesh shape — refreshes its row on close)."""
         self._run_id = run_id
         self._manifest = dict(manifest or {})
+        if self.shard_id is not None:
+            self._manifest.setdefault("shard_id", self.shard_id)
         with self._lock:
             self._write_run_row()
 

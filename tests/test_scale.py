@@ -1,0 +1,664 @@
+"""Million-household scale tier (ROADMAP item 4, ISSUE 17): synthetic
+population determinism/skew/churn, the integer-nanosecond virtual clock's
+exactness at 100k+ rps, per-replica warehouse shard federation (merge
+idempotency, out-of-order shards, torn last batch, row-identical federated
+views through the CLI), the structural O(1)-per-request audits at 1M ids
+(router pins, registry stats, session ring), the LRU spill policy and the
+SCALE_*.jsonl capture contract. Fast and JAX_PLATFORMS=cpu-safe (tier-1):
+everything here is host-side numpy + sqlite — no engine compiles."""
+
+import json
+import sqlite3
+from collections import deque
+
+import numpy as np
+import pytest
+
+from p2pmicrogrid_tpu.data.results import (
+    CONTINUOUS_VIEW_SQL,
+    FLEET_VIEW_SQL,
+    merge_warehouse_shards,
+    shard_db_path,
+)
+from p2pmicrogrid_tpu.scale import (
+    Population,
+    PopulationConfig,
+    audit_registry_scalability,
+    audit_ring_scalability,
+    audit_router_scalability,
+    run_scale_audit,
+    serve_bench_scale,
+)
+from p2pmicrogrid_tpu.scale.audit import _NoIterDict, audit_session_ring
+from p2pmicrogrid_tpu.scale.bench import _simulate_lru_spill
+from p2pmicrogrid_tpu.serve.loadgen import (
+    _MAX_EXACT_NS,
+    bursty_arrivals,
+    gaps_to_schedule_ns,
+    poisson_arrivals,
+    schedule_ns_to_s,
+)
+from p2pmicrogrid_tpu.serve.registry import BundleRegistry
+from p2pmicrogrid_tpu.serve.router import ConsistentHashRing, FleetRouter, Replica
+
+N_SMALL = 10_000          # population for the statistical tests
+N_MILLION = 1_000_000     # the scale the audits must hold at
+
+
+# -- synthetic population ------------------------------------------------------
+
+
+class TestPopulation:
+    def test_same_config_same_requests_bit_for_bit(self):
+        a = Population(n_households=N_SMALL, seed=7)
+        b = Population(n_households=N_SMALL, seed=7)
+        np.testing.assert_array_equal(
+            a.sample(5_000, seed=3), b.sample(5_000, seed=3)
+        )
+        assert a.arrival_ids(64, seed=1) == b.arrival_ids(64, seed=1)
+
+    def test_ids_are_stable_and_zero_padded(self):
+        pop = Population(n_households=N_SMALL, seed=0)
+        assert Population.household_id(42) == "house-0000042"
+        assert pop.ids(np.array([0, 9_999])) == [
+            "house-0000000", "house-0009999"
+        ]
+        # Stable under a DIFFERENT sampling history: ids are a pure
+        # function of the index, never of draw order.
+        pop.sample(1_000, seed=9)
+        assert pop.ids(np.array([42])) == ["house-0000042"]
+
+    def test_schedule_seeds_are_independent_streams(self):
+        pop = Population(n_households=N_SMALL, seed=0)
+        s1 = pop.sample(2_000, seed=1)
+        s2 = pop.sample(2_000, seed=2)
+        assert not np.array_equal(s1, s2)
+        np.testing.assert_array_equal(s1, pop.sample(2_000, seed=1))
+
+    def test_zipf_mix_concentrates_above_uniform(self):
+        """With zipf_s > 0 the hottest 1% of ids carries well more than
+        1% of traffic; at s=0 (uniform) it does not."""
+        one_class = {"residential": (1.0, 1.0)}  # isolate the Zipf axis
+        skewed = Population(n_households=N_SMALL, seed=0, zipf_s=0.9,
+                            churn=0.0, rate_classes=one_class)
+        flat = Population(n_households=N_SMALL, seed=0, zipf_s=0.0,
+                          churn=0.0, rate_classes=one_class)
+        n = 50_000
+        hot = skewed.skew_summary(skewed.sample(n, seed=5))
+        cold = flat.skew_summary(flat.sample(n, seed=5))
+        assert hot["top1pct_share"] > 3 * cold["top1pct_share"]
+        assert hot["unique"] < cold["unique"]
+
+    def test_churn_widens_the_touched_id_set(self):
+        base = Population(n_households=N_SMALL, seed=0, zipf_s=1.2,
+                          churn=0.0)
+        churny = Population(n_households=N_SMALL, seed=0, zipf_s=1.2,
+                            churn=0.3)
+        n = 30_000
+        assert (
+            churny.skew_summary(churny.sample(n, seed=2))["unique"]
+            > base.skew_summary(base.sample(n, seed=2))["unique"]
+        )
+
+    def test_rate_classes_cover_population_and_validate(self):
+        pop = Population(n_households=2_000, seed=1)
+        names = {pop.rate_class(i) for i in range(2_000)}
+        assert names == {"residential", "commercial", "industrial"}
+        with pytest.raises(ValueError, match="shares must sum to 1"):
+            PopulationConfig(
+                n_households=10,
+                rate_classes={"a": (0.5, 1.0), "b": (0.2, 2.0)},
+            )
+        with pytest.raises(ValueError, match="churn"):
+            PopulationConfig(n_households=10, churn=1.5)
+        with pytest.raises(ValueError, match="zipf_s"):
+            PopulationConfig(n_households=10, zipf_s=-0.1)
+
+    def test_sample_indices_always_in_range(self):
+        pop = Population(n_households=100, seed=3, churn=0.5)
+        idx = pop.sample(10_000, seed=1)
+        assert idx.min() >= 0 and idx.max() < 100
+
+
+# -- integer-nanosecond virtual clock ------------------------------------------
+
+
+class TestVirtualClockExactness:
+    def test_poisson_schedule_is_ns_exact_at_100k_rps(self):
+        """The headline regime (100k rps x minutes of virtual time): the
+        float64 seconds the planner consumes round-trip EXACTLY to the
+        int64 nanosecond schedule — no cumsum drift at any arrival."""
+        arr = poisson_arrivals(100_000.0, 300_000, seed=1)
+        ns = np.rint(arr * 1e9).astype(np.int64)
+        assert np.all(np.diff(ns) >= 1), "schedule must strictly increase"
+        rng = np.random.default_rng(1)
+        gaps = rng.exponential(1.0 / 100_000.0, size=300_000)
+        np.testing.assert_array_equal(ns, gaps_to_schedule_ns(gaps))
+        # ~3 virtual seconds of offered load actually materialized.
+        assert 2.8 < arr[-1] < 3.2
+
+    def test_zero_gaps_get_the_one_ns_floor(self):
+        t = gaps_to_schedule_ns(np.zeros(5))
+        np.testing.assert_array_equal(t, np.arange(1, 6))
+
+    def test_overflow_past_exact_float64_range_is_loud(self):
+        big = np.array([float(_MAX_EXACT_NS) / 1e9])
+        with pytest.raises(OverflowError):
+            gaps_to_schedule_ns(big)
+        with pytest.raises(OverflowError):
+            schedule_ns_to_s(np.array([_MAX_EXACT_NS], dtype=np.int64))
+
+    def test_roundtrip_is_lossless_within_range(self):
+        t_ns = np.array([1, 2, 10**9, 10**14, _MAX_EXACT_NS - 1],
+                        dtype=np.int64)
+        s = schedule_ns_to_s(t_ns)
+        np.testing.assert_array_equal(
+            np.rint(s * 1e9).astype(np.int64), t_ns
+        )
+
+    def test_bursty_arrivals_deterministic_and_strictly_increasing(self):
+        a = bursty_arrivals(50_000.0, 100_000, seed=4)
+        b = bursty_arrivals(50_000.0, 100_000, seed=4)
+        np.testing.assert_array_equal(a, b)
+        ns = np.rint(a * 1e9).astype(np.int64)
+        assert np.all(np.diff(ns) >= 1)
+
+
+# -- warehouse shard federation ------------------------------------------------
+
+
+def _write_shard(path, shard_id, config_hash, run_id, events=8,
+                 failovers=2.0):
+    """One replica's warehouse shard through the REAL WAL-mode sink:
+    serve-role run manifest, serve_request traces and a router counter —
+    the rows every federated view aggregates."""
+    from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+    tel = Telemetry(
+        run_id=run_id,
+        sinks=[SqliteSink(path, batch=4, shard_id=shard_id)],
+        manifest={
+            "created": "2026-08-01T00:00:00", "config_hash": config_hash,
+            "git_rev": "rev-1", "setting": "2-agent", "backend": "cpu",
+            "device_count": 1, "serve_role": "replica",
+            "serve_batching": "continuous",
+        },
+    )
+    for i in range(events):
+        tel.event("serve_request", wait_ms=0.5 + i, latency_ms=1.0 + i)
+    tel.counter("router.failovers", failovers)
+    tel.close()
+
+
+def _all_rows(con):
+    """Every merged table's full row set, as comparable sorted tuples."""
+    from p2pmicrogrid_tpu.data.results import SHARD_MERGE_TABLES
+
+    out = {}
+    for table in SHARD_MERGE_TABLES:
+        out[table] = sorted(
+            tuple(r) for r in con.execute(f"SELECT * FROM {table}")
+        )
+    return out
+
+
+class TestShardMerge:
+    def _shards(self, tmp_path, n=3):
+        base = str(tmp_path / "results.db")
+        paths = []
+        for r in range(n):
+            shard = shard_db_path(base, f"replica-{r}")
+            _write_shard(shard, f"replica-{r}", "cfg-scale",
+                         f"run-{r}", events=4 + r)
+            paths.append(shard)
+        return base, paths
+
+    def test_shard_path_is_a_sibling_of_the_base_db(self, tmp_path):
+        base = str(tmp_path / "results.db")
+        assert shard_db_path(base, "replica-0") == str(
+            tmp_path / "results.shard-replica-0.db"
+        )
+
+    def test_merge_is_idempotent_same_shard_twice(self, tmp_path):
+        _base, paths = self._shards(tmp_path)
+        con = sqlite3.connect(":memory:")
+        try:
+            merge_warehouse_shards(con, paths)
+            before = _all_rows(con)
+            again = merge_warehouse_shards(con, [paths[0], paths[0]])
+            assert again["telemetry_runs"] == 0
+            assert again["telemetry_points"] == 0
+            assert _all_rows(con) == before
+        finally:
+            con.close()
+
+    def test_merge_order_does_not_matter(self, tmp_path):
+        _base, paths = self._shards(tmp_path)
+        a = sqlite3.connect(":memory:")
+        b = sqlite3.connect(":memory:")
+        try:
+            merge_warehouse_shards(a, paths)
+            merge_warehouse_shards(b, list(reversed(paths)))
+            assert _all_rows(a) == _all_rows(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_torn_last_batch_merges_to_committed_prefix(self, tmp_path):
+        """A SIGKILLed replica's shard: the sink flushed one full batch
+        and died with another buffered. The committed prefix federates
+        cleanly — no half-rows, no merge error."""
+        from p2pmicrogrid_tpu.telemetry import SqliteSink, Telemetry
+
+        shard = str(tmp_path / "torn.shard-replica-9.db")
+        tel = Telemetry(
+            run_id="run-torn",
+            sinks=[SqliteSink(shard, batch=3, shard_id="replica-9")],
+            manifest={
+                "created": "2026-08-01T00:00:00",
+                "config_hash": "cfg-torn", "git_rev": "rev-1",
+                "setting": "2-agent", "backend": "cpu", "device_count": 1,
+                "serve_role": "replica",
+            },
+        )
+        for i in range(4):  # one batch of 3 commits; the 4th stays buffered
+            tel.event("serve_request", wait_ms=float(i))
+        # No tel.close(): the buffered event dies with the "process".
+        con = sqlite3.connect(":memory:")
+        try:
+            stats = merge_warehouse_shards(con, [shard])
+            assert stats["shards"] == 1
+            (n,) = con.execute(
+                "SELECT COUNT(*) FROM telemetry_points "
+                "WHERE kind = 'serve_request'"
+            ).fetchone()
+            assert n == 3  # exactly the committed batch, never a half-row
+        finally:
+            con.close()
+        tel.close()  # release the sink for tmp_path cleanup
+
+    def test_federated_views_row_identical_to_single_db(self, tmp_path):
+        """The acceptance criterion: `telemetry-query` over N shards
+        returns the SAME fleet/continuous rows as the single-DB funnel
+        holding every replica's telemetry."""
+        _base, paths = self._shards(tmp_path)
+        funnel = str(tmp_path / "funnel.db")
+        con = sqlite3.connect(funnel)
+        try:
+            merge_warehouse_shards(con, paths)
+        finally:
+            con.close()
+        federated = sqlite3.connect(":memory:")
+        single = sqlite3.connect(funnel)
+        try:
+            merge_warehouse_shards(federated, paths)
+            for sql in (FLEET_VIEW_SQL, CONTINUOUS_VIEW_SQL):
+                fed = federated.execute(sql).fetchall()
+                fun = single.execute(sql).fetchall()
+                assert fed == fun
+                assert fed, "view must aggregate real rows, not be vacuous"
+        finally:
+            federated.close()
+            single.close()
+
+    def test_cli_shard_federation_matches_results_db(self, tmp_path, capsys):
+        from p2pmicrogrid_tpu.cli import main
+
+        _base, paths = self._shards(tmp_path)
+        funnel = str(tmp_path / "funnel.db")
+        con = sqlite3.connect(funnel)
+        try:
+            merge_warehouse_shards(con, paths)
+        finally:
+            con.close()
+        for view_flag in ("--fleet", "--continuous"):
+            shard_args = ["telemetry-query", view_flag]
+            for p in reversed(paths):  # out-of-order on purpose
+                shard_args += ["--shard", p]
+            assert main(shard_args) == 0
+            shard_out = capsys.readouterr().out.strip().splitlines()
+            assert main(
+                ["telemetry-query", view_flag, "--results-db", funnel]
+            ) == 0
+            db_out = capsys.readouterr().out.strip().splitlines()
+            assert [json.loads(l) for l in shard_out] == [
+                json.loads(l) for l in db_out
+            ]
+            assert shard_out, f"{view_flag} federation returned no rows"
+
+    def test_cli_refuses_compact_and_watch_with_shards(self, tmp_path,
+                                                       capsys):
+        from p2pmicrogrid_tpu.cli import main
+
+        _base, paths = self._shards(tmp_path, n=1)
+        for extra in ("--compact", "--watch"):
+            rc = main(["telemetry-query", "--shard", paths[0], extra])
+            capsys.readouterr()
+            assert rc == 2
+        assert main(["telemetry-query"]) == 2  # neither source given
+        capsys.readouterr()
+
+
+# -- structural O(1) audits at 1M ids ------------------------------------------
+
+
+class _FakeEngine:
+    def __init__(self, config_hash):
+        self.manifest = {"config_hash": config_hash,
+                         "implementation": "fake"}
+        self.n_agents = 1
+        self.stats = {"rows": 0, "batches": 0, "padded_rows": 0}
+
+
+class _FakeQueue:
+    depth = 0
+    recent_wait_ms = deque()
+
+
+@pytest.fixture(scope="module")
+def million_pins():
+    """1M household->bundle pins, built ONCE for the audits below (the
+    dict build is the expensive part, not the audited operations)."""
+    return {
+        f"h{i}": ("cfg-b" if i % 3 == 0 else "cfg-a")
+        for i in range(N_MILLION)
+    }
+
+
+class TestScaleAudits:
+    def test_noiterdict_trips_on_iteration_and_allows_scoped(self):
+        d = _NoIterDict({"a": 1, "b": 2})
+        with pytest.raises(AssertionError, match="O\\(1\\) audit tripped"):
+            list(d)
+        with pytest.raises(AssertionError):
+            dict(d)  # dict() copies via keys() — also an id-space scan
+        assert d["a"] == 1 and len(d) == 2 and "b" in d  # O(1) ops fine
+        with d.allow():
+            assert sorted(d.items()) == [("a", 1), ("b", 2)]
+
+    def test_ring_audit_structure_and_spread(self):
+        ring = ConsistentHashRing(vnodes=512)
+        for r in range(5):
+            ring.add(f"replica-{r}")
+        ids = [f"house-{i:07d}" for i in range(20_000)]
+        audit = audit_ring_scalability(ring, ids, tolerance=0.25)
+        assert audit["ring_points"] == 5 * 512
+        assert audit["within_tolerance"]
+
+    def test_ring_audit_rejects_household_sized_tables(self):
+        ring = ConsistentHashRing(vnodes=8)
+        ring.add("replica-0")
+        ring._points.append(ring._points[-1] + 1)  # table leaked an entry
+        ring._owners.append("replica-0")
+        with pytest.raises(AssertionError, match="replicas x vnodes"):
+            audit_ring_scalability(ring, ["house-0000001"])
+
+    def test_registry_stats_never_iterates_a_million_pins(self,
+                                                          million_pins):
+        """Satellite (f) regression: stats() at 1M pinned households is
+        O(bundles) — the _NoIterDict raises if it ever re-scans the
+        id-keyed pin map, and the incremental tallies must agree with the
+        map's true size."""
+        reg = BundleRegistry()
+        reg.register(_FakeEngine("cfg-a"), _FakeQueue(), default=True)
+        reg.register(_FakeEngine("cfg-b"), _FakeQueue())
+        n_b = sum(1 for v in million_pins.values() if v == "cfg-b")
+        with reg._lock:
+            reg._pins = dict(million_pins)
+            reg._pin_counts = {"cfg-a": N_MILLION - n_b, "cfg-b": n_b}
+        audit = audit_registry_scalability(reg)
+        assert audit["pinned_total"] == N_MILLION
+        snap = reg.stats()
+        assert snap["bundles"]["cfg-b"]["pinned_households"] == n_b
+        assert reg.pinned_count == N_MILLION
+
+    def test_registry_route_path_is_o1_under_split(self):
+        reg = BundleRegistry()
+        reg.register(_FakeEngine("cfg-a"), _FakeQueue(), default=True)
+        reg.register(_FakeEngine("cfg-b"), _FakeQueue())
+        reg.set_split("cfg-b", 50)
+        with reg._lock:
+            reg._pins = _NoIterDict(reg._pins)
+        for i in range(64):  # pin writes must never scan the pin map
+            reg.route(f"house-{i:07d}")
+        assert reg.pinned_count == 64
+
+    def test_router_fleet_stats_reports_count_not_map(self, million_pins):
+        """Satellite (f) regression: fleet_stats() at 1M pins returns the
+        O(1) count — never a materialized per-household map — and the
+        request-path bookkeeping stays O(1) under the _NoIterDict."""
+        router = FleetRouter(
+            [Replica(replica_id=f"replica-{r}", host="127.0.0.1", port=1)
+             for r in range(3)],
+            vnodes=64,
+        )
+        guard = _NoIterDict(million_pins)
+        with router._lock:
+            router._pins = guard
+        snap = router.fleet_stats(timeout_s=0.2)
+        assert snap["pinned_households"] == N_MILLION
+        assert isinstance(snap["pinned_households"], int)
+        assert router.pinned_count == N_MILLION
+        # Hand the audit a plain dict — it plants its own tripwire.
+        with router._lock, guard.allow():
+            router._pins = dict(guard)
+        audit = audit_router_scalability(router, snapshot_limit=100)
+        assert audit["snapshot_len"] <= 100
+
+    def test_router_pinned_snapshot_is_capped(self):
+        router = FleetRouter(
+            [Replica(replica_id=f"replica-{r}", host="127.0.0.1", port=1)
+             for r in range(2)],
+            vnodes=32,
+        )
+        with router._lock:
+            router._pins = {f"h{i}": "replica-0" for i in range(500)}
+        assert len(router.pinned_households(limit=50)) == 50
+        assert router.pinned_count == 500
+
+    def test_run_scale_audit_holds_at_a_million_ids(self):
+        """The ISSUE's structural claim end-to-end: population, rings at
+        3/10/30 replicas and the pin-guarded router, all at 1M ids."""
+        audit = run_scale_audit(
+            n_households=N_MILLION, sample=20_000, vnodes=1024,
+            replica_counts=(3, 10, 30), seed=0,
+        )
+        assert audit["n_households"] == N_MILLION
+        assert [r["replicas"] for r in audit["rings"]] == [3, 10, 30]
+        assert all(r["within_tolerance"] for r in audit["rings"])
+        assert audit["router"]["pins"] == 0  # probe cleaned up after itself
+        assert 0 < audit["population_skew"]["unique"] <= 20_000
+
+
+# -- session-ring spill policy -------------------------------------------------
+
+
+class TestSpillPolicy:
+    def test_lru_replay_counts_hits_evictions_rejoins(self):
+        seq = np.array([1, 2, 1, 3, 2, 1])  # slots=2: 3 evicts, 2 rejoins
+        out = _simulate_lru_spill(seq, max_slots=2)
+        assert out == {
+            "requests": 6, "hits": 1, "joins": 5,
+            "evictions": 3, "rejoins": 2,
+        }
+
+    def test_lru_replay_is_deterministic(self):
+        pop = Population(n_households=1_000, seed=2)
+        seq = pop.sample(5_000, seed=1)
+        assert (_simulate_lru_spill(seq, 64)
+                == _simulate_lru_spill(seq.copy(), 64))
+
+    def test_batcher_counts_spill_rejoins_and_stays_bounded(self):
+        """The live continuous batcher mirrors the replay's accounting:
+        an evicted household's return is a counted spill rejoin, and the
+        host tables stay bounded by max_slots no matter the id churn."""
+        from p2pmicrogrid_tpu.serve.continuous import ContinuousBatcher
+
+        class _Engine:
+            is_recurrent = False
+            max_batch = 4
+            n_agents = 1
+            telemetry = None
+            manifest = {"config_hash": "cfg-spill"}
+
+            def bucket_for(self, n):
+                return n
+
+            def act(self, obs):
+                return np.zeros((obs.shape[0], 1), dtype=np.float32)
+
+        obs = np.zeros((1, 4), dtype=np.float32)
+        with ContinuousBatcher(_Engine(), max_slots=1,
+                               autostart=False) as cb:
+            for h in ("a", "b", "a", "c", "b"):
+                cb.submit(obs, household=h)
+                cb.step_once()
+            stats = dict(cb.stats)
+            audit = audit_session_ring(cb)
+        assert stats["evictions"] >= 3
+        assert stats["spill_rejoins"] >= 2
+        assert audit["resident"] <= 1
+        assert audit["recently_evicted"] <= audit["recently_evicted_cap"]
+
+
+# -- the scale bench + capture contract ----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def scale_rows(tmp_path_factory):
+    """One small-but-real serve_bench_scale run shared by the contract
+    tests: explicit service model (no engine), real ring placement, real
+    shard ingest into a real warehouse file."""
+    db = str(tmp_path_factory.mktemp("scale") / "results.db")
+    model = {1: 0.0004, 2: 0.0005, 4: 0.0007, 8: 0.0010}
+    rows = serve_bench_scale(
+        service_model=model,
+        population=Population(n_households=5_000, seed=0),
+        rate_hz=2_000.0, duration_s=1.0,
+        replica_counts=(2, 3, 4), vnodes=256,
+        max_batch=8, max_wait_s=0.002, max_slots=64,
+        results_db=db, seed=0,
+    )
+    return rows, db
+
+
+class TestScaleBench:
+    def test_headline_is_last_and_carries_the_claims(self, scale_rows):
+        rows, _db = scale_rows
+        head = rows[-1]
+        assert head["metric"] == "serve_bench_scale"
+        assert head["households"] == 5_000
+        assert head["replicas"] == 4
+        for key in ("rps_per_replica", "p50_ms", "p99_ms",
+                    "ingest_lag_ms", "load_spread", "value",
+                    "vs_baseline"):
+            assert isinstance(head[key], (int, float))
+        assert head["ingest"]["measured"] is True
+        assert head["ingest"]["merged_rows"]["telemetry_points"] > 0
+
+    def test_sweep_and_scaling_rows_cover_every_replica_count(
+        self, scale_rows
+    ):
+        rows, _db = scale_rows
+        sweep = [r for r in rows if r["metric"] == "scale_replica_sweep"]
+        assert [r["replicas"] for r in sweep] == [2, 3, 4]
+        (scaling,) = [r for r in rows if r["metric"] == "scale_scaling"]
+        assert scaling["replica_counts"] == [2, 3, 4]
+        assert set(scaling["load_spread_by_count"]) == {"2", "3", "4"}
+        (spill,) = [r for r in rows if r["metric"] == "scale_spill"]
+        assert spill["max_slots"] == 64
+        assert 0.0 <= spill["hit_rate"] <= 1.0
+
+    def test_bench_is_deterministic(self):
+        kw = dict(
+            service_model={1: 0.0004, 2: 0.0005},
+            population=Population(n_households=500, seed=1),
+            rate_hz=500.0, duration_s=1.0, replica_counts=(2, 3, 4),
+            vnodes=64, max_batch=2, seed=3,
+        )
+        assert serve_bench_scale(**kw) == serve_bench_scale(**kw)
+
+    def test_shard_files_merge_into_the_base_db(self, scale_rows):
+        _rows, db = scale_rows
+        con = sqlite3.connect(db)
+        try:
+            (n,) = con.execute(
+                "SELECT COUNT(*) FROM telemetry_points "
+                "WHERE kind = 'scale_batch'"
+            ).fetchone()
+        finally:
+            con.close()
+        assert n > 0
+
+    def test_schema_checker_enforces_the_scale_contract(self, scale_rows,
+                                                        tmp_path):
+        import sys
+
+        sys.path.insert(0, "tools")
+        try:
+            from check_artifacts_schema import check_scale_jsonl
+        finally:
+            sys.path.pop(0)
+        rows, _db = scale_rows
+
+        def write(path, rs):
+            with open(path, "w") as f:
+                for r in rs:
+                    f.write(json.dumps(r) + "\n")
+            return str(path)
+
+        # A committed-grade capture (headline claims 1M households).
+        good = [dict(r) for r in rows]
+        good[-1]["households"] = 1_000_000
+        problems = []
+        check_scale_jsonl(write(tmp_path / "SCALE_ok.jsonl", good),
+                          problems)
+        assert problems == []
+        # Under-scale capture: flagged.
+        problems = []
+        check_scale_jsonl(write(tmp_path / "SCALE_small.jsonl", rows),
+                          problems)
+        assert any("households" in p for p in problems)
+        # Headline not last: flagged.
+        problems = []
+        check_scale_jsonl(
+            write(tmp_path / "SCALE_mid.jsonl", [good[-1]] + good[:-1]),
+            problems,
+        )
+        assert any("last row" in p for p in problems)
+        # Missing scaling sweep: flagged.
+        problems = []
+        no_scaling = [r for r in good if r["metric"] != "scale_scaling"]
+        check_scale_jsonl(
+            write(tmp_path / "SCALE_nosweep.jsonl", no_scaling), problems
+        )
+        assert any("scale_scaling" in p for p in problems)
+
+
+# -- satellite defaults --------------------------------------------------------
+
+
+class TestScaleDefaults:
+    def test_promotion_default_batching_is_continuous(self):
+        import inspect
+
+        from p2pmicrogrid_tpu.serve.promotion import run_promotion_pipeline
+
+        sig = inspect.signature(run_promotion_pipeline)
+        assert sig.parameters["batching"].default == "continuous"
+
+    def test_fleet_loadgen_rejects_mismatched_household_ids(self):
+        from p2pmicrogrid_tpu.serve.router import run_fleet_loadgen
+
+        import asyncio
+
+        with pytest.raises(ValueError, match="household_ids"):
+            asyncio.run(
+                run_fleet_loadgen(
+                    None,
+                    np.zeros((4, 1, 4), dtype=np.float32),
+                    np.array([0.0, 0.001, 0.002, 0.003]),
+                    households=["house-0000001"],
+                    household_ids=["only-one"],
+                )
+            )

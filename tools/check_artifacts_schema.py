@@ -421,6 +421,83 @@ def check_serve_cb_jsonl(path: str, problems: list) -> None:
             )
 
 
+# Numeric keys every serve_bench_scale headline must carry — the scale
+# tier's claims (scale/bench.py, ISSUE 17): sustained rps/replica, tail
+# latency and warehouse ingest lag at a million-household population.
+SCALE_HEADLINE_NUMERIC = (
+    "households", "n_requests", "rate_hz",
+    "rps_per_replica", "offered_rps_per_replica",
+    "p50_ms", "p99_ms", "ingest_lag_ms", "load_spread", "vnodes",
+)
+
+SCALE_MIN_HOUSEHOLDS = 1_000_000
+
+
+def check_scale_jsonl(path: str, problems: list) -> None:
+    """SCALE_*.jsonl: metric rows + the ``serve_bench_scale`` headline
+    contract (numeric rps/p99/ingest-lag, >= 1e6 households, a
+    ``scale_scaling`` row sweeping >= 3 replica counts, headline LAST)."""
+    where = os.path.relpath(path)
+    check_metric_jsonl(path, problems)
+    rows = [
+        (row, rw) for row, rw in _iter_jsonl_rows(path, [])
+        if isinstance(row, dict)
+    ]
+    headlines = [
+        (i, row, rw) for i, (row, rw) in enumerate(rows)
+        if row.get("metric") == "serve_bench_scale"
+    ]
+    if not headlines:
+        problems.append(f"{where}: no serve_bench_scale headline row")
+        return
+    if headlines[-1][0] != len(rows) - 1:
+        problems.append(
+            f"{where}: serve_bench_scale headline must be the last row"
+        )
+    for _i, row, rw in headlines:
+        _require_numeric(
+            row, SCALE_HEADLINE_NUMERIC, rw, problems, "serve_bench_scale"
+        )
+        _require_bool(row, ("saturated",), rw, problems, "serve_bench_scale")
+        households = row.get("households")
+        if (
+            isinstance(households, (int, float))
+            and not isinstance(households, bool)
+            and households < SCALE_MIN_HOUSEHOLDS
+        ):
+            problems.append(
+                f"{rw}: scale headline covers {households} households — a "
+                f"committed capture must cover >= {SCALE_MIN_HOUSEHOLDS}"
+            )
+    scaling = [
+        (row, rw) for row, rw in rows
+        if row.get("metric") == "scale_scaling"
+    ]
+    if not scaling:
+        problems.append(
+            f"{where}: no scale_scaling row (replica-scaling sweep)"
+        )
+    for row, rw in scaling:
+        counts = row.get("replica_counts")
+        if not isinstance(counts, list) or len(counts) < 3:
+            problems.append(
+                f"{rw}: scale_scaling needs >= 3 replica counts, got "
+                f"{counts!r}"
+            )
+        _require_numeric(
+            row, ("max_load_spread",), rw, problems, "scale_scaling"
+        )
+        by_count = row.get("load_spread_by_count")
+        if not isinstance(by_count, dict) or not all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in by_count.values()
+        ):
+            problems.append(
+                f"{rw}: scale_scaling needs a numeric-valued "
+                "load_spread_by_count object"
+            )
+
+
 # Numeric SLO keys every serve_bench_fleet headline row must carry — the
 # chaos-run contract of serve/router.py:serve_bench_fleet. Availability,
 # failover count and retry rate are the point of a fleet capture: a row
@@ -1284,6 +1361,10 @@ def check_all(repo_root: str, strict_tail: bool = False) -> list:
         check_fleet_jsonl(path, problems)
     for path in sorted(fleet_proc_jsonl):
         check_fleet_proc_jsonl(path, problems)
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, "artifacts", "SCALE_*.jsonl"))
+    ):
+        check_scale_jsonl(path, problems)
     for path in sorted(
         glob.glob(os.path.join(repo_root, "artifacts", "TRACE_*.jsonl"))
     ):

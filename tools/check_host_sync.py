@@ -88,6 +88,11 @@ HOT_PATH_FILES = (
     # live fleet traffic: a stray blocking readback in its cycle loop
     # stalls the canary cadence and the recovery path alike.
     os.path.join("p2pmicrogrid_tpu", "serve", "autopilot.py"),
+    # The population sampler (ISSUE 17) generates the per-request arrival
+    # stream for million-household benches: a device readback per draw
+    # would turn the O(log N) vectorized sampler into the bench's own
+    # bottleneck and poison every scale capture's open-loop schedule.
+    os.path.join("p2pmicrogrid_tpu", "scale", "population.py"),
     # The regime engine (ISSUE 13) wraps every regime episode's slot scan
     # and the per-regime eval/training drivers — a blocking readback in
     # the slot wrapper or the episode closures would serialize every
